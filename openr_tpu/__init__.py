@@ -1,0 +1,33 @@
+"""openr-tpu: a TPU-native link-state routing platform.
+
+A ground-up rebuild of the capabilities of Facebook Open/R (reference:
+/root/reference) designed TPU-first: the distributed-protocol shell (discovery,
+replicated LSDB, link monitoring, FIB programming, control API) is host-side
+Python/C++ systems code, while the Decision module's shortest-path computation
+runs as a batched min-plus solver on TPU via JAX/XLA/Pallas, sharded over a
+device mesh with pjit.
+
+Layout (mirrors SURVEY.md §2 component inventory):
+  types.py        wire types (thrift-IDL equivalents, openr/if/*.thrift)
+  utils/          backoff, debounce, throttle, step detector, constants
+  messaging/      in-process pub/sub queues (openr/messaging/)
+  lsdb/           LinkState graph + PrefixState (openr/decision/LinkState.*)
+  solver/         CPU oracle + TPU batched SPF solvers (openr/decision/Decision.cpp)
+  ops/            JAX/Pallas min-plus kernels and nexthop extraction
+  parallel/       device mesh + sharding for the batched solver
+  kvstore/        replicated CRDT store + flooding (openr/kvstore/)
+  decision/       Decision module shell (openr/decision/Decision.cpp)
+  spark/          neighbor discovery FSM (openr/spark/)
+  linkmonitor/    link state + peering (openr/link-monitor/)
+  fib/            route programming proxy (openr/fib/)
+  prefix_manager/ prefix origination (openr/prefix-manager/)
+  allocators/     distributed value election (openr/allocators/)
+  platform/       FIB service + netlink seam (openr/platform/, openr/nl/)
+  config/         typed config (openr/config/)
+  ctrl/           control API surface (openr/ctrl-server/)
+  cli/            breeze-style CLI (openr/py/)
+  monitor/        counters + structured events (openr/monitor/)
+  watchdog/       liveness watchdog (openr/watchdog/)
+"""
+
+__version__ = "0.1.0"

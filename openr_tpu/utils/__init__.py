@@ -1,0 +1,16 @@
+"""Utility substrate: backoff, debounce, throttle, step detection.
+
+Equivalents of openr/common/{ExponentialBackoff,AsyncDebounce,AsyncThrottle,
+StepDetector}.h, rebuilt on asyncio instead of folly EventBase.
+"""
+
+from openr_tpu.utils.backoff import ExponentialBackoff
+from openr_tpu.utils.async_util import AsyncDebounce, AsyncThrottle
+from openr_tpu.utils.step_detector import StepDetector
+
+__all__ = [
+    "ExponentialBackoff",
+    "AsyncDebounce",
+    "AsyncThrottle",
+    "StepDetector",
+]

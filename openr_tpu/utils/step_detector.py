@@ -1,0 +1,104 @@
+"""Two-window step detector for noisy time series (RTT smoothing).
+
+Semantics follow openr/common/StepDetector.h: a fast and a slow sliding-window
+mean; when |fast-slow|/slow (percent) rises above hi_threshold we are on a
+step's rising edge; when it falls back below lo_threshold we signal the step
+with the fast mean. A separate absolute threshold catches slow "staircase"
+drift. Spark uses this to re-advertise adjacency RTT metrics only on real
+changes (Spark.cpp:667).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Tuple
+
+
+class _SlidingWindow:
+    """Count-bounded and time-bounded sliding window average."""
+
+    def __init__(self, max_samples: int, max_age: float) -> None:
+        self._max_samples = max_samples
+        self._max_age = max_age
+        self._samples: Deque[Tuple[float, float]] = deque()
+
+    def add(self, now: float, value: float) -> None:
+        self._samples.append((now, value))
+        while len(self._samples) > self._max_samples:
+            self._samples.popleft()
+        while self._samples and now - self._samples[0][0] > self._max_age:
+            self._samples.popleft()
+
+    def avg(self) -> float:
+        if not self._samples:
+            return 0.0
+        return sum(v for _, v in self._samples) / len(self._samples)
+
+    def count(self) -> int:
+        return len(self._samples)
+
+
+class StepDetector:
+    def __init__(
+        self,
+        step_cb: Callable[[float], None],
+        fast_window_size: int = 10,
+        slow_window_size: int = 60,
+        lower_threshold: float = 2.0,  # percent
+        upper_threshold: float = 5.0,  # percent
+        abs_threshold: float = 500.0,
+        sample_period: float = 1.0,
+    ) -> None:
+        assert lower_threshold < upper_threshold
+        assert fast_window_size < slow_window_size
+        self._fast = _SlidingWindow(
+            fast_window_size, sample_period * fast_window_size
+        )
+        self._slow = _SlidingWindow(
+            slow_window_size, sample_period * slow_window_size
+        )
+        self._slow_window_size = slow_window_size
+        self._lo = lower_threshold
+        self._hi = upper_threshold
+        self._abs = abs_threshold
+        self._step_cb = step_cb
+        self._last_avg = 0.0
+        self._last_avg_init = False
+        self._in_transit = False
+
+    def add_value(self, now: float, value: float) -> None:
+        self._fast.add(now, value)
+        self._slow.add(now, value)
+        fast_avg = self._fast.avg()
+        slow_avg = self._slow.avg()
+
+        if not self._last_avg_init and (
+            self._slow.count() >= self._slow_window_size / 2
+        ):
+            self._last_avg = slow_avg
+            self._last_avg_init = True
+
+        if slow_avg == 0:
+            raise ZeroDivisionError("slow window average is zero")
+
+        diff = abs((fast_avg - slow_avg) / slow_avg) * 100
+
+        if self._in_transit:
+            if diff <= self._lo:
+                # falling edge: step complete, report the fast mean
+                self._in_transit = False
+                self._step_cb(fast_avg)
+                self._last_avg = fast_avg
+                self._last_avg_init = True
+                return
+        elif diff >= self._hi:
+            self._in_transit = True
+
+        # gradual drift missed by the edge state machine
+        if (
+            diff <= self._lo
+            and self._last_avg_init
+            and abs(slow_avg - self._last_avg) >= self._abs
+        ):
+            self._step_cb(slow_avg)
+            self._last_avg = slow_avg

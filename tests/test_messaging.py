@@ -1,0 +1,258 @@
+"""Queue tests mirroring openr/messaging/tests/QueueTest.cpp."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.messaging import (
+    QueueClosedError,
+    ReplicateQueue,
+    RWQueue,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestRWQueue:
+    def test_push_then_get(self):
+        async def body():
+            q = RWQueue()
+            assert q.push(1)
+            assert q.push(2)
+            assert q.size() == 2
+            assert await q.get() == 1
+            assert await q.get() == 2
+            assert q.size() == 0
+
+        run(body())
+
+    def test_get_blocks_until_push(self):
+        async def body():
+            q = RWQueue()
+            getter = asyncio.ensure_future(q.get())
+            await asyncio.sleep(0)
+            assert not getter.done()
+            q.push("hello")
+            assert await getter == "hello"
+
+        run(body())
+
+    def test_try_get(self):
+        q = RWQueue()
+        assert q.try_get() is None
+        q.push(7)
+        assert q.try_get() == 7
+        assert q.try_get() is None
+
+    def test_push_after_close_fails(self):
+        q = RWQueue()
+        q.push(1)
+        q.close()
+        assert not q.push(2)
+
+    def test_drain_after_close(self):
+        # items pushed before close are still readable (QueueTest.cpp close
+        # semantics: pending data drains, then error)
+        async def body():
+            q = RWQueue()
+            q.push(1)
+            q.close()
+            assert await q.get() == 1
+            with pytest.raises(QueueClosedError):
+                await q.get()
+
+        run(body())
+
+    def test_close_wakes_pending_readers(self):
+        async def body():
+            q = RWQueue()
+            getter = asyncio.ensure_future(q.get())
+            await asyncio.sleep(0)
+            q.close()
+            with pytest.raises(QueueClosedError):
+                await getter
+
+        run(body())
+
+    def test_multiple_readers_fifo(self):
+        async def body():
+            q = RWQueue()
+            g1 = asyncio.ensure_future(q.get())
+            g2 = asyncio.ensure_future(q.get())
+            await asyncio.sleep(0)
+            q.push("a")
+            q.push("b")
+            assert await g1 == "a"
+            assert await g2 == "b"
+
+        run(body())
+
+    def test_stats(self):
+        async def body():
+            q = RWQueue()
+            q.push(1)
+            q.push(2)
+            await q.get()
+            assert q.num_writes == 2
+            assert q.num_reads == 1
+
+        run(body())
+
+
+class TestReplicateQueue:
+    def test_fanout(self):
+        async def body():
+            rq = ReplicateQueue()
+            r1 = rq.get_reader()
+            r2 = rq.get_reader()
+            assert rq.get_num_readers() == 2
+            rq.push(42)
+            assert await r1.get() == 42
+            assert await r2.get() == 42
+
+        run(body())
+
+    def test_reader_after_push_misses_old(self):
+        async def body():
+            rq = ReplicateQueue()
+            r1 = rq.get_reader()
+            rq.push(1)
+            r2 = rq.get_reader()
+            rq.push(2)
+            assert await r1.get() == 1
+            assert await r1.get() == 2
+            assert await r2.get() == 2
+            assert r2.size() == 0
+
+        run(body())
+
+    def test_close_propagates(self):
+        async def body():
+            rq = ReplicateQueue()
+            r1 = rq.get_reader()
+            rq.push(1)
+            rq.close()
+            assert not rq.push(2)
+            assert await r1.get() == 1
+            with pytest.raises(QueueClosedError):
+                await r1.get()
+            with pytest.raises(QueueClosedError):
+                rq.get_reader()
+
+        run(body())
+
+
+class TestUtils:
+    def test_exponential_backoff(self):
+        from openr_tpu.utils import ExponentialBackoff
+
+        t = [0.0]
+        b = ExponentialBackoff(1.0, 8.0, clock=lambda: t[0])
+        assert b.can_try_now()
+        b.report_error()
+        assert b.get_current_backoff() == 1.0
+        assert not b.can_try_now()
+        assert b.get_time_remaining_until_retry() == 1.0
+        b.report_error()
+        assert b.get_current_backoff() == 2.0
+        b.report_error()
+        b.report_error()
+        assert b.get_current_backoff() == 8.0
+        b.report_error()
+        assert b.get_current_backoff() == 8.0  # capped
+        assert b.at_max_backoff()
+        t[0] = 100.0
+        assert b.can_try_now()
+        b.report_success()
+        assert b.get_current_backoff() == 0.0
+
+    def test_async_debounce_batches(self):
+        from openr_tpu.utils import AsyncDebounce
+
+        async def body():
+            fired = []
+            d = AsyncDebounce(0.01, 0.05, lambda: fired.append(1))
+            for _ in range(10):
+                d()
+            assert d.is_scheduled()
+            await asyncio.sleep(0.2)
+            assert fired == [1]  # many invocations collapse to one
+
+        run(body())
+
+    def test_async_throttle(self):
+        from openr_tpu.utils import AsyncThrottle
+
+        async def body():
+            fired = []
+            th = AsyncThrottle(0.02, lambda: fired.append(1))
+            th()
+            th()
+            th()
+            assert th.is_active()
+            await asyncio.sleep(0.1)
+            assert fired == [1]
+            th()
+            await asyncio.sleep(0.1)
+            assert fired == [1, 1]
+
+        run(body())
+
+    def test_step_detector(self):
+        from openr_tpu.utils import StepDetector
+
+        steps = []
+        sd = StepDetector(
+            steps.append,
+            fast_window_size=4,
+            slow_window_size=16,
+            lower_threshold=2.0,
+            upper_threshold=5.0,
+            abs_threshold=10_000.0,
+            sample_period=1.0,
+        )
+        t = 0.0
+        for _ in range(20):
+            sd.add_value(t, 100.0)
+            t += 1.0
+        assert steps == []  # stable series, no steps
+        for _ in range(20):
+            sd.add_value(t, 200.0)
+            t += 1.0
+        assert len(steps) == 1  # one step detected
+        assert abs(steps[0] - 200.0) < 10.0
+
+
+class TestTypes:
+    def test_prefix_normalization(self):
+        from openr_tpu.types import IpPrefix
+
+        p = IpPrefix("10.0.0.5/24")
+        assert p.prefix == "10.0.0.0/24"
+        assert p.is_v4
+        assert p.prefix_length == 24
+        assert IpPrefix("fc00::1/64").prefix == "fc00::/64"
+        assert not IpPrefix("fc00::1/64").is_v4
+
+    def test_prefix_key_roundtrip(self):
+        from openr_tpu.types import IpPrefix, parse_prefix_key, prefix_key
+
+        k = prefix_key("node-1", IpPrefix("10.1.0.0/16"), "area51")
+        node, area, pfx = parse_prefix_key(k)
+        assert node == "node-1"
+        assert area == "area51"
+        assert pfx == IpPrefix("10.1.0.0/16")
+
+        node, area, pfx = parse_prefix_key(prefix_key("node-2"))
+        assert node == "node-2" and area is None and pfx is None
+
+    def test_value_merge_hash(self):
+        from openr_tpu.types import generate_hash
+
+        h1 = generate_hash(1, "node", b"abc")
+        h2 = generate_hash(1, "node", b"abc")
+        h3 = generate_hash(2, "node", b"abc")
+        assert h1 == h2
+        assert h1 != h3
