@@ -104,10 +104,18 @@ class RWQueue(Generic[T]):
 
 
 class RQueue(Generic[T]):
-    """Read-only facade over an RWQueue (openr/messaging/Queue.h:35)."""
+    """Read-only facade over an RWQueue (openr/messaging/Queue.h:35).
+
+    close() detaches this reader from its ReplicateQueue: the producer drops
+    closed readers on the next push (the reference GCs readers by shared_ptr
+    use-count, ReplicateQueue-inl.h).
+    """
 
     def __init__(self, queue: RWQueue[T]) -> None:
         self._queue = queue
+
+    def close(self) -> None:
+        self._queue.close()
 
     async def get(self) -> T:
         return await self._queue.get()
