@@ -26,13 +26,12 @@ This oracle defines the exact tie-breaking the TPU solver must reproduce:
 from __future__ import annotations
 
 import heapq
-from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.types import Adjacency, AdjacencyDatabase
 
 Metric = int
-INF = float("inf")
 
 
 class HoldableValue:

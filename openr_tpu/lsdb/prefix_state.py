@@ -98,9 +98,13 @@ class PrefixState:
         if table.get(node) == host:
             del table[node]
 
-    def get_prefix_databases(self) -> Dict[str, PrefixDatabase]:
-        """Reconstruct per-node PrefixDatabases (PrefixState.cpp:127-143)."""
-        out: Dict[str, PrefixDatabase] = {}
+    def get_prefix_databases(self) -> Dict[tuple, PrefixDatabase]:
+        """Reconstruct per-(node, area) PrefixDatabases.
+
+        PrefixState.cpp:127-143 keys by node only and silently drops all but
+        one area for multi-area nodes; keying by (node, area) is lossless.
+        """
+        out: Dict[tuple, PrefixDatabase] = {}
         for node, area_to_prefixes in self._node_to_prefixes.items():
             for area, prefixes in area_to_prefixes.items():
                 db = PrefixDatabase(this_node_name=node, area=area)
@@ -108,7 +112,7 @@ class PrefixState:
                     db.prefix_entries.append(
                         self._prefixes[prefix][node][area]
                     )
-                out[node] = db
+                out[(node, area)] = db
         return out
 
     def get_loopback_vias(

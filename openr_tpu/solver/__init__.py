@@ -1,0 +1,26 @@
+"""SPF solvers: route types, CPU oracle, and the TPU batched solver.
+
+The solver consumes LinkState + PrefixState and produces a DecisionRouteDb
+(unicast IP / IP2MPLS routes + MPLS label routes), mirroring
+openr/decision/Decision.cpp SpfSolver. Two interchangeable backends:
+  - cpu.SpfSolver: faithful oracle (per-source memoized Dijkstra)
+  - tpu.TpuSpfSolver: batched min-plus solver on TPU via JAX
+"""
+
+from openr_tpu.solver.routes import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    RibMplsEntry,
+    RibUnicastEntry,
+    get_route_delta,
+)
+from openr_tpu.solver.cpu import SpfSolver
+
+__all__ = [
+    "DecisionRouteDb",
+    "DecisionRouteUpdate",
+    "RibMplsEntry",
+    "RibUnicastEntry",
+    "get_route_delta",
+    "SpfSolver",
+]
