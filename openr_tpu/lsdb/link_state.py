@@ -339,6 +339,9 @@ class LinkState:
         self._kth_path_results: Dict[Tuple[str, str, int], List[Path]] = {}
         # counters (fb303 equivalents)
         self.spf_runs = 0
+        # monotonically bumped on every topology change; lets external
+        # solvers (TPU backend) cache compiled graphs per snapshot
+        self.version = 0
 
     # -- read API ----------------------------------------------------------
 
@@ -633,6 +636,7 @@ class LinkState:
     def _invalidate(self) -> None:
         self._spf_results.clear()
         self._kth_path_results.clear()
+        self.version += 1
 
     def _update_node_overloaded(
         self, node: str, overloaded: bool, hold_up_ttl: int, hold_down_ttl: int

@@ -1,0 +1,14 @@
+"""JAX/XLA ops for the batched min-plus SPF solver.
+
+The LSDB graph is compiled to padded edge-list arrays (graph.py); shortest
+paths for a batch of sources run as Bellman-Ford relaxation rounds with
+segment-min scatter (spf.py), converging in at most graph-diameter rounds; the
+ECMP first-hop DAG falls out of the triangle condition on the distance matrix.
+This replaces the reference's per-source serial Dijkstra
+(openr/decision/LinkState.cpp:806-880) with one data-parallel computation.
+"""
+
+from openr_tpu.ops.graph import INF, CompiledGraph, compile_graph
+from openr_tpu.ops.spf import batched_spf, ecmp_dag
+
+__all__ = ["INF", "CompiledGraph", "compile_graph", "batched_spf", "ecmp_dag"]

@@ -1,0 +1,107 @@
+"""LSDB graph → padded device arrays.
+
+The dynamic string-keyed LinkState graph becomes static-shaped int32 arrays:
+directed edge list (src, dst, w) sorted by destination for sorted segment-min,
+plus a per-node overload mask. Node and edge counts are padded to power-of-two
+buckets so that incremental topology changes (single link flap) reuse the same
+jit-compiled executable instead of recompiling (SURVEY.md §7 "dynamic graph,
+static shapes").
+
+Reference semantics compiled in:
+  - only up links participate (LinkState.cpp:844 skips !link->isUp())
+  - per-direction metrics (Link::getMetricFromNode)
+  - overloaded nodes carry no transit traffic (LinkState.cpp:829-836); the
+    mask is applied per-source inside the solver since a source's own edges
+    remain usable
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+from openr_tpu.lsdb.link_state import LinkState
+
+# int32-safe infinity: INF + max edge weight must not overflow int32
+INF = 1 << 29
+
+
+def _next_bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class CompiledGraph:
+    """Static-shaped arrays for one LinkState snapshot."""
+
+    names: List[str]  # index -> node name (real nodes only)
+    node_index: Dict[str, int]
+    n: int  # real node count
+    e: int  # real directed edge count
+    n_pad: int
+    e_pad: int
+    src: np.ndarray  # int32 [e_pad], padded entries point at 0 with INF w
+    dst: np.ndarray  # int32 [e_pad], sorted ascending (real entries)
+    w: np.ndarray  # int32 [e_pad]
+    overloaded: np.ndarray  # bool [n_pad]
+
+
+def compile_graph(link_state: LinkState) -> CompiledGraph:
+    names = sorted(
+        set(link_state.get_adjacency_databases().keys())
+        | {n for link in link_state.all_links for n in (link.n1, link.n2)}
+    )
+    node_index = {name: i for i, name in enumerate(names)}
+    n = len(names)
+
+    srcs: List[int] = []
+    dsts: List[int] = []
+    ws: List[int] = []
+    for link in sorted(link_state.all_links):
+        if not link.is_up():
+            continue
+        i1, i2 = node_index[link.n1], node_index[link.n2]
+        srcs.append(i1)
+        dsts.append(i2)
+        ws.append(link.metric_from_node(link.n1))
+        srcs.append(i2)
+        dsts.append(i1)
+        ws.append(link.metric_from_node(link.n2))
+    e = len(srcs)
+
+    n_pad = _next_bucket(max(n, 1))
+    e_pad = _next_bucket(max(e, 1))
+
+    src = np.zeros(e_pad, dtype=np.int32)
+    dst = np.zeros(e_pad, dtype=np.int32)
+    w = np.full(e_pad, INF, dtype=np.int32)
+    if e:
+        order = np.argsort(np.asarray(dsts, dtype=np.int32), kind="stable")
+        src[:e] = np.asarray(srcs, dtype=np.int32)[order]
+        dst[:e] = np.asarray(dsts, dtype=np.int32)[order]
+        w[:e] = np.asarray(ws, dtype=np.int32)[order]
+        # padded edges must not break sorted-segment assumptions: point them
+        # at the last real destination
+        dst[e:] = dst[e - 1]
+
+    overloaded = np.zeros(n_pad, dtype=bool)
+    for i, name in enumerate(names):
+        overloaded[i] = link_state.is_node_overloaded(name)
+
+    return CompiledGraph(
+        names=names,
+        node_index=node_index,
+        n=n,
+        e=e,
+        n_pad=n_pad,
+        e_pad=e_pad,
+        src=src,
+        dst=dst,
+        w=w,
+        overloaded=overloaded,
+    )

@@ -15,8 +15,10 @@ from openr_tpu.solver.routes import (
     get_route_delta,
 )
 from openr_tpu.solver.cpu import SpfSolver
+from openr_tpu.solver.tpu import TpuSpfSolver
 
 __all__ = [
+    "TpuSpfSolver",
     "DecisionRouteDb",
     "DecisionRouteUpdate",
     "RibMplsEntry",

@@ -106,6 +106,19 @@ class SpfSolver:
         self.counters: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
+    # SPF access seam — the TPU backend overrides these two methods to
+    # serve distances/nexthop-sets from the batched device solve while the
+    # whole route-assembly pipeline below is shared between backends
+    # ------------------------------------------------------------------
+
+    def _spf(self, link_state: LinkState, node: str):
+        """SpfResult-like mapping dest -> object with .metric/.next_hops."""
+        return link_state.get_spf_result(node)
+
+    def _dist(self, link_state: LinkState, a: str, b: str) -> Optional[Metric]:
+        return link_state.get_metric_from_a_to_b(a, b)
+
+    # ------------------------------------------------------------------
     # static routes (plugin seam)
     # ------------------------------------------------------------------
 
@@ -355,7 +368,7 @@ class SpfSolver:
                     link_state = area_link_states.get(area)
                     if link_state is None:
                         continue
-                    spf = link_state.get_spf_result(my_node_name)
+                    spf = self._spf(link_state, my_node_name)
                     if node not in spf:
                         continue  # unreachable
                     if not ret.best_node or node < ret.best_node:
@@ -407,7 +420,7 @@ class SpfSolver:
                 link_state = area_link_states.get(area)
                 if link_state is None:
                     continue
-                spf = link_state.get_spf_result(my_node_name)
+                spf = self._spf(link_state, my_node_name)
                 if node not in spf:
                     continue
                 assert entry.mv is not None
@@ -768,7 +781,7 @@ class SpfSolver:
         shortest_metric = INF_METRIC
 
         for link_state in area_link_states.values():
-            spf_from_here = link_state.get_spf_result(my_node_name)
+            spf_from_here = self._spf(link_state, my_node_name)
             min_metric, min_cost_nodes = self.get_min_cost_nodes(
                 spf_from_here, dst_node_names
             )
@@ -786,7 +799,7 @@ class SpfSolver:
                 for nh in spf_from_here[dst].next_hops:
                     next_hop_nodes[(nh, dst_ref)] = (
                         shortest_metric
-                        - link_state.get_metric_from_a_to_b(my_node_name, nh)
+                        - self._dist(link_state, my_node_name, nh)
                     )
 
             if self.compute_lfa_paths:
@@ -794,7 +807,7 @@ class SpfSolver:
                     if not link.is_up():
                         continue
                     neighbor = link.other_node_name(my_node_name)
-                    spf_from_neighbor = link_state.get_spf_result(neighbor)
+                    spf_from_neighbor = self._spf(link_state, neighbor)
                     if my_node_name not in spf_from_neighbor:
                         continue
                     neighbor_to_here = spf_from_neighbor[my_node_name].metric

@@ -1,0 +1,200 @@
+"""TPU batched SPF solver backend.
+
+Drop-in replacement for the CPU oracle: inherits the entire route-assembly
+pipeline from SpfSolver and overrides the SPF access seam so that distances
+and ECMP nexthop sets come from one batched min-plus solve on device
+(openr_tpu.ops.spf) instead of per-source Dijkstra runs.
+
+Per (area, topology-version, node) the solver compiles the LinkState to
+padded arrays and solves for sources = {me} ∪ neighbors(me) in a single
+device call — exactly the rows the route pipeline consumes:
+  - reachability/metric from me (best-announcer selection, min-cost nodes)
+  - dist(neighbor, t) for the triangle-condition ECMP nexthops and for the
+    RFC 5286 LFA inequality
+Nexthop sets are materialized lazily per queried destination via the triangle
+condition w(me,n) + D[n,t] == D[me,t], which reproduces Dijkstra's
+nexthop-union semantics (LinkState.cpp:855-871) without tracing paths.
+
+KSP2 path enumeration stays on the LinkState host path (get_kth_paths);
+fusing it on device is tracked for the ops layer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from openr_tpu.lsdb.link_state import LinkState
+from openr_tpu.ops.graph import INF, CompiledGraph, compile_graph
+from openr_tpu.ops.spf import batched_spf
+from openr_tpu.solver.cpu import Metric, SpfSolver
+
+
+class _NodeView:
+    """NodeSpfResult-compatible view over the device distance matrix."""
+
+    __slots__ = ("metric", "_result", "_dest")
+
+    def __init__(self, metric: Metric, result: "_TpuSpfResult", dest: str):
+        self.metric = metric
+        self._result = result
+        self._dest = dest
+
+    @property
+    def next_hops(self) -> Set[str]:
+        return self._result.next_hops_of(self._dest)
+
+
+class _TpuSpfResult:
+    """SpfResult-compatible mapping dest -> _NodeView, backed by D rows."""
+
+    def __init__(self, area: "_AreaSolve", source: str):
+        self._area = area
+        self._source = source
+        self._src_row = area.row_map[source]
+        self._nh_cache: Dict[str, Set[str]] = {}
+
+    def __contains__(self, dest: str) -> bool:
+        col = self._area.graph.node_index.get(dest)
+        if col is None:
+            return False
+        return self._area.d[self._src_row, col] < INF
+
+    def get(self, dest: str) -> Optional[_NodeView]:
+        col = self._area.graph.node_index.get(dest)
+        if col is None:
+            return None
+        metric = int(self._area.d[self._src_row, col])
+        if metric >= INF:
+            return None
+        return _NodeView(metric, self, dest)
+
+    def __getitem__(self, dest: str) -> _NodeView:
+        view = self.get(dest)
+        if view is None:
+            raise KeyError(dest)
+        return view
+
+    def next_hops_of(self, dest: str) -> Set[str]:
+        """ECMP nexthop node set for source -> dest via triangle condition.
+
+        Only valid when source is the solve's primary node: neighbor rows for
+        other sources are not in the batch, so a silent partial answer here
+        would corrupt routes — fail fast instead (the pipeline only reads
+        nexthop sets from my_node_name's perspective).
+        """
+        if self._source != self._area.sources[0]:
+            raise RuntimeError(
+                f"nexthop sets are only solved for {self._area.sources[0]}, "
+                f"requested for {self._source}"
+            )
+        cached = self._nh_cache.get(dest)
+        if cached is not None:
+            return cached
+        area = self._area
+        me = self._source
+        nhs: Set[str] = set()
+        if dest != me:
+            col = area.graph.node_index.get(dest)
+            if col is not None:
+                d_me = area.d[self._src_row, col]
+                if d_me < INF:
+                    ls = area.link_state
+                    for link in ls.ordered_links_from_node(me):
+                        if not link.is_up():
+                            continue
+                        n = link.other_node_name(me)
+                        n_row = area.row_map.get(n)
+                        if n_row is None:
+                            continue
+                        if ls.is_node_overloaded(n) and n != dest:
+                            continue
+                        w = link.metric_from_node(me)
+                        if w + area.d[n_row, col] == d_me:
+                            nhs.add(n)
+        self._nh_cache[dest] = nhs
+        return nhs
+
+
+class _AreaSolve:
+    """One batched device solve: sources = [me] + up-neighbors(me)."""
+
+    def __init__(self, link_state: LinkState, me: str) -> None:
+        self.link_state = link_state
+        self.me = me
+        self.graph: CompiledGraph = compile_graph(link_state)
+        neighbors = sorted(
+            {
+                link.other_node_name(me)
+                for link in link_state.links_from_node(me)
+                if link.is_up()
+            }
+        )
+        self.sources: List[str] = [me] + neighbors
+        rows = np.array(
+            [self.graph.node_index[s] for s in self.sources], dtype=np.int32
+        )
+        # one device call for the whole batch; copy back once
+        self.d = np.asarray(batched_spf(self.graph, rows))
+        self.row_map: Dict[str, int] = {
+            name: i for i, name in enumerate(self.sources)
+        }
+
+
+class TpuSpfSolver(SpfSolver):
+    """SpfSolver with the batched TPU distance backend."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        # (area name, node) -> (LinkState identity, topology version, solve);
+        # keyed by the stable area name so a replaced LinkState object for the
+        # same area overwrites its predecessor instead of leaking it
+        self._solves: Dict[
+            Tuple[str, str], Tuple[int, int, _AreaSolve]
+        ] = {}
+        self.device_solves = 0  # counter: batched device calls
+
+    def _area_solve(
+        self, link_state: LinkState, node: str
+    ) -> Optional[_AreaSolve]:
+        """The cached device solve for this area, or None when the node is
+        not present in this area's graph (multi-area: fall back to CPU)."""
+        if not link_state.has_node(node) and not link_state.links_from_node(
+            node
+        ):
+            return None
+        key = (link_state.area, node)
+        cached = self._solves.get(key)
+        if (
+            cached is not None
+            and cached[0] == id(link_state)
+            and cached[1] == link_state.version
+        ):
+            return cached[2]
+        solve = _AreaSolve(link_state, node)
+        self.device_solves += 1
+        self._solves[key] = (id(link_state), link_state.version, solve)
+        return solve
+
+    # -- SPF access seam -------------------------------------------------
+
+    def _spf(self, link_state: LinkState, node: str):
+        solve = self._area_solve(link_state, self.my_node_name)
+        if solve is not None and node in solve.row_map:
+            return _TpuSpfResult(solve, node)
+        # node outside the solved batch (not me / my neighbor), or an area
+        # this node does not participate in: CPU oracle fallback
+        return link_state.get_spf_result(node)
+
+    def _dist(self, link_state: LinkState, a: str, b: str) -> Optional[Metric]:
+        if a == b:
+            return 0
+        solve = self._area_solve(link_state, self.my_node_name)
+        if solve is not None:
+            row = solve.row_map.get(a)
+            col = solve.graph.node_index.get(b)
+            if row is not None and col is not None:
+                metric = int(solve.d[row, col])
+                return metric if metric < INF else None
+        return link_state.get_metric_from_a_to_b(a, b)

@@ -1,0 +1,346 @@
+"""TPU batched solver: op-level tests + full route-db parity vs the CPU oracle.
+
+The parity tests are the contract from SURVEY.md §7 phase 3: identical
+DecisionRouteDb output (routes, nexthops, labels) on every topology, verified
+on random graphs and the fixture topologies.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.ops import INF, batched_spf, compile_graph, ecmp_dag
+from openr_tpu.solver import SpfSolver, TpuSpfSolver
+from openr_tpu.topology import (
+    build_adj_dbs,
+    fabric_edges,
+    grid_edges,
+    ring_edges,
+    wan_edges,
+)
+from openr_tpu.types import (
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def build_ls(edges, area="0", **kwargs):
+    ls = LinkState(area)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def all_pairs_distance_check(ls):
+    """Compare batched BF distances against the Dijkstra oracle for all pairs."""
+    graph = compile_graph(ls)
+    d = np.asarray(batched_spf(graph, np.arange(graph.n_pad, dtype=np.int32)))
+    for src in graph.names:
+        oracle = ls.get_spf_result(src)
+        row = graph.node_index[src]
+        for dst in graph.names:
+            col = graph.node_index[dst]
+            got = int(d[row, col])
+            if dst in oracle:
+                assert got == oracle[dst].metric, (src, dst)
+            else:
+                assert got >= INF, (src, dst)
+
+
+class TestBatchedSpf:
+    def test_line(self):
+        ls = build_ls([("a", "b", 1), ("b", "c", 2), ("c", "d", 3)])
+        all_pairs_distance_check(ls)
+
+    def test_grid(self):
+        all_pairs_distance_check(build_ls(grid_edges(4)))
+
+    def test_weighted_ring(self):
+        edges = [(f"r{i}", f"r{(i+1)%8}", (i % 3) + 1) for i in range(8)]
+        all_pairs_distance_check(build_ls(edges))
+
+    def test_disconnected(self):
+        all_pairs_distance_check(build_ls([("a", "b", 1), ("x", "y", 2)]))
+
+    def test_overloaded_transit(self):
+        ls = build_ls(
+            [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)],
+            overloaded_nodes={"b"},
+        )
+        all_pairs_distance_check(ls)
+
+    def test_overloaded_cut_vertex(self):
+        # b overloaded and the only path a-c: c unreachable from a
+        ls = build_ls(
+            [("a", "b", 1), ("b", "c", 1)], overloaded_nodes={"b"}
+        )
+        graph = compile_graph(ls)
+        d = np.asarray(
+            batched_spf(graph, np.arange(graph.n_pad, dtype=np.int32))
+        )
+        ia, ib, ic = (graph.node_index[x] for x in "abc")
+        assert d[ia, ib] == 1  # reachable
+        assert d[ia, ic] >= INF  # no transit through b
+        assert d[ib, ic] == 1  # b's own routes unaffected
+        all_pairs_distance_check(ls)
+
+    def test_random_graphs(self):
+        rng = random.Random(42)
+        for trial in range(10):
+            n = rng.randint(4, 16)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = []
+            # random spanning tree + chords, random metrics
+            for i in range(1, n):
+                edges.append(
+                    (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 20))
+                )
+            for _ in range(rng.randint(0, n)):
+                a, b = rng.sample(nodes, 2)
+                if not any(
+                    (x == a and y == b) or (x == b and y == a)
+                    for x, y, _ in edges
+                ):
+                    edges.append((a, b, rng.randint(1, 20)))
+            overloaded = {
+                nodes[i] for i in range(n) if rng.random() < 0.2
+            }
+            ls = build_ls(edges, overloaded_nodes=overloaded)
+            all_pairs_distance_check(ls)
+
+    def test_ecmp_dag_matches_oracle_nexthops(self):
+        ls = build_ls(grid_edges(4))
+        graph = compile_graph(ls)
+        d = np.asarray(
+            batched_spf(graph, np.arange(graph.n_pad, dtype=np.int32))
+        )
+        dag = np.asarray(ecmp_dag(graph, d))
+        # oracle nexthop sets from each source = union over first-hop edges
+        for src in graph.names:
+            oracle = ls.get_spf_result(src)
+            row = graph.node_index[src]
+            for dst in graph.names:
+                if dst == src:
+                    continue
+                col = graph.node_index[dst]
+                got = {
+                    graph.names[graph.dst[e]]
+                    for e in range(graph.e)
+                    if graph.src[e] == row and dag[e, col]
+                }
+                want = oracle[dst].next_hops if dst in oracle else set()
+                assert got == want, (src, dst)
+
+    def test_bucket_padding_reuse(self):
+        # graphs in the same bucket share jit executables (no recompile):
+        # just exercise two different sizes in one bucket
+        for n in (5, 7):
+            all_pairs_distance_check(build_ls(ring_edges(n)))
+
+
+PFXS = ["10.1.0.0/16", "10.2.0.0/16", "10.3.0.0/16"]
+
+
+def make_prefix_state(announcers, area="0", **entry_kw):
+    ps = PrefixState()
+    for node, pfxs in announcers.items():
+        ps.update_prefix_database(
+            PrefixDatabase(
+                node,
+                [PrefixEntry(IpPrefix(p), **entry_kw) for p in pfxs],
+                area=area,
+            )
+        )
+    return ps
+
+
+def assert_route_db_equal(db_cpu, db_tpu):
+    assert db_cpu is not None and db_tpu is not None
+    assert set(db_cpu.unicast_entries) == set(db_tpu.unicast_entries)
+    for prefix, entry in db_cpu.unicast_entries.items():
+        assert db_tpu.unicast_entries[prefix] == entry, prefix
+    assert set(db_cpu.mpls_entries) == set(db_tpu.mpls_entries)
+    for label, entry in db_cpu.mpls_entries.items():
+        assert db_tpu.mpls_entries[label] == entry, label
+
+
+def run_parity(edges, announcers, me, overloaded=None, lfa=False, **entry_kw):
+    ls_cpu = build_ls(edges, overloaded_nodes=overloaded)
+    ls_tpu = build_ls(edges, overloaded_nodes=overloaded)
+    ps = make_prefix_state(announcers, **entry_kw)
+    cpu = SpfSolver(me, compute_lfa_paths=lfa)
+    tpu = TpuSpfSolver(me, compute_lfa_paths=lfa)
+    db_cpu = cpu.build_route_db(me, {"0": ls_cpu}, ps)
+    db_tpu = tpu.build_route_db(me, {"0": ls_tpu}, ps)
+    assert_route_db_equal(db_cpu, db_tpu)
+    assert tpu.device_solves >= 1
+    return db_tpu
+
+
+class TestRouteDbParity:
+    def test_line(self):
+        run_parity(
+            [("a", "b", 1), ("b", "c", 2)],
+            {"b": [PFXS[0]], "c": [PFXS[1]]},
+            "a",
+        )
+
+    def test_grid_ecmp(self):
+        run_parity(
+            grid_edges(4),
+            {"g3_3": [PFXS[0]], "g0_3": [PFXS[1]], "g2_1": [PFXS[2]]},
+            "g0_0",
+        )
+
+    def test_fabric(self):
+        edges = fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        run_parity(
+            edges,
+            {"rsw1_0": [PFXS[0]], "rsw0_3": [PFXS[1]]},
+            "rsw0_0",
+        )
+
+    def test_anycast(self):
+        run_parity(
+            [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)],
+            {"b": [PFXS[0]], "d": [PFXS[0]]},
+            "a",
+        )
+
+    def test_overloaded_announcer(self):
+        run_parity(
+            [("a", "b", 1), ("a", "c", 1)],
+            {"b": [PFXS[0]], "c": [PFXS[0]]},
+            "a",
+            overloaded={"b"},
+        )
+
+    def test_overloaded_transit(self):
+        run_parity(
+            [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)],
+            {"c": [PFXS[0]]},
+            "a",
+            overloaded={"b"},
+        )
+
+    def test_lfa_parity(self):
+        run_parity(
+            [("a", "b", 1), ("a", "c", 2), ("c", "b", 1)],
+            {"b": [PFXS[0]]},
+            "a",
+            lfa=True,
+        )
+
+    def test_ksp2_parity(self):
+        run_parity(
+            [("a", "b", 1), ("a", "c", 1), ("c", "b", 1)],
+            {"b": [PFXS[0]]},
+            "a",
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+
+    def test_wan_random(self):
+        edges = wan_edges(24, degree=4, seed=7)
+        run_parity(
+            edges,
+            {"w3": [PFXS[0]], "w17": [PFXS[1]], "w9": [PFXS[2]]},
+            "w0",
+        )
+
+    def test_random_parity_sweep(self):
+        rng = random.Random(1234)
+        for trial in range(6):
+            n = rng.randint(5, 14)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = []
+            for i in range(1, n):
+                edges.append(
+                    (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 9))
+                )
+            for _ in range(rng.randint(0, n // 2)):
+                a, b = rng.sample(nodes, 2)
+                if not any(
+                    {a, b} == {x, y} for x, y, _ in edges
+                ):
+                    edges.append((a, b, rng.randint(1, 9)))
+            announcers = {
+                rng.choice(nodes[1:]): [PFXS[i % 3]] for i in range(3)
+            }
+            overloaded = {
+                nodes[i] for i in range(1, n) if rng.random() < 0.15
+            }
+            run_parity(edges, announcers, nodes[0], overloaded=overloaded)
+
+    def test_multi_area_parity_with_absent_node(self):
+        # me participates in area A only; area B's graph lacks me entirely —
+        # the TPU backend must fall back to the CPU oracle for area B
+        def build(area, edges):
+            ls = LinkState(area)
+            for db in build_adj_dbs(edges, area=area).values():
+                ls.update_adjacency_database(db)
+            return ls
+
+        als_cpu = {
+            "A": build("A", [("a", "b", 1)]),
+            "B": build("B", [("x", "y", 1)]),
+        }
+        als_tpu = {
+            "A": build("A", [("a", "b", 1)]),
+            "B": build("B", [("x", "y", 1)]),
+        }
+        ps = PrefixState()
+        ps.update_prefix_database(
+            PrefixDatabase("b", [PrefixEntry(IpPrefix(PFXS[0]))], area="A")
+        )
+        ps.update_prefix_database(
+            PrefixDatabase("y", [PrefixEntry(IpPrefix(PFXS[1]))], area="B")
+        )
+        db_cpu = SpfSolver("a").build_route_db("a", als_cpu, ps)
+        db_tpu = TpuSpfSolver("a").build_route_db("a", als_tpu, ps)
+        assert_route_db_equal(db_cpu, db_tpu)
+        # reachable prefix programmed, unreachable (other area) not
+        assert IpPrefix(PFXS[0]) in db_tpu.unicast_entries
+        assert IpPrefix(PFXS[1]) not in db_tpu.unicast_entries
+
+    def test_incremental_update_recompiles(self):
+        # topology change bumps LinkState.version; solver must re-solve
+        edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+        ls = build_ls(edges)
+        ps = make_prefix_state({"c": [PFXS[0]]})
+        tpu = TpuSpfSolver("a")
+        db1 = tpu.build_route_db("a", {"0": ls}, ps)
+        nh1 = {
+            nh.neighbor_node
+            for nh in db1.unicast_entries[IpPrefix(PFXS[0])].nexthops
+        }
+        assert nh1 == {"b"}
+        solves_before = tpu.device_solves
+        # flap a-b: now direct a-c wins
+        dbs = build_adj_dbs([("a", "c", 5)])
+        from openr_tpu.types import AdjacencyDatabase
+
+        new_a = AdjacencyDatabase(
+            "a",
+            [x for x in build_adj_dbs(edges)["a"].adjacencies
+             if x.other_node_name != "b"],
+            area="0",
+        )
+        ls.update_adjacency_database(new_a)
+        db2 = tpu.build_route_db("a", {"0": ls}, ps)
+        nh2 = {
+            nh.neighbor_node
+            for nh in db2.unicast_entries[IpPrefix(PFXS[0])].nexthops
+        }
+        assert nh2 == {"c"}
+        assert tpu.device_solves == solves_before + 1
+        # unchanged topology: cached solve reused
+        tpu.build_route_db("a", {"0": ls}, ps)
+        assert tpu.device_solves == solves_before + 1
