@@ -1,0 +1,17 @@
+"""Device-mesh sharding for the batched SPF solver.
+
+Scaling axes (the TPU analog of the reference's parallelism, SURVEY.md §2.4):
+  - 'batch': the multi-source batch dimension — each device relaxes its slice
+    of sources with the edge list replicated (pure data parallelism, no
+    cross-chip traffic inside a relaxation round)
+  - 'graph': the edge dimension of the ECMP first-hop DAG extraction —
+    sharding the per-edge work for very large LSDBs
+"""
+
+from openr_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_batched_spf,
+    sharded_spf_step,
+)
+
+__all__ = ["make_mesh", "sharded_batched_spf", "sharded_spf_step"]
