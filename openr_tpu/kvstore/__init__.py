@@ -1,0 +1,34 @@
+"""Replicated eventually-consistent key-value store — the LSDB bus.
+
+Equivalent of openr/kvstore/: versioned CRDT merge (version > originatorId >
+value bytes, ttlVersion refresh), TTL expiry, prefix/originator filters,
+3-way full sync, incremental flooding with path-vector loop prevention, flood
+rate limiting with buffering, per-area instances, and a peer FSM
+(IDLE → SYNCING → INITIALIZED). The network transport is a seam: tests use the
+in-process transport (the KvStoreWrapper trick), production uses TCP.
+"""
+
+from openr_tpu.kvstore.store import (
+    KvStore,
+    KvStoreDb,
+    KvStoreFilters,
+    KvStoreParams,
+    PeerSpec,
+    PeerState,
+    compare_values,
+    merge_key_values,
+)
+from openr_tpu.kvstore.transport import InProcessTransport, KvStoreTransport
+
+__all__ = [
+    "KvStore",
+    "KvStoreDb",
+    "KvStoreFilters",
+    "KvStoreParams",
+    "PeerSpec",
+    "PeerState",
+    "compare_values",
+    "merge_key_values",
+    "InProcessTransport",
+    "KvStoreTransport",
+]

@@ -1,0 +1,763 @@
+"""KvStore: per-area replicated store with CRDT merge, TTL, sync, flooding.
+
+Behavioral port of openr/kvstore/KvStore.{h,cpp}:
+  - merge_key_values (KvStore.cpp:261-411): the CRDT merge — higher version
+    wins; same version → higher originatorId; same originator → higher value
+    bytes; identical value → retain higher ttlVersion; ttl-refresh updates
+    (no value) bump ttl/ttlVersion only.
+  - compare_values (KvStore.cpp:416-450): 3-way ordering used by the
+    difference dump; -2 = unknown (hash mismatch but no bodies).
+  - TTL countdown queue (KvStore.h:64-80, cleanup KvStore.cpp:2594-2644):
+    lazily-invalidated heap entries; expiry floods expiredKeys.
+  - 3-way full sync (KvStore.cpp:1381/1331/2705): requester sends its
+    hashes; responder returns better/missing keys + tobeUpdatedKeys; the
+    requester finalizes by pushing those keys back.
+  - flooding (KvStore.cpp:2851-2970): nodeIds path vector appended with our
+    id, never flood back to the sender, token-bucket rate limiting with a
+    merge buffer (KvStore.cpp:2648-2702).
+  - peer FSM IDLE → SYNCING → INITIALIZED (KvStore.h:46-62) with
+    exponential backoff on transport failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.types import (
+    KeyVals,
+    Publication,
+    TTL_INFINITY,
+    Value,
+    generate_hash,
+)
+from openr_tpu.utils import AsyncThrottle, ExponentialBackoff
+from openr_tpu.kvstore.transport import KvStoreTransport
+
+
+# ---------------------------------------------------------------------------
+# pure functions
+# ---------------------------------------------------------------------------
+
+
+def merge_key_values(
+    store: KeyVals,
+    key_vals: KeyVals,
+    filters: Optional["KvStoreFilters"] = None,
+) -> KeyVals:
+    """Merge key_vals into store; return the accepted updates to flood."""
+    updates: KeyVals = {}
+    for key, value in key_vals.items():
+        if filters is not None and not filters.key_match(key, value):
+            continue
+
+        # versions start at 1 (KvStore.cpp:277-279); reject anything lower
+        if value.version < 1:
+            continue
+
+        # TTL must be infinite or positive
+        if value.ttl != TTL_INFINITY and value.ttl <= 0:
+            continue
+
+        existing = store.get(key)
+        my_version = existing.version if existing is not None else 0
+        if value.version < my_version:
+            continue  # stale
+
+        update_all = False
+        update_ttl = False
+        if value.value is not None:
+            if value.version > my_version:
+                update_all = True
+            elif value.originator_id > existing.originator_id:
+                update_all = True
+            elif value.originator_id == existing.originator_id:
+                if existing.value is None or value.value > existing.value:
+                    # deterministic winner on divergent same-version values
+                    update_all = True
+                elif value.value == existing.value:
+                    if value.ttl_version > existing.ttl_version:
+                        update_ttl = True
+
+        # ttl refresh (no value body)
+        if (
+            value.value is None
+            and existing is not None
+            and value.version == existing.version
+            and value.originator_id == existing.originator_id
+            and value.ttl_version > existing.ttl_version
+        ):
+            update_ttl = True
+
+        if not update_all and not update_ttl:
+            continue
+
+        if update_all:
+            new_value = value.copy()
+            if new_value.hash is None:
+                new_value.hash = generate_hash(
+                    new_value.version, new_value.originator_id, new_value.value
+                )
+            store[key] = new_value
+        elif update_ttl:
+            existing.ttl = value.ttl
+            existing.ttl_version = value.ttl_version
+
+        updates[key] = value
+    return updates
+
+
+def compare_values(v1: Value, v2: Value) -> int:
+    """1: v1 better, -1: v2 better, 0: same, -2: unknown."""
+    if v1.version != v2.version:
+        return 1 if v1.version > v2.version else -1
+    if v1.originator_id != v2.originator_id:
+        return 1 if v1.originator_id > v2.originator_id else -1
+    if v1.hash is not None and v2.hash is not None and v1.hash == v2.hash:
+        if v1.ttl_version != v2.ttl_version:
+            return 1 if v1.ttl_version > v2.ttl_version else -1
+        return 0
+    if v1.value is not None and v2.value is not None:
+        if v1.value == v2.value:
+            if v1.ttl_version != v2.ttl_version:
+                return 1 if v1.ttl_version > v2.ttl_version else -1
+            return 0
+        return 1 if v1.value > v2.value else -1
+    return -2
+
+
+class KvStoreFilters:
+    """Key-prefix and originator filters (KvStore.h:82-119)."""
+
+    def __init__(
+        self,
+        key_prefixes: Optional[List[str]] = None,
+        originator_ids: Optional[Set[str]] = None,
+    ) -> None:
+        self.key_prefixes = key_prefixes or []
+        self.originator_ids = originator_ids or set()
+
+    def _prefix_match(self, key: str) -> bool:
+        if not self.key_prefixes:
+            return True
+        return any(key.startswith(p) for p in self.key_prefixes)
+
+    def key_match(self, key: str, value: Value) -> bool:
+        """OR semantics: match by prefix or by originator."""
+        if not self.key_prefixes and not self.originator_ids:
+            return True
+        if self.key_prefixes and self._prefix_match(key):
+            return True
+        if self.originator_ids and value.originator_id in self.originator_ids:
+            return True
+        return False
+
+    def key_match_all(self, key: str, value: Value) -> bool:
+        """AND semantics."""
+        return self._prefix_match(key) and (
+            not self.originator_ids
+            or value.originator_id in self.originator_ids
+        )
+
+
+# ---------------------------------------------------------------------------
+# peers
+# ---------------------------------------------------------------------------
+
+
+class PeerState(enum.Enum):
+    IDLE = "IDLE"
+    SYNCING = "SYNCING"
+    INITIALIZED = "INITIALIZED"
+
+
+class PeerEvent(enum.Enum):
+    PEER_ADD = "PEER_ADD"
+    SYNC_RESP_RCVD = "SYNC_RESP_RCVD"
+    SYNC_TIMEOUT = "SYNC_TIMEOUT"
+    API_ERROR = "API_ERROR"
+
+
+# state transition matrix (KvStore.h:421)
+_PEER_FSM: Dict[Tuple[PeerState, PeerEvent], PeerState] = {
+    (PeerState.IDLE, PeerEvent.PEER_ADD): PeerState.SYNCING,
+    (PeerState.SYNCING, PeerEvent.SYNC_RESP_RCVD): PeerState.INITIALIZED,
+    (PeerState.SYNCING, PeerEvent.SYNC_TIMEOUT): PeerState.IDLE,
+    (PeerState.SYNCING, PeerEvent.API_ERROR): PeerState.IDLE,
+    (PeerState.INITIALIZED, PeerEvent.SYNC_TIMEOUT): PeerState.IDLE,
+    (PeerState.INITIALIZED, PeerEvent.API_ERROR): PeerState.IDLE,
+    (PeerState.INITIALIZED, PeerEvent.SYNC_RESP_RCVD): PeerState.INITIALIZED,
+}
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    """Addressing info for one peer (thrift::PeerSpec equivalent)."""
+
+    peer_addr: str  # transport address (node id for in-process)
+    support_flood_optimization: bool = False
+
+
+@dataclass
+class _Peer:
+    spec: PeerSpec
+    backoff: ExponentialBackoff
+    state: PeerState = PeerState.IDLE
+
+
+@dataclass
+class _TtlEntry:
+    expiry: float
+    key: str
+    epoch: int  # store-write epoch; stale entries fail the epoch check
+
+    def __lt__(self, other: "_TtlEntry") -> bool:
+        return self.expiry < other.expiry
+
+
+class _TokenBucket:
+    """Flood rate limiter (folly::BasicTokenBucket equivalent)."""
+
+    def __init__(self, rate: float, burst: float) -> None:
+        self.rate = rate
+        self.burst = burst
+        self._tokens = burst
+        self._last = time.monotonic()
+
+    def consume(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# KvStoreDb — one area
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KvStoreParams:
+    node_id: str
+    ttl_decrement_ms: int = 1  # decrement applied when forwarding ttls
+    flood_rate: Optional[float] = None  # msgs/sec; None = unlimited
+    flood_burst: float = 32.0
+    flood_buffer_delay: float = 0.1  # kFloodPendingPublication (100ms)
+    sync_max_backoff: float = 8.0
+    filters: Optional[KvStoreFilters] = None
+
+
+class KvStoreDb:
+    def __init__(
+        self,
+        area: str,
+        params: KvStoreParams,
+        transport: KvStoreTransport,
+        updates_queue: ReplicateQueue,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.area = area
+        self.params = params
+        self.transport = transport
+        self.updates_queue = updates_queue
+        self._loop = loop
+        self.store: KeyVals = {}
+        self.peers: Dict[str, _Peer] = {}
+        self._ttl_heap: List[_TtlEntry] = []
+        # per-key write epoch: bumped on every accepted update so TTL heap
+        # entries from superseded writes can never evict the current value
+        self._ttl_epochs: Dict[str, int] = {}
+        self._ttl_timer: Optional[asyncio.TimerHandle] = None
+        self._flood_limiter = (
+            _TokenBucket(params.flood_rate, params.flood_burst)
+            if params.flood_rate
+            else None
+        )
+        # pending buffered flood keys (merge buffer under rate limiting)
+        self._publication_buffer: Set[str] = set()
+        self._buffer_flush = AsyncThrottle(
+            params.flood_buffer_delay, self._flush_buffered, loop=loop
+        )
+        self._retry_pending: Set[str] = set()
+        self._sync_tasks: Set[asyncio.Task] = set()
+        self.counters: Dict[str, int] = {}
+
+    # -- basic API ---------------------------------------------------------
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    def get_key(self, key: str) -> Optional[Value]:
+        return self.store.get(key)
+
+    def get_key_vals(self, keys: List[str]) -> Publication:
+        pub = Publication(area=self.area)
+        for key in keys:
+            v = self.store.get(key)
+            if v is not None:
+                pub.key_vals[key] = v
+        return pub
+
+    def dump_all(
+        self,
+        filters: Optional[KvStoreFilters] = None,
+        match_all: bool = False,
+    ) -> Publication:
+        pub = Publication(area=self.area)
+        filters = filters or KvStoreFilters()
+        match = filters.key_match_all if match_all else filters.key_match
+        for key, value in self.store.items():
+            if match(key, value):
+                pub.key_vals[key] = value
+        return pub
+
+    def dump_hashes(
+        self, filters: Optional[KvStoreFilters] = None
+    ) -> Publication:
+        pub = Publication(area=self.area)
+        filters = filters or KvStoreFilters()
+        for key, value in self.store.items():
+            if filters.key_match(key, value):
+                pub.key_vals[key] = Value(
+                    version=value.version,
+                    originator_id=value.originator_id,
+                    value=None,
+                    ttl=value.ttl,
+                    ttl_version=value.ttl_version,
+                    hash=value.hash,
+                )
+        return pub
+
+    def dump_difference(
+        self, my_key_vals: KeyVals, req_key_vals: KeyVals
+    ) -> Publication:
+        """3-way sync difference (KvStore.cpp:1331-1375): keyVals = keys
+        where we are better/only-us; tobe_updated_keys = keys where the
+        requester is better/only-them."""
+        pub = Publication(area=self.area)
+        pub.tobe_updated_keys = []
+        for key in set(my_key_vals) | set(req_key_vals):
+            mine = my_key_vals.get(key)
+            theirs = req_key_vals.get(key)
+            if mine is None:
+                pub.tobe_updated_keys.append(key)
+                continue
+            if theirs is None:
+                pub.key_vals[key] = mine
+                continue
+            rc = compare_values(mine, theirs)
+            if rc in (1, -2):
+                pub.key_vals[key] = mine
+            if rc in (-1, -2):
+                pub.tobe_updated_keys.append(key)
+        return pub
+
+    # -- local writes ------------------------------------------------------
+
+    def set_key_vals(self, key_vals: KeyVals) -> KeyVals:
+        """Local API write (thrift setKvStoreKeyVals): merge + flood."""
+        updates = merge_key_values(self.store, key_vals, self.params.filters)
+        self._update_ttl_countdown(updates)
+        if updates:
+            self._bump("kvstore.updated_key_vals", len(updates))
+            self.flood_publication(
+                Publication(key_vals=updates, area=self.area)
+            )
+        return updates
+
+    def handle_set_key_vals(
+        self, key_vals: KeyVals, node_ids: Optional[List[str]]
+    ) -> None:
+        """KEY_SET arriving from a peer (flooded publication)."""
+        if node_ids is not None and self.params.node_id in node_ids:
+            self._bump("kvstore.looped_publications")
+            return  # path-vector loop prevention (KvStore.cpp:2874-2884)
+        updates = merge_key_values(self.store, key_vals, self.params.filters)
+        self._update_ttl_countdown(updates)
+        if updates:
+            self.flood_publication(
+                Publication(
+                    key_vals=updates, area=self.area, node_ids=list(node_ids or [])
+                )
+            )
+
+    def handle_dump(self, key_val_hashes: Optional[KeyVals]) -> Publication:
+        """KEY_DUMP serving side; with hashes, serve the 3-way difference."""
+        pub = self.dump_all()
+        if key_val_hashes is not None:
+            pub = self.dump_difference(pub.key_vals, key_val_hashes)
+        self._update_publication_ttl(pub)
+        return pub
+
+    # -- flooding ----------------------------------------------------------
+
+    def flood_publication(
+        self,
+        publication: Publication,
+        rate_limit: bool = True,
+        _from_buffer: bool = False,
+    ) -> None:
+        if (
+            self._flood_limiter is not None
+            and rate_limit
+            and not self._flood_limiter.consume(1)
+        ):
+            self._buffer_publication(publication)
+            self._buffer_flush()
+            return
+        if self._publication_buffer and not _from_buffer:
+            self._buffer_publication(publication)
+            self._flush_buffered()
+            return
+
+        self._update_publication_ttl(publication, decrement=True)
+        if not publication.key_vals and not publication.expired_keys:
+            return
+
+        sender_id: Optional[str] = None
+        if publication.node_ids:
+            sender_id = publication.node_ids[-1]
+        if publication.node_ids is None:
+            publication.node_ids = []
+        publication.node_ids.append(self.params.node_id)
+
+        # internal subscribers (Decision et al.)
+        self.updates_queue.push(publication)
+        self._bump("kvstore.num_updates")
+
+        if not publication.key_vals:
+            return  # expiry-only publications stay local
+
+        for peer_name, peer in self.peers.items():
+            if sender_id is not None and sender_id == peer_name:
+                continue  # never flood back to the sender
+            if peer.state == PeerState.IDLE:
+                continue
+            self._spawn(
+                self._send_key_vals(
+                    peer_name,
+                    dict(publication.key_vals),
+                    list(publication.node_ids),
+                )
+            )
+
+    def _buffer_publication(self, publication: Publication) -> None:
+        self._bump("kvstore.rate_limit_suppress")
+        self._publication_buffer.update(publication.key_vals.keys())
+        self._publication_buffer.update(publication.expired_keys)
+
+    def _flush_buffered(self) -> None:
+        self._buffer_flush.cancel()
+        if not self._publication_buffer:
+            return
+        pub = Publication(area=self.area)
+        for key in self._publication_buffer:
+            value = self.store.get(key)
+            if value is not None:
+                pub.key_vals[key] = value
+            else:
+                pub.expired_keys.append(key)
+        self._publication_buffer.clear()
+        # forwarded as merged publication, not rate limited again
+        self.flood_publication(pub, rate_limit=False, _from_buffer=True)
+
+    async def _send_key_vals(
+        self, peer_name: str, key_vals: KeyVals, node_ids: List[str]
+    ) -> None:
+        peer = self.peers.get(peer_name)
+        if peer is None:
+            return
+        try:
+            await self.transport.set_key_vals(
+                peer.spec.peer_addr, self.area, key_vals, node_ids
+            )
+            self._bump("kvstore.thrift.num_flood_pub")
+        except Exception:
+            self._bump("kvstore.thrift.num_flood_pub_failure")
+            self._peer_event(peer_name, PeerEvent.API_ERROR)
+
+    # -- peers + full sync -------------------------------------------------
+
+    def add_peers(self, peers: Dict[str, PeerSpec]) -> None:
+        for name, spec in peers.items():
+            existing = self.peers.get(name)
+            if existing is not None and existing.spec == spec:
+                continue
+            self.peers[name] = _Peer(
+                spec=spec,
+                backoff=ExponentialBackoff(
+                    0.064, self.params.sync_max_backoff
+                ),
+            )
+            self._peer_event(name, PeerEvent.PEER_ADD)
+            self._spawn(self._full_sync(name))
+
+    def del_peers(self, names: List[str]) -> None:
+        for name in names:
+            self.peers.pop(name, None)
+
+    def get_peers(self) -> Dict[str, PeerSpec]:
+        return {name: p.spec for name, p in self.peers.items()}
+
+    def peer_state(self, name: str) -> Optional[PeerState]:
+        peer = self.peers.get(name)
+        return peer.state if peer else None
+
+    def _peer_event(self, name: str, event: PeerEvent) -> None:
+        peer = self.peers.get(name)
+        if peer is None:
+            return
+        next_state = _PEER_FSM.get((peer.state, event))
+        if next_state is not None:
+            peer.state = next_state
+        if event == PeerEvent.API_ERROR:
+            peer.backoff.report_error()
+            if name not in self._retry_pending:
+                self._retry_pending.add(name)
+                self._spawn(self._retry_sync(name))
+
+    async def _retry_sync(self, name: str) -> None:
+        try:
+            peer = self.peers.get(name)
+            if peer is None:
+                return
+            await asyncio.sleep(peer.backoff.get_time_remaining_until_retry())
+            peer = self.peers.get(name)
+            if peer is not None and peer.state == PeerState.IDLE:
+                peer.state = PeerState.SYNCING
+                self._retry_pending.discard(name)
+                await self._full_sync(name)
+        finally:
+            self._retry_pending.discard(name)
+
+    async def _full_sync(self, peer_name: str) -> None:
+        """3-way full sync with one peer (requester side)."""
+        peer = self.peers.get(peer_name)
+        if peer is None:
+            return
+        my_hashes = self.dump_hashes().key_vals
+        try:
+            pub = await self.transport.dump_key_vals(
+                peer.spec.peer_addr, self.area, my_hashes
+            )
+        except Exception:
+            self._bump("kvstore.full_sync_failure")
+            self._peer_event(peer_name, PeerEvent.API_ERROR)
+            return
+        peer.backoff.report_success()
+        self._bump("kvstore.thrift.num_full_sync")
+        # merge their better keys and flood resulting updates onward
+        self.handle_set_key_vals(pub.key_vals, [peer_name])
+        self._peer_event(peer_name, PeerEvent.SYNC_RESP_RCVD)
+        # push back keys the peer is missing / has worse
+        if pub.tobe_updated_keys:
+            await self._finalize_full_sync(pub.tobe_updated_keys, peer_name)
+
+    async def _finalize_full_sync(
+        self, keys: List[str], peer_name: str
+    ) -> None:
+        updates: KeyVals = {}
+        for key in keys:
+            value = self.store.get(key)
+            if value is not None:
+                updates[key] = value
+        pub = Publication(key_vals=updates, area=self.area)
+        self._update_publication_ttl(pub)
+        if not pub.key_vals:
+            return
+        peer = self.peers.get(peer_name)
+        if peer is None or peer.state == PeerState.IDLE:
+            return
+        self._bump("kvstore.thrift.num_finalized_sync")
+        try:
+            await self.transport.set_key_vals(
+                peer.spec.peer_addr,
+                self.area,
+                pub.key_vals,
+                [self.params.node_id],
+            )
+        except Exception:
+            self._peer_event(peer_name, PeerEvent.API_ERROR)
+
+    # -- TTL ---------------------------------------------------------------
+
+    def _update_ttl_countdown(self, key_vals: KeyVals) -> None:
+        """Register countdown entries for accepted updates. Every accepted
+        update bumps the key's epoch so entries from superseded writes (even
+        ones with identical version/originator/ttlVersion, e.g. the
+        value-bytes tiebreak) can never evict the refreshed value."""
+        now = time.monotonic()
+        for key, value in key_vals.items():
+            epoch = self._ttl_epochs.get(key, 0) + 1
+            self._ttl_epochs[key] = epoch
+            if value.ttl == TTL_INFINITY:
+                continue
+            entry = _TtlEntry(
+                expiry=now + value.ttl / 1000.0, key=key, epoch=epoch
+            )
+            if (
+                not self._ttl_heap or entry.expiry <= self._ttl_heap[0].expiry
+            ):
+                self._schedule_ttl_timer(value.ttl / 1000.0)
+            heapq.heappush(self._ttl_heap, entry)
+
+    def _schedule_ttl_timer(self, delay: float) -> None:
+        if self._ttl_timer is not None:
+            self._ttl_timer.cancel()
+        self._ttl_timer = self.loop().call_later(
+            max(0.0, delay), self.cleanup_ttl_countdown_queue
+        )
+
+    def cleanup_ttl_countdown_queue(self) -> None:
+        """Evict expired keys; lazily drop invalidated heap entries."""
+        self._ttl_timer = None
+        expired: List[str] = []
+        now = time.monotonic()
+        while self._ttl_heap and self._ttl_heap[0].expiry <= now:
+            top = heapq.heappop(self._ttl_heap)
+            if (
+                top.key in self.store
+                and self._ttl_epochs.get(top.key) == top.epoch
+            ):
+                expired.append(top.key)
+                del self.store[top.key]
+                del self._ttl_epochs[top.key]
+                self._bump("kvstore.expired_key_vals")
+        if self._ttl_heap:
+            self._schedule_ttl_timer(self._ttl_heap[0].expiry - now)
+        if expired:
+            self.flood_publication(
+                Publication(expired_keys=expired, area=self.area)
+            )
+
+    def _update_publication_ttl(
+        self, publication: Publication, decrement: bool = False
+    ) -> None:
+        """Drop about-to-expire keys; decrement forwarded TTLs
+        (KvStore.cpp:2038 updatePublicationTtl)."""
+        dec = self.params.ttl_decrement_ms
+        for key in list(publication.key_vals.keys()):
+            value = publication.key_vals[key]
+            if value.ttl == TTL_INFINITY:
+                continue
+            if value.ttl - dec <= 0:
+                del publication.key_vals[key]
+                continue
+            if decrement:
+                new_value = value.copy()
+                new_value.ttl = value.ttl - dec
+                publication.key_vals[key] = new_value
+
+    # -- misc --------------------------------------------------------------
+
+    def _spawn(self, coro) -> None:
+        task = self.loop().create_task(coro)
+        self._sync_tasks.add(task)
+        task.add_done_callback(self._sync_tasks.discard)
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def stop(self) -> None:
+        if self._ttl_timer is not None:
+            self._ttl_timer.cancel()
+            self._ttl_timer = None
+        self._buffer_flush.cancel()
+        for task in list(self._sync_tasks):
+            task.cancel()
+
+
+# ---------------------------------------------------------------------------
+# KvStore — multi-area container
+# ---------------------------------------------------------------------------
+
+
+class KvStore:
+    """Container of per-area KvStoreDbs sharing one transport + node id."""
+
+    def __init__(
+        self,
+        node_id: str,
+        areas: List[str],
+        transport,
+        params: Optional[KvStoreParams] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        import dataclasses
+
+        from openr_tpu.kvstore.transport import (
+            BoundTransport,
+            InProcessTransport,
+        )
+
+        self.node_id = node_id
+        params = params or KvStoreParams(node_id=node_id)
+        # never mutate the caller's params object (it may be shared)
+        self.params = dataclasses.replace(params, node_id=node_id)
+        if isinstance(transport, InProcessTransport):
+            transport.register(node_id, self)
+            transport = BoundTransport(transport, node_id)
+        self.updates_queue: ReplicateQueue = ReplicateQueue()
+        self.dbs: Dict[str, KvStoreDb] = {
+            area: KvStoreDb(
+                area, self.params, transport, self.updates_queue, loop
+            )
+            for area in areas
+        }
+
+    def db(self, area: str = "0") -> KvStoreDb:
+        return self.dbs[area]
+
+    # -- local API (OpenrCtrl surface) ------------------------------------
+
+    def set_key(
+        self,
+        key: str,
+        value: Value,
+        area: str = "0",
+    ) -> None:
+        self.dbs[area].set_key_vals({key: value})
+
+    def get_key(self, key: str, area: str = "0") -> Optional[Value]:
+        return self.dbs[area].get_key(key)
+
+    def dump_all(self, area: str = "0", **kw) -> Publication:
+        return self.dbs[area].dump_all(**kw)
+
+    def add_peers(self, peers: Dict[str, PeerSpec], area: str = "0") -> None:
+        self.dbs[area].add_peers(peers)
+
+    def del_peers(self, names: List[str], area: str = "0") -> None:
+        self.dbs[area].del_peers(names)
+
+    # -- transport server side --------------------------------------------
+
+    def handle_set_key_vals(
+        self, area: str, key_vals: KeyVals, node_ids: Optional[List[str]]
+    ) -> None:
+        db = self.dbs.get(area)
+        if db is not None:
+            db.handle_set_key_vals(key_vals, node_ids)
+
+    def handle_dump(
+        self, area: str, key_val_hashes: Optional[KeyVals]
+    ) -> Publication:
+        db = self.dbs.get(area)
+        if db is None:
+            return Publication(area=area)
+        return db.handle_dump(key_val_hashes)
+
+    def stop(self) -> None:
+        for db in self.dbs.values():
+            db.stop()
+        self.updates_queue.close()

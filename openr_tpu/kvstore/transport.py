@@ -1,0 +1,134 @@
+"""KvStore peer transport seam.
+
+The reference reaches peers over ZMQ ROUTER sockets or thrift clients
+(openr/kvstore/KvStore.h:130,453). Here the transport is an explicit
+interface; InProcessTransport wires stores directly (the KvStoreWrapper
+multi-store trick, openr/kvstore/KvStoreWrapper.h:30) with an optional
+per-link delay and a drop set for partition tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Dict, Optional, Set, Tuple
+
+from openr_tpu.types import KeyVals, Publication
+
+if TYPE_CHECKING:
+    from openr_tpu.kvstore.store import KvStore
+
+
+class KvStoreTransportError(RuntimeError):
+    pass
+
+
+class KvStoreTransport:
+    """Async RPC surface between stores (the thrift client equivalent)."""
+
+    async def set_key_vals(
+        self,
+        peer_addr: str,
+        area: str,
+        key_vals: KeyVals,
+        node_ids: Optional[list] = None,
+    ) -> None:
+        """KEY_SET: push key/values to a peer (flooding + finalize-sync)."""
+        raise NotImplementedError
+
+    async def dump_key_vals(
+        self,
+        peer_addr: str,
+        area: str,
+        key_val_hashes: Optional[KeyVals] = None,
+    ) -> Publication:
+        """KEY_DUMP: fetch the peer's store; with hashes, the peer returns
+        only differing keys plus tobe_updated_keys (3-way sync)."""
+        raise NotImplementedError
+
+
+class InProcessTransport(KvStoreTransport):
+    """Directly wired stores with optional latency/partitions."""
+
+    def __init__(self, delay: float = 0.0) -> None:
+        self._stores: Dict[str, "KvStore"] = {}
+        self._delay = delay
+        # (src, dst) pairs currently partitioned
+        self._dropped: Set[Tuple[str, str]] = set()
+
+    def register(self, node_id: str, store: "KvStore") -> None:
+        self._stores[node_id] = store
+
+    def partition(self, a: str, b: str) -> None:
+        self._dropped.add((a, b))
+        self._dropped.add((b, a))
+
+    def heal(self, a: str, b: str) -> None:
+        self._dropped.discard((a, b))
+        self._dropped.discard((b, a))
+
+    def _target(self, caller: str, peer_addr: str) -> "KvStore":
+        if (caller, peer_addr) in self._dropped:
+            raise KvStoreTransportError(
+                f"partitioned: {caller} -> {peer_addr}"
+            )
+        store = self._stores.get(peer_addr)
+        if store is None:
+            raise KvStoreTransportError(f"unknown peer {peer_addr}")
+        return store
+
+    # NOTE: callers pass their own node id via the bound transport handle
+    # (see KvStore._bound_transport); peer_addr is the target node id.
+
+    async def call_set(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        key_vals: KeyVals,
+        node_ids: Optional[list],
+    ) -> None:
+        if self._delay:
+            await asyncio.sleep(self._delay)
+        target = self._target(caller, peer_addr)
+        target.handle_set_key_vals(area, key_vals, node_ids)
+
+    async def call_dump(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        key_val_hashes: Optional[KeyVals],
+    ) -> Publication:
+        if self._delay:
+            await asyncio.sleep(self._delay)
+        target = self._target(caller, peer_addr)
+        return target.handle_dump(area, key_val_hashes)
+
+
+class BoundTransport(KvStoreTransport):
+    """A transport handle bound to one caller's node id."""
+
+    def __init__(self, inner: InProcessTransport, node_id: str) -> None:
+        self._inner = inner
+        self._node_id = node_id
+
+    async def set_key_vals(
+        self,
+        peer_addr: str,
+        area: str,
+        key_vals: KeyVals,
+        node_ids: Optional[list] = None,
+    ) -> None:
+        await self._inner.call_set(
+            self._node_id, peer_addr, area, key_vals, node_ids
+        )
+
+    async def dump_key_vals(
+        self,
+        peer_addr: str,
+        area: str,
+        key_val_hashes: Optional[KeyVals] = None,
+    ) -> Publication:
+        return await self._inner.call_dump(
+            self._node_id, peer_addr, area, key_val_hashes
+        )

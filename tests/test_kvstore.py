@@ -1,0 +1,373 @@
+"""KvStore tests mirroring openr/kvstore/tests/KvStoreTest.cpp core scenarios:
+CRDT merge semantics, TTL expiry, 3-way full sync, flooding with loop
+prevention, peer FSM, rate limiting."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreFilters,
+    KvStoreParams,
+    PeerSpec,
+    PeerState,
+    compare_values,
+    merge_key_values,
+)
+from openr_tpu.types import TTL_INFINITY, Publication, Value, generate_hash
+
+
+def v(
+    version=1,
+    originator="node1",
+    value=b"data",
+    ttl=TTL_INFINITY,
+    ttl_version=0,
+    with_hash=False,
+):
+    val = Value(version, originator, value, ttl, ttl_version)
+    if with_hash:
+        val.hash = generate_hash(version, originator, value)
+    return val
+
+
+class TestMergeKeyValues:
+    def test_new_key(self):
+        store = {}
+        updates = merge_key_values(store, {"k": v()})
+        assert "k" in updates and "k" in store
+        assert store["k"].hash is not None  # hash filled in
+
+    def test_higher_version_wins(self):
+        store = {"k": v(version=1, value=b"old")}
+        updates = merge_key_values(store, {"k": v(version=2, value=b"new")})
+        assert updates and store["k"].value == b"new"
+
+    def test_lower_version_ignored(self):
+        store = {"k": v(version=5, value=b"cur")}
+        updates = merge_key_values(store, {"k": v(version=4, value=b"old")})
+        assert not updates and store["k"].value == b"cur"
+
+    def test_originator_tiebreak(self):
+        store = {"k": v(originator="a", value=b"x")}
+        assert merge_key_values(store, {"k": v(originator="b", value=b"y")})
+        assert store["k"].originator_id == "b"
+        # lower originator loses
+        assert not merge_key_values(
+            store, {"k": v(originator="a", value=b"z")}
+        )
+
+    def test_value_tiebreak_same_originator(self):
+        # same version+originator, higher value bytes win (deterministic
+        # reconciliation after restart, KvStore.cpp:316-334)
+        store = {"k": v(value=b"aaa")}
+        assert merge_key_values(store, {"k": v(value=b"bbb")})
+        assert store["k"].value == b"bbb"
+        assert not merge_key_values(store, {"k": v(value=b"aaa")})
+
+    def test_ttl_version_refresh(self):
+        store = {"k": v(ttl=10000, ttl_version=0)}
+        # ttl refresh has no value body
+        refresh = Value(1, "node1", None, 20000, 1)
+        updates = merge_key_values(store, {"k": refresh})
+        assert updates
+        assert store["k"].ttl == 20000
+        assert store["k"].ttl_version == 1
+        assert store["k"].value == b"data"  # body preserved
+        # stale ttl version ignored
+        assert not merge_key_values(store, {"k": Value(1, "node1", None, 30000, 1)})
+
+    def test_invalid_ttl_skipped(self):
+        assert not merge_key_values({}, {"k": v(ttl=0)})
+        assert not merge_key_values({}, {"k": v(ttl=-5)})
+        assert merge_key_values({}, {"k": v(ttl=1000)})
+
+    def test_filters(self):
+        filters = KvStoreFilters(key_prefixes=["adj:"])
+        store = {}
+        updates = merge_key_values(
+            store, {"adj:n1": v(), "prefix:n1": v()}, filters
+        )
+        assert set(updates) == {"adj:n1"}
+
+    def test_same_value_same_ttlversion_noop(self):
+        store = {"k": v(with_hash=True)}
+        assert not merge_key_values(store, {"k": v(with_hash=True)})
+
+
+class TestCompareValues:
+    def test_version(self):
+        assert compare_values(v(version=2), v(version=1)) == 1
+        assert compare_values(v(version=1), v(version=2)) == -1
+
+    def test_originator(self):
+        assert compare_values(v(originator="b"), v(originator="a")) == 1
+
+    def test_hash_equal_ttl_version(self):
+        a = v(with_hash=True, ttl_version=2)
+        b = v(with_hash=True, ttl_version=1)
+        assert compare_values(a, b) == 1
+        b2 = v(with_hash=True, ttl_version=2)
+        assert compare_values(a, b2) == 0
+
+    def test_value_compare(self):
+        assert compare_values(v(value=b"b"), v(value=b"a")) == 1
+
+    def test_unknown(self):
+        a = v(with_hash=True)
+        b = Value(1, "node1", None, TTL_INFINITY, 0, hash=12345)
+        assert compare_values(a, b) == -2
+
+
+def run(coro, timeout=10.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def make_stores(names, transport=None, areas=("0",), **params_kw):
+    transport = transport or InProcessTransport()
+    stores = {
+        name: KvStore(
+            name,
+            list(areas),
+            transport,
+            params=KvStoreParams(node_id=name, **params_kw),
+        )
+        for name in names
+    }
+    return stores, transport
+
+
+async def settle(delay=0.05):
+    await asyncio.sleep(delay)
+
+
+class TestFullSync:
+    def test_peer_add_triggers_sync(self):
+        async def body():
+            stores, _ = make_stores(["a", "b"])
+            stores["a"].set_key("k1", v(originator="a", value=b"va"))
+            stores["b"].set_key("k2", v(originator="b", value=b"vb"))
+            # a peers with b: 3-way sync both directions
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            assert stores["a"].get_key("k2").value == b"vb"
+            assert stores["b"].get_key("k1").value == b"va"  # finalize leg
+            assert stores["a"].db().peer_state("b") == PeerState.INITIALIZED
+
+        run(body())
+
+    def test_conflict_resolution_via_sync(self):
+        async def body():
+            stores, _ = make_stores(["a", "b"])
+            stores["a"].set_key("k", v(version=3, originator="a", value=b"a3"))
+            stores["b"].set_key("k", v(version=5, originator="b", value=b"b5"))
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            assert stores["a"].get_key("k").value == b"b5"
+            assert stores["b"].get_key("k").value == b"b5"
+
+        run(body())
+
+    def test_sync_failure_backoff_to_idle(self):
+        async def body():
+            transport = InProcessTransport()
+            stores, _ = make_stores(["a", "b"], transport)
+            transport.partition("a", "b")
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            assert stores["a"].db().peer_state("b") == PeerState.IDLE
+            # heal: retry task should eventually re-sync
+            stores["b"].set_key("k", v(originator="b"))
+            transport.heal("a", "b")
+            await settle(0.3)  # initial backoff 64ms
+            assert stores["a"].db().peer_state("b") == PeerState.INITIALIZED
+            assert stores["a"].get_key("k") is not None
+
+        run(body())
+
+
+class TestFlooding:
+    def test_chain_propagation(self):
+        async def body():
+            stores, _ = make_stores(["a", "b", "c"])
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a"), "c": PeerSpec("c")})
+            stores["c"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            stores["a"].set_key("k", v(originator="a", value=b"flood"))
+            await settle()
+            assert stores["c"].get_key("k").value == b"flood"
+
+        run(body())
+
+    def test_loop_prevention_in_ring(self):
+        async def body():
+            stores, _ = make_stores(["a", "b", "c"])
+            ring = {"a": ["b", "c"], "b": ["a", "c"], "c": ["a", "b"]}
+            for name, peers in ring.items():
+                stores[name].add_peers(
+                    {p: PeerSpec(p) for p in peers}
+                )
+            await settle()
+            for s in stores.values():
+                s.db().counters.clear()
+            stores["a"].set_key("k", v(originator="a", value=b"ring"))
+            await settle()
+            for s in stores.values():
+                assert s.get_key("k").value == b"ring"
+
+        run(body())
+
+    def test_path_vector_loop_drop(self):
+        # a publication whose nodeIds already contains our id is dropped
+        # before merging, even if it carries a newer value
+        stores, _ = make_stores(["a"])
+        db = stores["a"].db()
+        db.set_key_vals({"k": v(version=1, originator="a")})
+        db.handle_set_key_vals(
+            {"k": v(version=9, originator="z", value=b"loop")},
+            node_ids=["z", "a", "b"],
+        )
+        assert stores["a"].get_key("k").version == 1
+        assert db.counters.get("kvstore.looped_publications") == 1
+
+    def test_internal_subscribers_see_updates(self):
+        async def body():
+            stores, _ = make_stores(["a", "b"])
+            reader = stores["b"].updates_queue.get_reader()
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a")})
+            await settle()
+            stores["a"].set_key("k", v(originator="a"))
+            await settle()
+            seen = []
+            while True:
+                pub = reader.try_get()
+                if pub is None:
+                    break
+                seen.append(pub)
+            # b's queue saw at least one publication containing k
+            # (from sync or flood)
+            assert any("k" in p.key_vals for p in seen)
+
+        run(body())
+
+    def test_rate_limit_buffers_and_merges(self):
+        async def body():
+            stores, _ = make_stores(
+                ["a", "b"], flood_rate=2.0, flood_burst=2.0,
+                flood_buffer_delay=0.05,
+            )
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a")})
+            await settle()
+            for i in range(20):
+                stores["a"].set_key(
+                    f"k{i}", v(originator="a", value=b"x%d" % i)
+                )
+            assert stores["a"].db().counters.get(
+                "kvstore.rate_limit_suppress", 0
+            ) > 0
+            await settle(0.5)
+            # all keys eventually arrive despite rate limiting
+            for i in range(20):
+                assert stores["b"].get_key(f"k{i}") is not None
+
+        run(body())
+
+
+class TestTtl:
+    def test_key_expires(self):
+        async def body():
+            stores, _ = make_stores(["a"])
+            stores["a"].set_key("k", v(ttl=50))  # 50ms
+            assert stores["a"].get_key("k") is not None
+            await settle(0.2)
+            assert stores["a"].get_key("k") is None
+            assert stores["a"].db().counters.get(
+                "kvstore.expired_key_vals"
+            ) == 1
+
+        run(body())
+
+    def test_ttl_refresh_extends(self):
+        async def body():
+            stores, _ = make_stores(["a"])
+            stores["a"].set_key("k", v(ttl=80))
+            await settle(0.05)
+            # refresh before expiry with higher ttlVersion
+            stores["a"].db().set_key_vals(
+                {"k": Value(1, "node1", None, 200, 1)}
+            )
+            await settle(0.1)  # original would have expired by now
+            assert stores["a"].get_key("k") is not None
+            await settle(0.2)
+            assert stores["a"].get_key("k") is None
+
+        run(body())
+
+    def test_forwarded_ttl_decremented(self):
+        async def body():
+            stores, _ = make_stores(["a", "b"])
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a")})
+            await settle()
+            stores["a"].set_key("k", v(ttl=10000))
+            await settle()
+            assert stores["b"].get_key("k").ttl < 10000
+
+        run(body())
+
+
+class TestDumpApis:
+    def test_dump_with_filters(self):
+        stores, _ = make_stores(["a"])
+        db = stores["a"].db()
+        db.set_key_vals({"adj:x": v(originator="x")})
+        db.set_key_vals({"prefix:y": v(originator="y")})
+        pub = db.dump_all(KvStoreFilters(key_prefixes=["adj:"]))
+        assert set(pub.key_vals) == {"adj:x"}
+        pub = db.dump_all(
+            KvStoreFilters(originator_ids={"y"})
+        )
+        assert set(pub.key_vals) == {"prefix:y"}
+        # AND semantics
+        pub = db.dump_all(
+            KvStoreFilters(key_prefixes=["adj:"], originator_ids={"y"}),
+            match_all=True,
+        )
+        assert pub.key_vals == {}
+
+    def test_dump_hashes_strips_values(self):
+        stores, _ = make_stores(["a"])
+        db = stores["a"].db()
+        db.set_key_vals({"k": v()})
+        pub = db.dump_hashes()
+        assert pub.key_vals["k"].value is None
+        assert pub.key_vals["k"].hash is not None
+
+    def test_get_key_vals_subset(self):
+        stores, _ = make_stores(["a"])
+        db = stores["a"].db()
+        db.set_key_vals({"k1": v(), "k2": v()})
+        pub = db.get_key_vals(["k1", "nope"])
+        assert set(pub.key_vals) == {"k1"}
+
+    def test_multi_area_isolation(self):
+        async def body():
+            transport = InProcessTransport()
+            stores, _ = make_stores(
+                ["a", "b"], transport, areas=("red", "blue")
+            )
+            stores["a"].set_key("k", v(originator="a"), area="red")
+            stores["a"].add_peers({"b": PeerSpec("b")}, area="red")
+            await settle()
+            assert stores["b"].get_key("k", area="red") is not None
+            assert stores["b"].get_key("k", area="blue") is None
+
+        run(body())
