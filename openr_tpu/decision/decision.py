@@ -1,0 +1,439 @@
+"""Decision module: consumes KvStore publications, maintains per-area
+LinkState + global PrefixState, debounces, solves, emits route deltas.
+
+Behavioral port of openr/decision/Decision.{h,cpp} module shell:
+  - processPublication (Decision.cpp:1631-1763): 'adj:<node>' values update
+    the area's LinkState (with ordered-FIB holds when enabled);
+    'prefix:...' values update PrefixState (per-node or per-prefix keys);
+    expired keys delete the corresponding db.
+  - pending-updates batch tracker (Decision.h:95-207): counts + the perf
+    event trace of the oldest event in the batch.
+  - debounced rebuild (AsyncDebounce, Decision.cpp:1406) between
+    debounce_min and debounce_max.
+  - cold-start timer (eor_time_s) delays the first computation so the LSDB
+    can fill after restart (Decision.cpp:1353-1359).
+  - RibPolicy applied to unicast routes before emission
+    (Decision.cpp:1831-1865), with TTL expiry re-emission.
+  - solver backend selected by config: 'cpu' oracle or 'tpu' batched
+    (the BASELINE.json north-star plugin seam).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.messaging import QueueClosedError, RQueue, ReplicateQueue
+from openr_tpu.solver import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    SpfSolver,
+    TpuSpfSolver,
+    get_route_delta,
+)
+from openr_tpu.solver.rib_policy import RibPolicy
+from openr_tpu.types import (
+    ADJ_DB_MARKER,
+    PREFIX_DB_MARKER,
+    AdjacencyDatabase,
+    PerfEvents,
+    PrefixDatabase,
+    Publication,
+    parse_prefix_key,
+)
+from openr_tpu.utils import AsyncDebounce
+from openr_tpu.utils import serializer
+
+
+@dataclass
+class DecisionConfig:
+    my_node_name: str
+    areas: List[str] = field(default_factory=lambda: ["0"])
+    solver_backend: str = "cpu"  # 'cpu' | 'tpu'
+    enable_v4: bool = True
+    compute_lfa_paths: bool = False
+    enable_ordered_fib: bool = False
+    bgp_dry_run: bool = False
+    bgp_use_igp_metric: bool = False
+    debounce_min: float = 0.01  # 10ms (docs/Runbook.md:425-435)
+    debounce_max: float = 0.25  # 250ms
+    eor_time_s: float = 0.0  # cold-start hold; 0 = no hold
+
+
+class _PendingUpdates:
+    """Batch tracker (Decision.h:95-207)."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.perf_events: Optional[PerfEvents] = None
+        self.needs_route_update = False
+
+    def apply(self, perf_events: Optional[PerfEvents]) -> None:
+        self.count += 1
+        self.needs_route_update = True
+        # keep the OLDEST event trace in the batch (Decision.h:174-191)
+        if perf_events is not None and (
+            self.perf_events is None
+            or (
+                perf_events.events
+                and self.perf_events.events
+                and perf_events.events[0].unix_ts
+                < self.perf_events.events[0].unix_ts
+            )
+        ):
+            self.perf_events = perf_events.copy()
+
+    def reset(self) -> None:
+        self.count = 0
+        self.perf_events = None
+        self.needs_route_update = False
+
+
+class Decision:
+    def __init__(
+        self,
+        config: DecisionConfig,
+        kvstore_updates: RQueue,
+        route_updates_queue: ReplicateQueue,
+        static_routes_updates: Optional[RQueue] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.kvstore_updates = kvstore_updates
+        self.route_updates_queue = route_updates_queue
+        self.static_routes_updates = static_routes_updates
+        self._loop = loop
+
+        solver_cls = TpuSpfSolver if config.solver_backend == "tpu" else SpfSolver
+        self.solver = solver_cls(
+            config.my_node_name,
+            enable_v4=config.enable_v4,
+            compute_lfa_paths=config.compute_lfa_paths,
+            enable_ordered_fib=config.enable_ordered_fib,
+            bgp_dry_run=config.bgp_dry_run,
+            bgp_use_igp_metric=config.bgp_use_igp_metric,
+        )
+        self.area_link_states: Dict[str, LinkState] = {
+            area: LinkState(area) for area in config.areas
+        }
+        self.prefix_state = PrefixState()
+        # per-prefix-key aggregation (Decision.cpp:1584-1629): entries from
+        # per-prefix keys override entries from full-db keys per node
+        self._per_prefix_entries: Dict[str, Dict] = {}
+        self._full_db_entries: Dict[str, Dict] = {}
+        self.route_db = DecisionRouteDb()
+        self.rib_policy: Optional[RibPolicy] = None
+        self._pending = _PendingUpdates()
+        self._rebuild_debounce = AsyncDebounce(
+            config.debounce_min,
+            config.debounce_max,
+            self.rebuild_routes,
+            loop=loop,
+        )
+        self._cold_start_until: Optional[float] = None
+        self._cold_start_timer: Optional[asyncio.TimerHandle] = None
+        self._rib_policy_timer: Optional[asyncio.TimerHandle] = None
+        self._task: Optional[asyncio.Task] = None
+        self.counters: Dict[str, int] = {}
+        self.have_computed_routes = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    def start(self) -> None:
+        if self.config.eor_time_s > 0:
+            self._cold_start_until = (
+                self.loop().time() + self.config.eor_time_s
+            )
+            self._cold_start_timer = self.loop().call_later(
+                self.config.eor_time_s, self._end_cold_start
+            )
+        self._task = self.loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._rebuild_debounce.cancel()
+        if self._cold_start_timer is not None:
+            self._cold_start_timer.cancel()
+            self._cold_start_timer = None
+        if self._rib_policy_timer is not None:
+            self._rib_policy_timer.cancel()
+
+    def _end_cold_start(self) -> None:
+        self._cold_start_until = None
+        self._pending.needs_route_update = True
+        self.rebuild_routes()
+
+    async def _run(self) -> None:
+        tasks = [self._consume_kvstore()]
+        if self.static_routes_updates is not None:
+            tasks.append(self._consume_static())
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _consume_kvstore(self) -> None:
+        while True:
+            try:
+                pub = await self.kvstore_updates.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            try:
+                self.process_publication(pub)
+            except Exception:
+                # a malformed value must not kill the consumer
+                # (Decision.cpp:1726-1729 catches per-key deserialize errors)
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "failed to process publication"
+                )
+                self._bump("decision.errors")
+
+    async def _consume_static(self) -> None:
+        try:
+            while True:
+                update = await self.static_routes_updates.get()
+                mpls_to_update, mpls_to_delete = update
+                self.solver.push_static_routes_delta(
+                    mpls_to_update, mpls_to_delete
+                )
+                static = self.solver.process_static_route_updates()
+                if static is not None and not static.empty():
+                    self.route_updates_queue.push(static)
+        except (QueueClosedError, asyncio.CancelledError):
+            pass
+
+    # ------------------------------------------------------------------
+    # publication processing
+    # ------------------------------------------------------------------
+
+    def process_publication(self, publication: Publication) -> None:
+        area = publication.area
+        link_state = self.area_link_states.get(area)
+        if link_state is None:
+            # unknown area: create on the fly (config-less area discovery)
+            link_state = LinkState(area)
+            self.area_link_states[area] = link_state
+
+        changed = False
+        for key, value in publication.key_vals.items():
+            if value.value is None:
+                continue  # ttl refresh only
+            if key.startswith(ADJ_DB_MARKER):
+                adj_db = serializer.loads(value.value)
+                assert isinstance(adj_db, AdjacencyDatabase)
+                adj_db.area = area
+                hold_up = hold_down = 0
+                if self.config.enable_ordered_fib:
+                    # hold TTLs from hop distance (Decision.cpp:1669-1679)
+                    maybe_hops = link_state.get_hops_from_a_to_b(
+                        self.config.my_node_name, adj_db.this_node_name
+                    )
+                    if maybe_hops is not None:
+                        hold_up = maybe_hops
+                        hold_down = (
+                            link_state.get_max_hops_to_node(
+                                adj_db.this_node_name
+                            )
+                            - hold_up
+                        )
+                change = link_state.update_adjacency_database(
+                    adj_db, hold_up, hold_down
+                )
+                self._bump("decision.adj_db_update")
+                if (
+                    change.topology_changed
+                    or change.link_attributes_changed
+                    or change.node_label_changed
+                ):
+                    changed = True
+                    self._pending.apply(adj_db.perf_events)
+            elif key.startswith(PREFIX_DB_MARKER):
+                prefix_db = serializer.loads(value.value)
+                assert isinstance(prefix_db, PrefixDatabase)
+                node_db = self._update_node_prefix_database(key, prefix_db)
+                if node_db is None:
+                    continue
+                node_db.area = area
+                self._bump("decision.prefix_db_update")
+                if self.prefix_state.update_prefix_database(node_db):
+                    changed = True
+                    self._pending.apply(prefix_db.perf_events)
+
+        for key in publication.expired_keys:
+            if key.startswith(ADJ_DB_MARKER):
+                node = key[len(ADJ_DB_MARKER):]
+                if link_state.delete_adjacency_database(node).topology_changed:
+                    changed = True
+                    self._pending.apply(None)
+            elif key.startswith(PREFIX_DB_MARKER):
+                node, _, _ = parse_prefix_key(key)
+                delete_db = PrefixDatabase(
+                    this_node_name=node, delete_prefix=True
+                )
+                node_db = self._update_node_prefix_database(key, delete_db)
+                if node_db is None:
+                    continue
+                node_db.area = area
+                if self.prefix_state.update_prefix_database(node_db):
+                    changed = True
+                    self._pending.apply(None)
+
+        if changed:
+            self._schedule_rebuild()
+
+    def _update_node_prefix_database(
+        self, key: str, prefix_db: PrefixDatabase
+    ) -> Optional[PrefixDatabase]:
+        """Merge a per-prefix or full-db key into the node's aggregated
+        PrefixDatabase (Decision.cpp:1584-1629). Per-prefix entries override
+        full-db entries; returns the synthesized node database."""
+        node = prefix_db.this_node_name
+        _, _, key_prefix = parse_prefix_key(key)
+        per_prefix = self._per_prefix_entries.setdefault(node, {})
+        full_db = self._full_db_entries.setdefault(node, {})
+        if key_prefix is not None:
+            # per-prefix key
+            if prefix_db.delete_prefix:
+                per_prefix.pop(key_prefix, None)
+            else:
+                assert len(prefix_db.prefix_entries) == 1, key
+                entry = prefix_db.prefix_entries[0]
+                # ignore self-redistributed route reflection
+                # (Decision.cpp:1598-1604)
+                if (
+                    node == self.config.my_node_name
+                    and entry.area_stack
+                    and entry.area_stack[0] in self.area_link_states
+                ):
+                    return None
+                per_prefix[key_prefix] = entry
+        else:
+            full_db.clear()
+            for entry in prefix_db.prefix_entries:
+                full_db[entry.prefix] = entry
+
+        node_db = PrefixDatabase(
+            this_node_name=node, perf_events=prefix_db.perf_events
+        )
+        node_db.prefix_entries.extend(per_prefix.values())
+        node_db.prefix_entries.extend(
+            entry
+            for prefix, entry in full_db.items()
+            if prefix not in per_prefix
+        )
+        return node_db
+
+    def _schedule_rebuild(self) -> None:
+        if self._cold_start_until is not None:
+            return  # waiting for LSDB fill after restart
+        self._rebuild_debounce()
+
+    # ------------------------------------------------------------------
+    # route computation + emission
+    # ------------------------------------------------------------------
+
+    def rebuild_routes(self) -> None:
+        """Debounced batch solve + delta emission (Decision.cpp:1771-1814)."""
+        if self._cold_start_until is not None:
+            return
+        if not self._pending.needs_route_update:
+            return
+        perf_events = self._pending.perf_events
+        self._bump("decision.batched_updates", self._pending.count)
+        self._pending.reset()
+        self._bump("decision.route_build_runs")
+
+        new_db = self.solver.build_route_db(
+            self.config.my_node_name, self.area_link_states, self.prefix_state
+        )
+        if new_db is None:
+            return
+        self._apply_rib_policy(new_db)
+        delta = get_route_delta(new_db, self.route_db)
+        self.route_db = new_db
+        self.have_computed_routes = True
+        if not delta.empty():
+            delta.perf_events = perf_events
+            self.route_updates_queue.push(delta)
+            self._bump("decision.route_updates_published")
+
+    def _apply_rib_policy(self, route_db: DecisionRouteDb) -> None:
+        if self.rib_policy is None or not self.rib_policy.is_active():
+            return
+        for entry in route_db.unicast_entries.values():
+            if self.rib_policy.apply_action(entry):
+                self._bump("decision.rib_policy_applied")
+
+    def set_rib_policy(self, policy: RibPolicy) -> None:
+        """OpenrCtrl setRibPolicy (Decision.cpp:1517-1550): apply now and
+        schedule re-application at expiry."""
+        self.rib_policy = policy
+        if self._rib_policy_timer is not None:
+            self._rib_policy_timer.cancel()
+        self._rib_policy_timer = self.loop().call_later(
+            max(0.0, policy.get_ttl_duration()), self._on_rib_policy_expiry
+        )
+        self._pending.needs_route_update = True
+        self.rebuild_routes()
+
+    def get_rib_policy(self) -> Optional[RibPolicy]:
+        return self.rib_policy
+
+    def _on_rib_policy_expiry(self) -> None:
+        # re-emit routes without the expired policy
+        self._pending.needs_route_update = True
+        self.rebuild_routes()
+
+    # ------------------------------------------------------------------
+    # read APIs (OpenrCtrl surface)
+    # ------------------------------------------------------------------
+
+    def get_decision_route_db(
+        self, node: Optional[str] = None
+    ) -> Optional[DecisionRouteDb]:
+        """Computed routes from this node's (or any node's) perspective
+        (Decision.cpp:1437-1448)."""
+        if node is None or node == self.config.my_node_name:
+            return self.route_db
+        solver = SpfSolver(
+            node,
+            enable_v4=self.config.enable_v4,
+            compute_lfa_paths=self.config.compute_lfa_paths,
+            enable_ordered_fib=self.config.enable_ordered_fib,
+            bgp_dry_run=self.config.bgp_dry_run,
+            bgp_use_igp_metric=self.config.bgp_use_igp_metric,
+        )
+        return solver.build_route_db(
+            node, self.area_link_states, self.prefix_state
+        )
+
+    def get_adjacency_databases(self) -> Dict[str, AdjacencyDatabase]:
+        out: Dict[str, AdjacencyDatabase] = {}
+        for link_state in self.area_link_states.values():
+            out.update(link_state.get_adjacency_databases())
+        return out
+
+    def get_prefix_databases(self) -> Dict[tuple, PrefixDatabase]:
+        return self.prefix_state.get_prefix_databases()
+
+    def decrement_ordered_fib_holds(self) -> None:
+        """Tick ordered-FIB holds on all areas (Decision.cpp hold timer)."""
+        changed = False
+        for link_state in self.area_link_states.values():
+            if link_state.decrement_holds().topology_changed:
+                changed = True
+        if changed:
+            self._pending.needs_route_update = True
+            self._pending.count += 1
+            self._schedule_rebuild()
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n

@@ -1,0 +1,101 @@
+"""Deterministic wire serialization for LSDB objects.
+
+The reference serializes thrift structs into KvStore value bytes; here
+dataclasses are encoded as canonical JSON (sorted keys, no whitespace).
+Determinism matters: the KvStore CRDT merge breaks same-version ties by
+comparing value BYTES (KvStore.cpp:316-334), so two encodings of the same
+logical object must be byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+from openr_tpu import types as T
+
+_TYPE_REGISTRY: Dict[str, Type] = {
+    cls.__name__: cls
+    for cls in (
+        T.Adjacency,
+        T.AdjacencyDatabase,
+        T.PrefixEntry,
+        T.PrefixDatabase,
+        T.PerfEvent,
+        T.PerfEvents,
+        T.MetricEntity,
+        T.MetricVector,
+        T.NextHop,
+        T.MplsAction,
+        T.UnicastRoute,
+        T.MplsRoute,
+    )
+}
+
+_ENUMS: Dict[str, Type] = {
+    cls.__name__: cls
+    for cls in (
+        T.PrefixType,
+        T.PrefixForwardingType,
+        T.PrefixForwardingAlgorithm,
+        T.CompareType,
+        T.MplsActionCode,
+    )
+}
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            "__t": type(obj).__name__,
+            **{
+                f.name: _encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            },
+        }
+    if type(obj).__name__ in _ENUMS:
+        return {"__t": type(obj).__name__, "v": obj.name}
+    if isinstance(obj, bytes):
+        return {"__t": "bytes", "v": obj.hex()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, list):
+        return [_decode(x) for x in obj]
+    if isinstance(obj, dict):
+        tname = obj.get("__t")
+        if tname is None:
+            return {k: _decode(v) for k, v in obj.items()}
+        if tname == "IpPrefix":
+            return T.IpPrefix(obj["prefix"])
+        if tname == "bytes":
+            return bytes.fromhex(obj["v"])
+        if tname in _ENUMS:
+            return _ENUMS[tname][obj["v"]]
+        cls = _TYPE_REGISTRY[tname]
+        fields = {
+            k: _decode(v) for k, v in obj.items() if k != "__t"
+        }
+        # tuples where the dataclass declares tuples
+        for f in dataclasses.fields(cls):
+            if f.name in fields and isinstance(fields[f.name], list):
+                if "Tuple" in str(f.type) or "tuple" in str(f.type):
+                    fields[f.name] = tuple(fields[f.name])
+        return cls(**fields)
+    return obj
+
+
+def dumps(obj: Any) -> bytes:
+    return json.dumps(
+        _encode(obj), sort_keys=True, separators=(",", ":")
+    ).encode()
+
+
+def loads(data: bytes) -> Any:
+    return _decode(json.loads(data.decode()))

@@ -1,0 +1,405 @@
+"""Decision module tests, mirroring DecisionTestFixture scenarios from
+openr/decision/tests/DecisionTest.cpp:4234+ (publication processing, debounce
+batching, route delta emission, expiry, cold start, RibPolicy)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.decision import Decision, DecisionConfig
+from openr_tpu.messaging import ReplicateQueue, RWQueue, RQueue
+from openr_tpu.solver.rib_policy import (
+    RibPolicy,
+    RibPolicyStatement,
+    SetWeightAction,
+)
+from openr_tpu.topology import build_adj_dbs
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=10.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def make_publication(adj_dbs=(), prefix_dbs=(), expired=(), area="0", version=1):
+    pub = Publication(area=area)
+    for db in adj_dbs:
+        pub.key_vals[adj_key(db.this_node_name)] = Value(
+            version, db.this_node_name, serializer.dumps(db)
+        )
+    for db in prefix_dbs:
+        pub.key_vals[prefix_key(db.this_node_name)] = Value(
+            version, db.this_node_name, serializer.dumps(db)
+        )
+    pub.expired_keys.extend(expired)
+    return pub
+
+
+def make_decision(backend="cpu", **cfg_kw):
+    kv_q = RWQueue()
+    route_q = ReplicateQueue()
+    decision = Decision(
+        DecisionConfig(
+            my_node_name="a",
+            solver_backend=backend,
+            debounce_min=0.005,
+            debounce_max=0.02,
+            **cfg_kw,
+        ),
+        RQueue(kv_q),
+        route_q,
+    )
+    return decision, kv_q, route_q
+
+
+PFX = "10.9.0.0/16"
+
+
+class TestDecision:
+    def test_publication_to_route_delta(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1), ("b", "c", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[
+                        PrefixDatabase("c", [PrefixEntry(IpPrefix(PFX))])
+                    ],
+                )
+            )
+            delta = await reader.get()
+            assert [e.prefix for e in delta.unicast_routes_to_update] == [
+                IpPrefix(PFX)
+            ]
+            nh = next(iter(delta.unicast_routes_to_update[0].nexthops))
+            assert nh.neighbor_node == "b"
+            assert delta.mpls_routes_to_update  # node label routes
+            decision.stop()
+
+        run(body())
+
+    def test_debounce_batches_publications(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1), ("b", "c", 1), ("c", "d", 1)])
+            # push each node's adjacency separately: one rebuild
+            for db in dbs.values():
+                kv_q.push(make_publication(adj_dbs=[db]))
+            kv_q.push(
+                make_publication(
+                    prefix_dbs=[PrefixDatabase("d", [PrefixEntry(IpPrefix(PFX))])]
+                )
+            )
+            delta = await reader.get()
+            assert decision.counters["decision.route_build_runs"] == 1
+            assert decision.counters["decision.adj_db_update"] == 4  # 4 nodes
+            decision.stop()
+
+        run(body())
+
+    def test_link_flap_reroutes(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+            dbs = build_adj_dbs(edges)
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("c", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            d1 = await reader.get()
+            nh1 = next(iter(d1.unicast_routes_to_update[0].nexthops))
+            assert nh1.neighbor_node == "b"
+            # b withdraws its link to c
+            b_down = AdjacencyDatabase(
+                "b",
+                [x for x in dbs["b"].adjacencies if x.other_node_name != "c"],
+                node_label=dbs["b"].node_label,
+            )
+            kv_q.push(make_publication(adj_dbs=[b_down], version=2))
+            d2 = await reader.get()
+            route = next(
+                e
+                for e in d2.unicast_routes_to_update
+                if e.prefix == IpPrefix(PFX)
+            )
+            assert {nh.neighbor_node for nh in route.nexthops} == {"c"}
+            decision.stop()
+
+        run(body())
+
+    def test_adj_expiry_removes_routes(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1), ("b", "c", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("c", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await reader.get()
+            # c's adjacency db expires from the store
+            kv_q.push(make_publication(expired=[adj_key("c")]))
+            d2 = await reader.get()
+            assert IpPrefix(PFX) in d2.unicast_routes_to_delete
+            decision.stop()
+
+        run(body())
+
+    def test_prefix_expiry(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await reader.get()
+            kv_q.push(make_publication(expired=[prefix_key("b")]))
+            d2 = await reader.get()
+            assert d2.unicast_routes_to_delete == [IpPrefix(PFX)]
+            decision.stop()
+
+        run(body())
+
+    def test_cold_start_holds_computation(self):
+        async def body():
+            decision, kv_q, route_q = make_decision(eor_time_s=0.15)
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await asyncio.sleep(0.05)
+            assert not decision.have_computed_routes  # still held
+            delta = await reader.get()  # emitted after eor expires
+            assert decision.have_computed_routes
+            assert delta.unicast_routes_to_update
+            decision.stop()
+
+        run(body())
+
+    def test_rib_policy_weights(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await reader.get()
+            policy = RibPolicy(
+                [
+                    RibPolicyStatement(
+                        "s1",
+                        {IpPrefix(PFX)},
+                        SetWeightAction(
+                            default_weight=1, area_to_weight={"0": 7}
+                        ),
+                    )
+                ],
+                ttl_secs=60,
+            )
+            decision.set_rib_policy(policy)
+            delta = await reader.get()
+            entry = delta.unicast_routes_to_update[0]
+            assert {nh.weight for nh in entry.nexthops} == {7}
+            decision.stop()
+
+        run(body())
+
+    def test_rib_policy_zero_weight_drops_nexthop(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await reader.get()
+            decision.set_rib_policy(
+                RibPolicy(
+                    [
+                        RibPolicyStatement(
+                            "s1",
+                            {IpPrefix(PFX)},
+                            SetWeightAction(default_weight=0),
+                        )
+                    ],
+                    ttl_secs=60,
+                )
+            )
+            delta = await reader.get()
+            assert delta.unicast_routes_to_update[0].nexthops == set()
+            decision.stop()
+
+        run(body())
+
+    def test_get_decision_route_db_other_node(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1), ("b", "c", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("a", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            await reader.get()
+            # from c's perspective, route to a's prefix via b
+            c_db = decision.get_decision_route_db("c")
+            nh = next(iter(c_db.unicast_entries[IpPrefix(PFX)].nexthops))
+            assert nh.neighbor_node == "b"
+            decision.stop()
+
+        run(body())
+
+    def test_tpu_backend_end_to_end(self):
+        async def body():
+            decision, kv_q, route_q = make_decision(backend="tpu")
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs(
+                [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+            )
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("d", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            delta = await reader.get()
+            route = delta.unicast_routes_to_update[0]
+            assert {nh.neighbor_node for nh in route.nexthops} == {"b", "c"}
+            assert decision.solver.device_solves >= 1
+            decision.stop()
+
+        run(body())
+
+    def test_per_prefix_keys_accumulate(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            p1, p2 = IpPrefix("10.1.0.0/16"), IpPrefix("10.2.0.0/16")
+            pub = make_publication(adj_dbs=dbs.values())
+            # two per-prefix keys from the same node must accumulate
+            for p in (p1, p2):
+                pub.key_vals[prefix_key("b", p, "0")] = Value(
+                    1, "b", serializer.dumps(
+                        PrefixDatabase("b", [PrefixEntry(p)])
+                    )
+                )
+            kv_q.push(pub)
+            delta = await reader.get()
+            assert {e.prefix for e in delta.unicast_routes_to_update} == {
+                p1, p2
+            }
+            # expiry of ONE per-prefix key withdraws only that prefix
+            kv_q.push(
+                make_publication(expired=[prefix_key("b", p1, "0")])
+            )
+            d2 = await reader.get()
+            assert d2.unicast_routes_to_delete == [p1]
+            assert decision.get_decision_route_db().unicast_entries.keys() == {
+                p2
+            }
+            decision.stop()
+
+        run(body())
+
+    def test_node_label_only_change_rebuilds(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(make_publication(adj_dbs=dbs.values()))
+            d1 = await reader.get()
+            assert {e.label for e in d1.mpls_routes_to_update} == {100, 101}
+            # b changes only its node label
+            b2 = AdjacencyDatabase(
+                "b", dbs["b"].adjacencies, node_label=555
+            )
+            kv_q.push(make_publication(adj_dbs=[b2], version=2))
+            d2 = await reader.get()
+            assert {e.label for e in d2.mpls_routes_to_update} == {555}
+            assert d2.mpls_routes_to_delete == [101]
+            decision.stop()
+
+        run(body())
+
+    def test_malformed_value_does_not_kill_consumer(self):
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+            bad = Publication(area="0")
+            bad.key_vals[adj_key("evil")] = Value(1, "evil", b"not-json")
+            kv_q.push(bad)
+            await asyncio.sleep(0.05)
+            assert decision.counters.get("decision.errors") == 1
+            # consumer still alive: a good publication still computes routes
+            dbs = build_adj_dbs([("a", "b", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX))])],
+                )
+            )
+            delta = await reader.get()
+            assert delta.unicast_routes_to_update
+            decision.stop()
+
+        run(body())
+
+    def test_serializer_roundtrip_deterministic(self):
+        dbs = build_adj_dbs([("a", "b", 1)])
+        blob1 = serializer.dumps(dbs["a"])
+        blob2 = serializer.dumps(serializer.loads(blob1))
+        assert blob1 == blob2
+        pdb = PrefixDatabase("a", [PrefixEntry(IpPrefix(PFX))])
+        assert serializer.loads(serializer.dumps(pdb)) == pdb
