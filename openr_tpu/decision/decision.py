@@ -44,6 +44,7 @@ from openr_tpu.types import (
     parse_prefix_key,
 )
 from openr_tpu.utils import AsyncDebounce
+from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.utils import serializer
 
 
@@ -91,7 +92,7 @@ class _PendingUpdates:
         self.needs_route_update = False
 
 
-class Decision:
+class Decision(CountersMixin):
     def __init__(
         self,
         config: DecisionConfig,
@@ -119,10 +120,10 @@ class Decision:
             area: LinkState(area) for area in config.areas
         }
         self.prefix_state = PrefixState()
-        # per-prefix-key aggregation (Decision.cpp:1584-1629): entries from
-        # per-prefix keys override entries from full-db keys per node
-        self._per_prefix_entries: Dict[str, Dict] = {}
-        self._full_db_entries: Dict[str, Dict] = {}
+        # per-prefix-key aggregation (Decision.cpp:1584-1629), keyed by
+        # (node, area): per-prefix entries override full-db entries
+        self._per_prefix_entries: Dict[tuple, Dict] = {}
+        self._full_db_entries: Dict[tuple, Dict] = {}
         self.route_db = DecisionRouteDb()
         self.rib_policy: Optional[RibPolicy] = None
         self._pending = _PendingUpdates()
@@ -184,17 +185,7 @@ class Decision:
                 pub = await self.kvstore_updates.get()
             except (QueueClosedError, asyncio.CancelledError):
                 return
-            try:
-                self.process_publication(pub)
-            except Exception:
-                # a malformed value must not kill the consumer
-                # (Decision.cpp:1726-1729 catches per-key deserialize errors)
-                import logging
-
-                logging.getLogger(__name__).exception(
-                    "failed to process publication"
-                )
-                self._bump("decision.errors")
+            self.process_publication(pub)
 
     async def _consume_static(self) -> None:
         try:
@@ -226,46 +217,17 @@ class Decision:
         for key, value in publication.key_vals.items():
             if value.value is None:
                 continue  # ttl refresh only
-            if key.startswith(ADJ_DB_MARKER):
-                adj_db = serializer.loads(value.value)
-                assert isinstance(adj_db, AdjacencyDatabase)
-                adj_db.area = area
-                hold_up = hold_down = 0
-                if self.config.enable_ordered_fib:
-                    # hold TTLs from hop distance (Decision.cpp:1669-1679)
-                    maybe_hops = link_state.get_hops_from_a_to_b(
-                        self.config.my_node_name, adj_db.this_node_name
-                    )
-                    if maybe_hops is not None:
-                        hold_up = maybe_hops
-                        hold_down = (
-                            link_state.get_max_hops_to_node(
-                                adj_db.this_node_name
-                            )
-                            - hold_up
-                        )
-                change = link_state.update_adjacency_database(
-                    adj_db, hold_up, hold_down
+            try:
+                changed |= self._process_key(key, value, area, link_state)
+            except Exception:
+                # a malformed value must not poison the rest of the batch
+                # (Decision.cpp:1726-1729 catches per-key)
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "failed to process key %s", key
                 )
-                self._bump("decision.adj_db_update")
-                if (
-                    change.topology_changed
-                    or change.link_attributes_changed
-                    or change.node_label_changed
-                ):
-                    changed = True
-                    self._pending.apply(adj_db.perf_events)
-            elif key.startswith(PREFIX_DB_MARKER):
-                prefix_db = serializer.loads(value.value)
-                assert isinstance(prefix_db, PrefixDatabase)
-                node_db = self._update_node_prefix_database(key, prefix_db)
-                if node_db is None:
-                    continue
-                node_db.area = area
-                self._bump("decision.prefix_db_update")
-                if self.prefix_state.update_prefix_database(node_db):
-                    changed = True
-                    self._pending.apply(prefix_db.perf_events)
+                self._bump("decision.errors")
 
         for key in publication.expired_keys:
             if key.startswith(ADJ_DB_MARKER):
@@ -278,7 +240,9 @@ class Decision:
                 delete_db = PrefixDatabase(
                     this_node_name=node, delete_prefix=True
                 )
-                node_db = self._update_node_prefix_database(key, delete_db)
+                node_db = self._update_node_prefix_database(
+                    key, delete_db, area
+                )
                 if node_db is None:
                     continue
                 node_db.area = area
@@ -289,16 +253,63 @@ class Decision:
         if changed:
             self._schedule_rebuild()
 
+    def _process_key(
+        self, key: str, value, area: str, link_state: LinkState
+    ) -> bool:
+        """Apply one LSDB key; returns True if state changed."""
+        changed = False
+        if key.startswith(ADJ_DB_MARKER):
+            adj_db = serializer.loads(value.value)
+            assert isinstance(adj_db, AdjacencyDatabase)
+            adj_db.area = area
+            hold_up = hold_down = 0
+            if self.config.enable_ordered_fib:
+                # hold TTLs from hop distance (Decision.cpp:1669-1679)
+                maybe_hops = link_state.get_hops_from_a_to_b(
+                    self.config.my_node_name, adj_db.this_node_name
+                )
+                if maybe_hops is not None:
+                    hold_up = maybe_hops
+                    hold_down = (
+                        link_state.get_max_hops_to_node(adj_db.this_node_name)
+                        - hold_up
+                    )
+            change = link_state.update_adjacency_database(
+                adj_db, hold_up, hold_down
+            )
+            self._bump("decision.adj_db_update")
+            if (
+                change.topology_changed
+                or change.link_attributes_changed
+                or change.node_label_changed
+            ):
+                changed = True
+                self._pending.apply(adj_db.perf_events)
+        elif key.startswith(PREFIX_DB_MARKER):
+            prefix_db = serializer.loads(value.value)
+            assert isinstance(prefix_db, PrefixDatabase)
+            node_db = self._update_node_prefix_database(key, prefix_db, area)
+            if node_db is None:
+                return False
+            node_db.area = area
+            self._bump("decision.prefix_db_update")
+            if self.prefix_state.update_prefix_database(node_db):
+                changed = True
+                self._pending.apply(prefix_db.perf_events)
+        return changed
+
     def _update_node_prefix_database(
-        self, key: str, prefix_db: PrefixDatabase
+        self, key: str, prefix_db: PrefixDatabase, pub_area: str
     ) -> Optional[PrefixDatabase]:
         """Merge a per-prefix or full-db key into the node's aggregated
         PrefixDatabase (Decision.cpp:1584-1629). Per-prefix entries override
-        full-db entries; returns the synthesized node database."""
+        full-db entries; aggregation is per (node, area) so one node's
+        advertisements in different areas never bleed into each other."""
         node = prefix_db.this_node_name
-        _, _, key_prefix = parse_prefix_key(key)
-        per_prefix = self._per_prefix_entries.setdefault(node, {})
-        full_db = self._full_db_entries.setdefault(node, {})
+        _, key_area, key_prefix = parse_prefix_key(key)
+        agg_key = (node, key_area if key_area is not None else pub_area)
+        per_prefix = self._per_prefix_entries.setdefault(agg_key, {})
+        full_db = self._full_db_entries.setdefault(agg_key, {})
         if key_prefix is not None:
             # per-prefix key
             if prefix_db.delete_prefix:
@@ -435,5 +446,3 @@ class Decision:
             self._pending.count += 1
             self._schedule_rebuild()
 
-    def _bump(self, counter: str, n: int = 1) -> None:
-        self.counters[counter] = self.counters.get(counter, 0) + n

@@ -1,0 +1,189 @@
+"""In-process KvStore client with persist semantics.
+
+Equivalent of openr/kvstore/KvStoreClientInternal.{h,cpp}: persist_key keeps a
+key advertised under our originator id — if a peer overwrites it (higher
+version from another originator) the client re-advertises with a bumped
+version (checkPersistKeyInStore / keyValUpdated semantics); TTL-carrying keys
+are refreshed at ttl/4 cadence with ttlVersion bumps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Tuple
+
+from openr_tpu.kvstore.store import KvStore
+from openr_tpu.messaging import QueueClosedError
+from openr_tpu.types import TTL_INFINITY, Publication, Value
+
+
+class KvStoreClient:
+    def __init__(
+        self,
+        kvstore: KvStore,
+        node_id: Optional[str] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.kvstore = kvstore
+        self.node_id = node_id or kvstore.node_id
+        self._loop = loop
+        # (area, key) -> desired value bytes + ttl
+        self._persisted: Dict[Tuple[str, str], Tuple[bytes, int]] = {}
+        self._key_callbacks: Dict[
+            Tuple[str, str], List[Callable[[str, Optional[Value]], None]]
+        ] = {}
+        self._ttl_timers: Dict[Tuple[str, str], asyncio.TimerHandle] = {}
+        self._reader = kvstore.updates_queue.get_reader()
+        self._task = self.loop().create_task(self._watch())
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+
+    def set_key(
+        self,
+        key: str,
+        value: bytes,
+        area: str = "0",
+        ttl: int = TTL_INFINITY,
+    ) -> None:
+        """Advertise with a version higher than whatever is in the store."""
+        existing = self.kvstore.get_key(key, area=area)
+        version = (existing.version + 1) if existing is not None else 1
+        self.kvstore.set_key(
+            key,
+            Value(
+                version=version,
+                originator_id=self.node_id,
+                value=value,
+                ttl=ttl,
+            ),
+            area=area,
+        )
+
+    def persist_key(
+        self,
+        key: str,
+        value: bytes,
+        area: str = "0",
+        ttl: int = TTL_INFINITY,
+    ) -> None:
+        """Advertise and keep advertised: re-advertise if overwritten."""
+        self._persisted[(area, key)] = (value, ttl)
+        existing = self.kvstore.get_key(key, area=area)
+        if (
+            existing is not None
+            and existing.originator_id == self.node_id
+            and existing.value == value
+        ):
+            self._schedule_ttl_refresh(area, key, existing, ttl)
+            return  # already ours and current
+        self.set_key(key, value, area=area, ttl=ttl)
+        stored = self.kvstore.get_key(key, area=area)
+        if stored is not None:
+            self._schedule_ttl_refresh(area, key, stored, ttl)
+
+    def unset_key(self, key: str, area: str = "0") -> None:
+        """Stop persisting; the key ages out by TTL (or stays for others)."""
+        self._persisted.pop((area, key), None)
+        timer = self._ttl_timers.pop((area, key), None)
+        if timer is not None:
+            timer.cancel()
+
+    def clear_key(
+        self, key: str, value: bytes = b"", area: str = "0", ttl: int = 1000
+    ) -> None:
+        """Actively supersede the key with a short-ttl tombstone value."""
+        self.unset_key(key, area=area)
+        self.set_key(key, value, area=area, ttl=ttl)
+
+    def get_key(self, key: str, area: str = "0") -> Optional[Value]:
+        return self.kvstore.get_key(key, area=area)
+
+    def subscribe_key(
+        self,
+        key: str,
+        callback: Callable[[str, Optional[Value]], None],
+        area: str = "0",
+    ) -> None:
+        self._key_callbacks.setdefault((area, key), []).append(callback)
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for timer in self._ttl_timers.values():
+            timer.cancel()
+        self._ttl_timers.clear()
+
+    # ------------------------------------------------------------------
+
+    def _schedule_ttl_refresh(
+        self, area: str, key: str, stored: Value, ttl: int
+    ) -> None:
+        if ttl == TTL_INFINITY:
+            return
+        old = self._ttl_timers.pop((area, key), None)
+        if old is not None:
+            old.cancel()
+        self._ttl_timers[(area, key)] = self.loop().call_later(
+            ttl / 1000.0 / 4,  # refresh at ttl/4 (Constants.h kTtlRefresh)
+            self._refresh_ttl,
+            area,
+            key,
+        )
+
+    def _refresh_ttl(self, area: str, key: str) -> None:
+        self._ttl_timers.pop((area, key), None)
+        desired = self._persisted.get((area, key))
+        if desired is None:
+            return
+        value_bytes, ttl = desired
+        existing = self.kvstore.get_key(key, area=area)
+        if existing is None or existing.originator_id != self.node_id:
+            return  # _watch will re-advertise
+        refresh = Value(
+            version=existing.version,
+            originator_id=self.node_id,
+            value=None,
+            ttl=ttl,
+            ttl_version=existing.ttl_version + 1,
+        )
+        self.kvstore.db(area).set_key_vals({key: refresh})
+        updated = self.kvstore.get_key(key, area=area)
+        if updated is not None:
+            self._schedule_ttl_refresh(area, key, updated, ttl)
+
+    async def _watch(self) -> None:
+        """Re-advertise persisted keys when peers overwrite them and fire
+        key subscriptions."""
+        try:
+            while True:
+                pub: Publication = await self._reader.get()
+                for key, value in pub.key_vals.items():
+                    for cb in self._key_callbacks.get((pub.area, key), []):
+                        cb(key, value)
+                    desired = self._persisted.get((pub.area, key))
+                    if desired is None:
+                        continue
+                    if value.value is None:
+                        continue  # ttl refresh, not a clobber
+                    value_bytes, ttl = desired
+                    if (
+                        value.originator_id != self.node_id
+                        or value.value != value_bytes
+                    ):
+                        # someone clobbered our key: take it back
+                        self.set_key(
+                            key, value_bytes, area=pub.area, ttl=ttl
+                        )
+                for key in pub.expired_keys:
+                    for cb in self._key_callbacks.get((pub.area, key), []):
+                        cb(key, None)
+                    desired = self._persisted.get((pub.area, key))
+                    if desired is not None:
+                        value_bytes, ttl = desired
+                        self.set_key(key, value_bytes, area=pub.area, ttl=ttl)
+        except (QueueClosedError, asyncio.CancelledError):
+            pass

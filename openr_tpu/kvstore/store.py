@@ -37,6 +37,7 @@ from openr_tpu.types import (
     generate_hash,
 )
 from openr_tpu.utils import AsyncThrottle, ExponentialBackoff
+from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.kvstore.transport import KvStoreTransport
 
 
@@ -257,7 +258,7 @@ class KvStoreParams:
     filters: Optional[KvStoreFilters] = None
 
 
-class KvStoreDb:
+class KvStoreDb(CountersMixin):
     def __init__(
         self,
         area: str,
@@ -665,8 +666,6 @@ class KvStoreDb:
         self._sync_tasks.add(task)
         task.add_done_callback(self._sync_tasks.discard)
 
-    def _bump(self, counter: str, n: int = 1) -> None:
-        self.counters[counter] = self.counters.get(counter, 0) + n
 
     def stop(self) -> None:
         if self._ttl_timer is not None:

@@ -1,0 +1,12 @@
+"""LinkMonitor: links, adjacencies, peering, drain state.
+
+Equivalent of openr/link-monitor/LinkMonitor.{h,cpp}.
+"""
+
+from openr_tpu.linkmonitor.link_monitor import (
+    InterfaceEntry,
+    LinkMonitor,
+    LinkMonitorConfig,
+)
+
+__all__ = ["InterfaceEntry", "LinkMonitor", "LinkMonitorConfig"]

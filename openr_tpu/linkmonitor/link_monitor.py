@@ -1,0 +1,384 @@
+"""LinkMonitor: interface tracking with flap dampening, Spark-event →
+adjacency translation, KvStore advertisement and peering.
+
+Behavioral port of openr/link-monitor/LinkMonitor.{h,cpp}:
+  - InterfaceEntry with exponential-backoff link-flap dampening
+    (link-monitor/InterfaceEntry.h); only stably-up interfaces are handed
+    to Spark (advertiseInterfaces LinkMonitor.cpp:726).
+  - neighborUpEvent/neighborDownEvent (LinkMonitor.cpp:373,453): neighbor
+    events become Adjacency entries; adjacency database advertised under
+    'adj:<node>' via the KvStore client's persist semantics
+    (advertiseAdjacencies LinkMonitor.cpp:625-700).
+  - KvStore peering follows established neighbors (advertiseKvStorePeers
+    LinkMonitor.cpp:542-623).
+  - drain/overload controls: node overload, per-link overload (soft
+    drain), per-link metric override — all re-advertised immediately and
+    persisted in the config store when provided.
+  - RTT-vs-hop metric choice (enable_rtt_metric).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.store import KvStore, PeerSpec
+from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.spark.spark import NeighborEvent, NeighborEventType
+from openr_tpu.types import Adjacency, AdjacencyDatabase, adj_key
+from openr_tpu.utils import ExponentialBackoff, AsyncThrottle
+from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils import serializer
+
+# config-store keys (LinkMonitor.h kConfigKey equivalent)
+CONFIG_KEY = "link-monitor-config"
+
+
+@dataclass
+class LinkMonitorConfig:
+    node_name: str
+    node_label: int = 0
+    enable_rtt_metric: bool = False
+    flap_initial_backoff: float = 0.06  # 60ms
+    flap_max_backoff: float = 1.0
+    adv_throttle: float = 0.005  # advertisement coalescing window
+    areas: List[str] = field(default_factory=lambda: ["0"])
+
+
+class InterfaceEntry:
+    """Interface with flap-dampening backoff (InterfaceEntry.h)."""
+
+    def __init__(self, if_name: str, backoff: ExponentialBackoff) -> None:
+        self.if_name = if_name
+        self.is_up = False
+        self.backoff = backoff
+        self.addresses: List[str] = []
+
+    def update(self, is_up: bool) -> bool:
+        """Returns True if state changed."""
+        changed = self.is_up != is_up
+        if changed:
+            self.is_up = is_up
+            # every transition is an error event for dampening purposes
+            self.backoff.report_error()
+        return changed
+
+    def is_active(self) -> bool:
+        """Up and out of the dampening window."""
+        return self.is_up and self.backoff.can_try_now()
+
+
+@dataclass
+class _AdjacencyEntry:
+    adjacency: Adjacency
+    area: str
+    is_restarting: bool = False
+
+
+class LinkMonitor(CountersMixin):
+    def __init__(
+        self,
+        config: LinkMonitorConfig,
+        neighbor_events: RQueue,
+        kvstore: KvStore,
+        spark,  # Spark instance (update_interfaces target)
+        config_store=None,  # optional PersistentStore-like (dict interface)
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.neighbor_events = neighbor_events
+        self.kvstore = kvstore
+        self.kvstore_client = KvStoreClient(kvstore, config.node_name, loop)
+        self.spark = spark
+        self.config_store = config_store
+        self._loop = loop
+
+        self.interfaces: Dict[str, InterfaceEntry] = {}
+        # (node, local iface) -> adjacency entry
+        self.adjacencies: Dict[Tuple[str, str], _AdjacencyEntry] = {}
+        self.node_overloaded = False
+        self.overloaded_links: Set[str] = set()
+        self.link_metric_overrides: Dict[str, int] = {}
+
+        self._load_state()
+        self._adv_throttle = AsyncThrottle(
+            config.adv_throttle, self._advertise, loop=loop
+        )
+        self._iface_timer: Optional[asyncio.TimerHandle] = None
+        self._task: Optional[asyncio.Task] = None
+        self.counters: Dict[str, int] = {}
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    def start(self) -> None:
+        self._task = self.loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._adv_throttle.cancel()
+        if self._iface_timer is not None:
+            self._iface_timer.cancel()
+            self._iface_timer = None
+        self.kvstore_client.stop()
+
+    # ------------------------------------------------------------------
+    # durable drain state (PersistentStore seam)
+    # ------------------------------------------------------------------
+
+    def _load_state(self) -> None:
+        if self.config_store is None:
+            return
+        blob = self.config_store.load(CONFIG_KEY)
+        if blob is None:
+            return
+        state = serializer.loads(blob)
+        self.node_overloaded = state.get("node_overloaded", False)
+        self.overloaded_links = set(state.get("overloaded_links", []))
+        self.link_metric_overrides = dict(
+            state.get("link_metric_overrides", {})
+        )
+
+    def _save_state(self) -> None:
+        if self.config_store is None:
+            return
+        self.config_store.store(
+            CONFIG_KEY,
+            serializer.dumps(
+                {
+                    "node_overloaded": self.node_overloaded,
+                    "overloaded_links": sorted(self.overloaded_links),
+                    "link_metric_overrides": dict(
+                        self.link_metric_overrides
+                    ),
+                }
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # interface events (netlink seam)
+    # ------------------------------------------------------------------
+
+    def update_interface(self, if_name: str, is_up: bool) -> None:
+        """Apply a link event from the platform (netlink) layer."""
+        entry = self.interfaces.get(if_name)
+        if entry is None:
+            entry = InterfaceEntry(
+                if_name,
+                ExponentialBackoff(
+                    self.config.flap_initial_backoff,
+                    self.config.flap_max_backoff,
+                ),
+            )
+            # a fresh interface starts clean: no dampening on first up
+            self.interfaces[if_name] = entry
+            entry.is_up = is_up
+            self._advertise_interfaces_when_stable()
+            return
+        if entry.update(is_up):
+            self._bump("link_monitor.link_flap")
+            self._advertise_interfaces_when_stable()
+
+    def _advertise_interfaces_when_stable(self) -> None:
+        """Push the active interface set to Spark, re-checking when
+        dampening windows expire (single re-evaluation timer, no pile-up)."""
+        if self._iface_timer is not None:
+            self._iface_timer.cancel()
+            self._iface_timer = None
+        active = [e.if_name for e in self.interfaces.values() if e.is_active()]
+        self.spark.update_interfaces(active)
+        # schedule re-evaluation at the earliest backoff expiry
+        pending = [
+            e.backoff.get_time_remaining_until_retry()
+            for e in self.interfaces.values()
+            if e.is_up and not e.backoff.can_try_now()
+        ]
+        if pending:
+            self._iface_timer = self.loop().call_later(
+                min(pending) + 0.001, self._advertise_interfaces_when_stable
+            )
+
+    # ------------------------------------------------------------------
+    # neighbor events
+    # ------------------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                event = await self.neighbor_events.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            try:
+                self._process_neighbor_event(event)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "failed to process neighbor event"
+                )
+                self._bump("link_monitor.errors")
+
+    def _process_neighbor_event(self, event: NeighborEvent) -> None:
+        if event.event_type == NeighborEventType.NEIGHBOR_UP:
+            self._neighbor_up(event)
+        elif event.event_type == NeighborEventType.NEIGHBOR_RESTARTED:
+            self._neighbor_up(event)
+        elif event.event_type == NeighborEventType.NEIGHBOR_DOWN:
+            self._neighbor_down(event)
+        elif event.event_type == NeighborEventType.NEIGHBOR_RESTARTING:
+            entry = self.adjacencies.get(
+                (event.node_name, event.local_if_name)
+            )
+            if entry is not None:
+                entry.is_restarting = True
+            # keep adjacency + peering during graceful restart
+        elif event.event_type == NeighborEventType.NEIGHBOR_RTT_CHANGE:
+            if self.config.enable_rtt_metric:
+                entry = self.adjacencies.get(
+                    (event.node_name, event.local_if_name)
+                )
+                if entry is not None:
+                    entry.adjacency = self._make_adjacency(event)
+                    self._adv_throttle()
+
+    def _metric_for(self, event: NeighborEvent) -> int:
+        if self.config.enable_rtt_metric and event.rtt_us > 0:
+            # rtt-based metric: microseconds / 100 (getRttMetric)
+            return max(1, event.rtt_us // 100)
+        metric = 1
+        override = self.link_metric_overrides.get(event.local_if_name)
+        if override is not None:
+            metric = override
+        return metric
+
+    def _make_adjacency(self, event: NeighborEvent) -> Adjacency:
+        return Adjacency(
+            other_node_name=event.node_name,
+            if_name=event.local_if_name,
+            other_if_name=event.remote_if_name,
+            metric=self._metric_for(event),
+            adj_label=event.label,
+            is_overloaded=event.local_if_name in self.overloaded_links,
+            rtt=event.rtt_us,
+            nexthop_v4=event.transport_address_v4,
+            nexthop_v6=event.transport_address_v6,
+        )
+
+    def _neighbor_up(self, event: NeighborEvent) -> None:
+        self._bump("link_monitor.neighbor_up")
+        area = event.area or "0"
+        self.adjacencies[(event.node_name, event.local_if_name)] = (
+            _AdjacencyEntry(self._make_adjacency(event), area)
+        )
+        self._advertise_kvstore_peers()
+        self._adv_throttle()
+
+    def _neighbor_down(self, event: NeighborEvent) -> None:
+        self._bump("link_monitor.neighbor_down")
+        self.adjacencies.pop((event.node_name, event.local_if_name), None)
+        self._advertise_kvstore_peers()
+        self._adv_throttle()
+
+    # ------------------------------------------------------------------
+    # advertisement
+    # ------------------------------------------------------------------
+
+    def _advertise_kvstore_peers(self) -> None:
+        """Sync KvStore peering with the adjacency set
+        (advertiseKvStorePeers LinkMonitor.cpp:542-623)."""
+        for area in self.config.areas:
+            desired: Dict[str, PeerSpec] = {}
+            for (node, _), entry in self.adjacencies.items():
+                if entry.area != area:
+                    continue
+                desired[node] = PeerSpec(peer_addr=node)
+            current = self.kvstore.dbs[area].get_peers()
+            to_del = [n for n in current if n not in desired]
+            to_add = {
+                n: spec for n, spec in desired.items() if current.get(n) != spec
+            }
+            if to_del:
+                self.kvstore.del_peers(to_del, area=area)
+            if to_add:
+                self.kvstore.add_peers(to_add, area=area)
+
+    def _advertise(self) -> None:
+        """Build + persist 'adj:<node>' per area (advertiseAdjacencies)."""
+        for area in self.config.areas:
+            adjacencies = [
+                entry.adjacency
+                for (node, _), entry in sorted(self.adjacencies.items())
+                if entry.area == area
+            ]
+            adj_db = AdjacencyDatabase(
+                this_node_name=self.config.node_name,
+                adjacencies=adjacencies,
+                is_overloaded=self.node_overloaded,
+                node_label=self.config.node_label,
+                area=area,
+            )
+            self.kvstore_client.persist_key(
+                adj_key(self.config.node_name),
+                serializer.dumps(adj_db),
+                area=area,
+            )
+            self._bump("link_monitor.advertise_adj_db")
+
+    # ------------------------------------------------------------------
+    # drain / overload controls (OpenrCtrl surface)
+    # ------------------------------------------------------------------
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        if self.node_overloaded != overloaded:
+            self.node_overloaded = overloaded
+            self._save_state()
+            self._adv_throttle()
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        changed = (
+            if_name not in self.overloaded_links
+            if overloaded
+            else if_name in self.overloaded_links
+        )
+        if overloaded:
+            self.overloaded_links.add(if_name)
+        else:
+            self.overloaded_links.discard(if_name)
+        if changed:
+            self._save_state()
+            self._rebuild_adjacencies()
+            self._adv_throttle()
+
+    def set_link_metric(self, if_name: str, metric: Optional[int]) -> None:
+        if metric is None:
+            self.link_metric_overrides.pop(if_name, None)
+        else:
+            self.link_metric_overrides[if_name] = metric
+        self._save_state()
+        self._rebuild_adjacencies()
+        self._adv_throttle()
+
+    def _rebuild_adjacencies(self) -> None:
+        from openr_tpu.types import replace
+
+        for key, entry in self.adjacencies.items():
+            adj = entry.adjacency
+            metric = adj.metric
+            if not self.config.enable_rtt_metric:
+                metric = self.link_metric_overrides.get(adj.if_name, 1)
+            entry.adjacency = replace(
+                adj,
+                metric=metric,
+                is_overloaded=adj.if_name in self.overloaded_links,
+            )
+
+    def get_interfaces(self) -> Dict[str, InterfaceEntry]:
+        return self.interfaces
+
+    def get_adjacencies(self) -> Dict[Tuple[str, str], Adjacency]:
+        return {k: e.adjacency for k, e in self.adjacencies.items()}
+

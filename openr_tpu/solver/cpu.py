@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.lsdb.link_state import Link, LinkState, path_a_in_path_b
+from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.lsdb.prefix_state import PrefixState
 from openr_tpu.solver.metric_vector import (
     CompareResult,
@@ -82,7 +83,7 @@ def get_prefix_forwarding_algorithm(
     return PrefixForwardingAlgorithm.KSP2_ED_ECMP
 
 
-class SpfSolver:
+class SpfSolver(CountersMixin):
     """Route computation from one node's perspective (Decision.cpp:90)."""
 
     def __init__(
@@ -904,5 +905,3 @@ class SpfSolver:
 
     # ------------------------------------------------------------------
 
-    def _bump(self, counter: str) -> None:
-        self.counters[counter] = self.counters.get(counter, 0) + 1

@@ -1,0 +1,40 @@
+"""Spark: neighbor discovery over UDP multicast hello/handshake/heartbeat.
+
+Equivalent of openr/spark/: 3-message protocol, 5-state neighbor FSM with
+graceful restart, RTT measurement from reflected timestamps smoothed by a
+StepDetector, fast-init discovery, area negotiation. Socket operations go
+through the IoProvider seam; MockIoProvider wires N instances in one process
+with per-link latency (openr/tests/mocks/MockIoProvider.h).
+"""
+
+from openr_tpu.spark.messages import (
+    SparkHandshakeMsg,
+    SparkHelloMsg,
+    SparkHeartbeatMsg,
+    ReflectedNeighborInfo,
+)
+from openr_tpu.spark.io_provider import IoProvider, MockIoNetwork, MockIoProvider
+from openr_tpu.spark.spark import (
+    NeighborEvent,
+    NeighborEventType,
+    Spark,
+    SparkConfig,
+    SparkNeighEvent,
+    SparkNeighState,
+)
+
+__all__ = [
+    "SparkHandshakeMsg",
+    "SparkHelloMsg",
+    "SparkHeartbeatMsg",
+    "ReflectedNeighborInfo",
+    "IoProvider",
+    "MockIoNetwork",
+    "MockIoProvider",
+    "NeighborEvent",
+    "NeighborEventType",
+    "Spark",
+    "SparkConfig",
+    "SparkNeighEvent",
+    "SparkNeighState",
+]

@@ -1,0 +1,110 @@
+"""IoProvider: the socket seam for Spark.
+
+The reference routes all UDP multicast syscalls through IoProvider
+(openr/spark/IoProvider.h) so tests can substitute MockIoProvider
+(openr/tests/mocks/MockIoProvider.h:25-60): N Spark instances in one process
+glued by in-memory mailboxes with configurable per-link latency. The same
+seam here; the real UDP provider wraps asyncio datagram transports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.spark.messages import SparkHelloPacket
+
+
+@dataclass
+class ReceivedPacket:
+    if_name: str  # interface it arrived on
+    packet: SparkHelloPacket
+    recv_ts_us: int
+
+
+class IoProvider:
+    """Send/receive seam. Timestamps are microseconds (kernel-timestamp
+    equivalents, used for RTT measurement)."""
+
+    def set_receiver(self, instance_id: str, callback) -> None:
+        raise NotImplementedError
+
+    def send(self, if_name: str, packet: SparkHelloPacket) -> int:
+        """Send on interface; returns the send timestamp in us."""
+        raise NotImplementedError
+
+    def now_us(self) -> int:
+        return int(time.monotonic() * 1_000_000)
+
+
+class MockIoNetwork:
+    """Shared virtual network: connects (instance, iface) endpoints in
+    pairs with per-link latency (ConnectedIfPairs)."""
+
+    def __init__(self) -> None:
+        # (instance, iface) -> list of ((instance, iface), latency_s)
+        self._links: Dict[Tuple[str, str], List[Tuple[Tuple[str, str], float]]] = {}
+        self._receivers: Dict[str, object] = {}
+        self._partitioned: set = set()
+
+    def connect(
+        self,
+        a: Tuple[str, str],
+        b: Tuple[str, str],
+        latency_ms: float = 1.0,
+    ) -> None:
+        self._links.setdefault(a, []).append((b, latency_ms / 1000.0))
+        self._links.setdefault(b, []).append((a, latency_ms / 1000.0))
+
+    def disconnect(self, a: Tuple[str, str], b: Tuple[str, str]) -> None:
+        self._partitioned.add((a, b))
+        self._partitioned.add((b, a))
+
+    def reconnect(self, a: Tuple[str, str], b: Tuple[str, str]) -> None:
+        self._partitioned.discard((a, b))
+        self._partitioned.discard((b, a))
+
+    def provider(self, instance_id: str) -> "MockIoProvider":
+        return MockIoProvider(self, instance_id)
+
+    def _register(self, instance_id: str, callback) -> None:
+        self._receivers[instance_id] = callback
+
+    def _send(
+        self, src: Tuple[str, str], packet: SparkHelloPacket
+    ) -> int:
+        now_us = int(time.monotonic() * 1_000_000)
+        loop = asyncio.get_event_loop()
+        for dst, latency in self._links.get(src, []):
+            if (src, dst) in self._partitioned:
+                continue
+            dst_instance, dst_iface = dst
+            callback = self._receivers.get(dst_instance)
+            if callback is None:
+                continue
+            loop.call_later(
+                latency,
+                callback,
+                ReceivedPacket(
+                    if_name=dst_iface,
+                    packet=packet,
+                    recv_ts_us=int(
+                        (time.monotonic() + latency) * 1_000_000
+                    ),
+                ),
+            )
+        return now_us
+
+
+class MockIoProvider(IoProvider):
+    def __init__(self, network: MockIoNetwork, instance_id: str) -> None:
+        self._network = network
+        self.instance_id = instance_id
+
+    def set_receiver(self, instance_id: str, callback) -> None:
+        self._network._register(instance_id, callback)
+
+    def send(self, if_name: str, packet: SparkHelloPacket) -> int:
+        return self._network._send((self.instance_id, if_name), packet)

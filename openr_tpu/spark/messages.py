@@ -1,0 +1,64 @@
+"""Spark wire messages (openr/if/Spark.thrift equivalents).
+
+SparkHelloMsg:43 — periodic discovery beacon carrying reflected neighbor
+timestamps for RTT measurement and bidirectionality detection.
+SparkHandshakeMsg:67 — negotiation (transport addresses, ports, area).
+SparkHeartbeatMsg:93 — liveness keepalive after establishment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass
+class ReflectedNeighborInfo:
+    """Timestamps echoed back to a neighbor (Spark.thrift:30-40)."""
+
+    last_nbr_msg_sent_ts_us: int = 0  # when the nbr last sent us a hello
+    last_my_msg_rcvd_ts_us: int = 0  # when we received it
+
+
+@dataclass
+class SparkHelloMsg:
+    domain_name: str
+    node_name: str
+    if_name: str
+    seq_num: int
+    neighbor_infos: Dict[str, ReflectedNeighborInfo] = field(
+        default_factory=dict
+    )
+    version: int = 1
+    solicit_response: bool = False
+    restarting: bool = False
+    sent_ts_in_us: int = 0
+
+
+@dataclass
+class SparkHandshakeMsg:
+    node_name: str
+    is_adj_established: bool
+    hold_time_ms: int
+    graceful_restart_time_ms: int
+    transport_address_v6: str
+    transport_address_v4: str
+    openr_ctrl_thrift_port: int
+    kvstore_cmd_port: int
+    area: str
+    neighbor_node_name: Optional[str] = None
+
+
+@dataclass
+class SparkHeartbeatMsg:
+    node_name: str
+    seq_num: int
+
+
+@dataclass
+class SparkHelloPacket:
+    """Union envelope (Spark.thrift SparkHelloPacket:103)."""
+
+    hello_msg: Optional[SparkHelloMsg] = None
+    handshake_msg: Optional[SparkHandshakeMsg] = None
+    heartbeat_msg: Optional[SparkHeartbeatMsg] = None

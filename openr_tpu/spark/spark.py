@@ -1,0 +1,614 @@
+"""Spark neighbor discovery module.
+
+Behavioral port of openr/spark/Spark.{h,cpp}:
+  - table-driven 5-state neighbor FSM (Spark.cpp:110-178):
+      IDLE -> WARM on any hello; WARM -> NEGOTIATE on bidirectional hello;
+      NEGOTIATE -> ESTABLISHED on handshake (-> WARM on negotiate timeout or
+      failure); ESTABLISHED -> IDLE on hold expiry or info loss, -> RESTART
+      on a restarting hello; RESTART -> ESTABLISHED on hello, -> IDLE on GR
+      expiry.
+  - hello beacons per interface with fast-init cadence until first
+    adjacency (Spark.cpp:1553, docs/Spark.md:43-46), reflecting neighbor
+    timestamps for RTT measurement (updateNeighborRtt Spark.cpp:667):
+      rtt = (t4 - t1) - (t3 - t2)
+  - handshake negotiation incl. area matching (processHandshakeMsg
+    Spark.cpp:1355); heartbeat keepalives refreshing hold timers
+    (processHeartbeatMsg Spark.cpp:1501); graceful-restart flow.
+  - RTT smoothed through StepDetector; RTT_CHANGE events only on steps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.spark.io_provider import IoProvider, ReceivedPacket
+from openr_tpu.spark.messages import (
+    ReflectedNeighborInfo,
+    SparkHandshakeMsg,
+    SparkHelloMsg,
+    SparkHelloPacket,
+    SparkHeartbeatMsg,
+)
+from openr_tpu.utils import StepDetector
+from openr_tpu.utils.counters import CountersMixin
+
+
+class SparkNeighState(enum.Enum):
+    IDLE = 0
+    WARM = 1
+    NEGOTIATE = 2
+    ESTABLISHED = 3
+    RESTART = 4
+
+
+class SparkNeighEvent(enum.Enum):
+    HELLO_RCVD_INFO = 0
+    HELLO_RCVD_NO_INFO = 1
+    HELLO_RCVD_RESTART = 2
+    HEARTBEAT_RCVD = 3
+    HANDSHAKE_RCVD = 4
+    HEARTBEAT_TIMER_EXPIRE = 5
+    NEGOTIATE_TIMER_EXPIRE = 6
+    GR_TIMER_EXPIRE = 7
+    NEGOTIATION_FAILURE = 8
+
+
+S, E = SparkNeighState, SparkNeighEvent
+# exact transition matrix from Spark.cpp:110-178; missing = invalid
+_FSM: Dict[Tuple[SparkNeighState, SparkNeighEvent], SparkNeighState] = {
+    (S.IDLE, E.HELLO_RCVD_INFO): S.WARM,
+    (S.IDLE, E.HELLO_RCVD_NO_INFO): S.WARM,
+    (S.WARM, E.HELLO_RCVD_INFO): S.NEGOTIATE,
+    (S.NEGOTIATE, E.HANDSHAKE_RCVD): S.ESTABLISHED,
+    (S.NEGOTIATE, E.NEGOTIATE_TIMER_EXPIRE): S.WARM,
+    (S.NEGOTIATE, E.NEGOTIATION_FAILURE): S.WARM,
+    (S.ESTABLISHED, E.HELLO_RCVD_NO_INFO): S.IDLE,
+    (S.ESTABLISHED, E.HELLO_RCVD_RESTART): S.RESTART,
+    (S.ESTABLISHED, E.HEARTBEAT_RCVD): S.ESTABLISHED,
+    (S.ESTABLISHED, E.HEARTBEAT_TIMER_EXPIRE): S.IDLE,
+    (S.RESTART, E.HELLO_RCVD_INFO): S.ESTABLISHED,
+    (S.RESTART, E.GR_TIMER_EXPIRE): S.IDLE,
+}
+
+
+class NeighborEventType(enum.Enum):
+    NEIGHBOR_UP = "NEIGHBOR_UP"
+    NEIGHBOR_DOWN = "NEIGHBOR_DOWN"
+    NEIGHBOR_RESTARTING = "NEIGHBOR_RESTARTING"
+    NEIGHBOR_RESTARTED = "NEIGHBOR_RESTARTED"
+    NEIGHBOR_RTT_CHANGE = "NEIGHBOR_RTT_CHANGE"
+
+
+@dataclass
+class NeighborEvent:
+    event_type: NeighborEventType
+    node_name: str
+    local_if_name: str
+    remote_if_name: str
+    area: str
+    rtt_us: int = 0
+    label: int = 0
+    transport_address_v4: str = ""
+    transport_address_v6: str = ""
+    kvstore_cmd_port: int = 0
+    openr_ctrl_thrift_port: int = 0
+
+
+@dataclass
+class SparkConfig:
+    node_name: str
+    domain: str = "default"
+    # ordered (area, node-name regex) pairs for area negotiation
+    # (AreaConfiguration, config/Config.h:251)
+    area_configs: List[Tuple[str, str]] = field(
+        default_factory=lambda: [("0", ".*")]
+    )
+    hello_time: float = 20.0
+    fastinit_hello_time: float = 0.5
+    handshake_time: float = 0.5
+    keepalive_time: float = 2.0
+    hold_time: float = 10.0
+    graceful_restart_time: float = 30.0
+    negotiate_hold_time: float = 2.0  # handshake_time * 4-ish
+    transport_address_v4: str = "169.254.0.1"
+    transport_address_v6: str = "fe80::1"
+    kvstore_cmd_port: int = 60002
+    openr_ctrl_thrift_port: int = 2018
+    node_label: int = 0
+
+    def area_for(self, neighbor_name: str) -> Optional[str]:
+        for area, pattern in self.area_configs:
+            if re.fullmatch(pattern, neighbor_name):
+                return area
+        return None
+
+
+class _Neighbor:
+    def __init__(
+        self,
+        spark: "Spark",
+        node_name: str,
+        local_if: str,
+        remote_if: str,
+        seq_num: int,
+    ) -> None:
+        self.spark = spark
+        self.node_name = node_name
+        self.local_if = local_if
+        self.remote_if = remote_if
+        self.seq_num = seq_num
+        self.state = SparkNeighState.IDLE
+        self.area: Optional[str] = None
+        self.label = 0
+        self.rtt_us = 0
+        self.rtt_latest_us = 0
+        self.transport_address_v4 = ""
+        self.transport_address_v6 = ""
+        self.kvstore_cmd_port = 0
+        self.openr_ctrl_thrift_port = 0
+        # reflected timestamps for the hello we send back
+        self.last_nbr_msg_sent_ts_us = 0
+        self.last_my_msg_rcvd_ts_us = 0
+        self.step_detector = StepDetector(
+            self._on_rtt_step,
+            fast_window_size=10,
+            slow_window_size=60,
+            lower_threshold=2.0,
+            upper_threshold=5.0,
+            abs_threshold=500.0,
+            sample_period=1.0,
+        )
+        self._negotiate_timer: Optional[asyncio.TimerHandle] = None
+        self._handshake_timer: Optional[asyncio.TimerHandle] = None
+        self._hold_timer: Optional[asyncio.TimerHandle] = None
+        self._gr_timer: Optional[asyncio.TimerHandle] = None
+
+    def _on_rtt_step(self, new_rtt: float) -> None:
+        self.rtt_us = int(new_rtt)
+        if self.state == SparkNeighState.ESTABLISHED:
+            self.spark.publish_event(
+                NeighborEventType.NEIGHBOR_RTT_CHANGE, self
+            )
+
+    def fsm(self, event: SparkNeighEvent) -> Optional[SparkNeighState]:
+        """Apply event; returns the new state or None if invalid."""
+        next_state = _FSM.get((self.state, event))
+        if next_state is None:
+            return None
+        old, self.state = self.state, next_state
+        return next_state
+
+    def cancel_timers(self) -> None:
+        for t in (
+            self._negotiate_timer,
+            self._handshake_timer,
+            self._hold_timer,
+            self._gr_timer,
+        ):
+            if t is not None:
+                t.cancel()
+        self._negotiate_timer = None
+        self._handshake_timer = None
+        self._hold_timer = None
+        self._gr_timer = None
+
+
+class Spark(CountersMixin):
+    def __init__(
+        self,
+        config: SparkConfig,
+        io_provider: IoProvider,
+        neighbor_events_queue: ReplicateQueue,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.io = io_provider
+        self.neighbor_events_queue = neighbor_events_queue
+        self._loop = loop
+        self.interfaces: Dict[str, bool] = {}  # ifname -> fast-init pending
+        # ifname -> node -> neighbor
+        self.neighbors: Dict[str, Dict[str, _Neighbor]] = {}
+        self.seq_num = 0
+        self._hello_timers: Dict[str, asyncio.TimerHandle] = {}
+        self._heartbeat_timers: Dict[str, asyncio.TimerHandle] = {}
+        self.counters: Dict[str, int] = {}
+        self._stopped = False
+        self.io.set_receiver(config.node_name, self._on_packet)
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+    # interface management (fed by LinkMonitor)
+    # ------------------------------------------------------------------
+
+    def update_interfaces(self, up_ifaces: List[str]) -> None:
+        """Apply the interface set (processInterfaceUpdates Spark.cpp:1637)."""
+        added = [i for i in up_ifaces if i not in self.interfaces]
+        removed = [i for i in self.interfaces if i not in up_ifaces]
+        for iface in removed:
+            self._remove_interface(iface)
+        for iface in added:
+            self.interfaces[iface] = True  # fast-init pending
+            self._send_hello(iface)
+            self._schedule_heartbeat(iface)
+
+    def _remove_interface(self, iface: str) -> None:
+        for neighbor in list(self.neighbors.get(iface, {}).values()):
+            if neighbor.state in (
+                SparkNeighState.ESTABLISHED,
+                SparkNeighState.RESTART,
+            ):
+                self.publish_event(NeighborEventType.NEIGHBOR_DOWN, neighbor)
+            neighbor.cancel_timers()
+        self.neighbors.pop(iface, None)
+        self.interfaces.pop(iface, None)
+        t = self._hello_timers.pop(iface, None)
+        if t is not None:
+            t.cancel()
+        t = self._heartbeat_timers.pop(iface, None)
+        if t is not None:
+            t.cancel()
+
+    # ------------------------------------------------------------------
+    # outbound
+    # ------------------------------------------------------------------
+
+    def _send_hello(
+        self, iface: str, restarting: bool = False
+    ) -> None:
+        if self._stopped or iface not in self.interfaces:
+            return
+        self.seq_num += 1
+        infos: Dict[str, ReflectedNeighborInfo] = {}
+        for neighbor in self.neighbors.get(iface, {}).values():
+            infos[neighbor.node_name] = ReflectedNeighborInfo(
+                last_nbr_msg_sent_ts_us=neighbor.last_nbr_msg_sent_ts_us,
+                last_my_msg_rcvd_ts_us=neighbor.last_my_msg_rcvd_ts_us,
+            )
+        msg = SparkHelloMsg(
+            domain_name=self.config.domain,
+            node_name=self.config.node_name,
+            if_name=iface,
+            seq_num=self.seq_num,
+            neighbor_infos=infos,
+            solicit_response=self.interfaces.get(iface, False),
+            restarting=restarting,
+            sent_ts_in_us=self.io.now_us(),
+        )
+        msg.sent_ts_in_us = self.io.send(
+            iface, SparkHelloPacket(hello_msg=msg)
+        )
+        self._bump("spark.hello_packet_sent")
+        # fast-init cadence until an adjacency forms on the interface
+        fast = self.interfaces.get(iface, False)
+        period = (
+            self.config.fastinit_hello_time if fast else self.config.hello_time
+        )
+        old = self._hello_timers.get(iface)
+        if old is not None:
+            old.cancel()
+        self._hello_timers[iface] = self.loop().call_later(
+            period, self._send_hello, iface
+        )
+
+    def _schedule_heartbeat(self, iface: str) -> None:
+        if self._stopped or iface not in self.interfaces:
+            return
+        self.io.send(
+            iface,
+            SparkHelloPacket(
+                heartbeat_msg=SparkHeartbeatMsg(
+                    node_name=self.config.node_name, seq_num=self.seq_num
+                )
+            ),
+        )
+        self._bump("spark.heartbeat_packet_sent")
+        self._heartbeat_timers[iface] = self.loop().call_later(
+            self.config.keepalive_time, self._schedule_heartbeat, iface
+        )
+
+    def _send_handshake(self, neighbor: _Neighbor) -> None:
+        if (
+            self._stopped
+            or neighbor.state != SparkNeighState.NEGOTIATE
+            or neighbor.local_if not in self.interfaces
+        ):
+            return
+        area = self.config.area_for(neighbor.node_name)
+        self.io.send(
+            neighbor.local_if,
+            SparkHelloPacket(
+                handshake_msg=SparkHandshakeMsg(
+                    node_name=self.config.node_name,
+                    is_adj_established=False,
+                    hold_time_ms=int(self.config.hold_time * 1000),
+                    graceful_restart_time_ms=int(
+                        self.config.graceful_restart_time * 1000
+                    ),
+                    transport_address_v6=self.config.transport_address_v6,
+                    transport_address_v4=self.config.transport_address_v4,
+                    openr_ctrl_thrift_port=self.config.openr_ctrl_thrift_port,
+                    kvstore_cmd_port=self.config.kvstore_cmd_port,
+                    area=area if area is not None else "",
+                    neighbor_node_name=neighbor.node_name,
+                )
+            ),
+        )
+        self._bump("spark.handshake_packet_sent")
+        neighbor._handshake_timer = self.loop().call_later(
+            self.config.handshake_time, self._send_handshake, neighbor
+        )
+
+    # ------------------------------------------------------------------
+    # inbound
+    # ------------------------------------------------------------------
+
+    def _on_packet(self, received: ReceivedPacket) -> None:
+        if self._stopped or received.if_name not in self.interfaces:
+            return
+        packet = received.packet
+        if packet.hello_msg is not None:
+            self._process_hello(received)
+        elif packet.handshake_msg is not None:
+            self._process_handshake(received)
+        elif packet.heartbeat_msg is not None:
+            self._process_heartbeat(received)
+
+    def _get_or_create_neighbor(
+        self, iface: str, msg: SparkHelloMsg
+    ) -> _Neighbor:
+        by_node = self.neighbors.setdefault(iface, {})
+        neighbor = by_node.get(msg.node_name)
+        if neighbor is None:
+            neighbor = _Neighbor(
+                self, msg.node_name, iface, msg.if_name, msg.seq_num
+            )
+            by_node[msg.node_name] = neighbor
+        return neighbor
+
+    def _process_hello(self, received: ReceivedPacket) -> None:
+        msg = received.packet.hello_msg
+        if msg.node_name == self.config.node_name:
+            return  # our own multicast echo
+        if msg.domain_name != self.config.domain:
+            self._bump("spark.invalid_domain")
+            return
+        iface = received.if_name
+        neighbor = self._get_or_create_neighbor(iface, msg)
+        neighbor.seq_num = msg.seq_num
+        neighbor.remote_if = msg.if_name
+        neighbor.last_nbr_msg_sent_ts_us = msg.sent_ts_in_us
+        neighbor.last_my_msg_rcvd_ts_us = received.recv_ts_us
+        self._bump("spark.hello_packet_recv")
+
+        our_info = msg.neighbor_infos.get(self.config.node_name)
+        # RTT from reflected timestamps (Spark.cpp:667):
+        # t1 = our hello sent, t2 = nbr received it, t3 = nbr hello sent,
+        # t4 = we received it; rtt = (t4 - t1) - (t3 - t2)
+        if our_info is not None and our_info.last_nbr_msg_sent_ts_us > 0:
+            rtt = (
+                received.recv_ts_us - our_info.last_nbr_msg_sent_ts_us
+            ) - (msg.sent_ts_in_us - our_info.last_my_msg_rcvd_ts_us)
+            if rtt > 0:
+                neighbor.rtt_latest_us = rtt
+                if neighbor.rtt_us == 0:
+                    neighbor.rtt_us = rtt
+                neighbor.step_detector.add_value(
+                    time.monotonic(), float(rtt)
+                )
+
+        state = neighbor.state
+        if state == SparkNeighState.IDLE:
+            neighbor.fsm(
+                SparkNeighEvent.HELLO_RCVD_INFO
+                if our_info is not None
+                else SparkNeighEvent.HELLO_RCVD_NO_INFO
+            )
+            if our_info is None:
+                # solicit a fast response for quick bidirectional discovery
+                self._send_hello(iface)
+        elif state == SparkNeighState.WARM:
+            if our_info is not None:
+                neighbor.fsm(SparkNeighEvent.HELLO_RCVD_INFO)
+                self._start_negotiation(neighbor)
+        elif state == SparkNeighState.ESTABLISHED:
+            if msg.restarting:
+                neighbor.fsm(SparkNeighEvent.HELLO_RCVD_RESTART)
+                self._neighbor_restarting(neighbor)
+            elif our_info is None:
+                # neighbor forgot about us: hard down
+                neighbor.fsm(SparkNeighEvent.HELLO_RCVD_NO_INFO)
+                self._neighbor_down(neighbor)
+            # else: refresh only (heartbeats maintain hold)
+        elif state == SparkNeighState.RESTART:
+            if not msg.restarting and our_info is not None:
+                neighbor.fsm(SparkNeighEvent.HELLO_RCVD_INFO)
+                self._neighbor_restarted(neighbor)
+
+    def _start_negotiation(self, neighbor: _Neighbor) -> None:
+        self._send_handshake(neighbor)
+        if neighbor._negotiate_timer is not None:
+            neighbor._negotiate_timer.cancel()
+        neighbor._negotiate_timer = self.loop().call_later(
+            self.config.negotiate_hold_time,
+            self._negotiate_timeout,
+            neighbor,
+        )
+
+    def _negotiate_timeout(self, neighbor: _Neighbor) -> None:
+        if neighbor.state == SparkNeighState.NEGOTIATE:
+            neighbor.fsm(SparkNeighEvent.NEGOTIATE_TIMER_EXPIRE)
+            if neighbor._handshake_timer is not None:
+                neighbor._handshake_timer.cancel()
+
+    def _process_handshake(self, received: ReceivedPacket) -> None:
+        msg = received.packet.handshake_msg
+        if msg.node_name == self.config.node_name:
+            return
+        iface = received.if_name
+        neighbor = self.neighbors.get(iface, {}).get(msg.node_name)
+        if neighbor is None:
+            return
+        self._bump("spark.handshake_packet_recv")
+        # a handshake directed at another node is not for us
+        if (
+            msg.neighbor_node_name is not None
+            and msg.neighbor_node_name != self.config.node_name
+        ):
+            return
+        # respond so the peer can also establish (unless it already has)
+        if not msg.is_adj_established and neighbor.state in (
+            SparkNeighState.NEGOTIATE,
+            SparkNeighState.ESTABLISHED,
+        ):
+            area = self.config.area_for(msg.node_name)
+            self.io.send(
+                iface,
+                SparkHelloPacket(
+                    handshake_msg=SparkHandshakeMsg(
+                        node_name=self.config.node_name,
+                        is_adj_established=True,
+                        hold_time_ms=int(self.config.hold_time * 1000),
+                        graceful_restart_time_ms=int(
+                            self.config.graceful_restart_time * 1000
+                        ),
+                        transport_address_v6=self.config.transport_address_v6,
+                        transport_address_v4=self.config.transport_address_v4,
+                        openr_ctrl_thrift_port=(
+                            self.config.openr_ctrl_thrift_port
+                        ),
+                        kvstore_cmd_port=self.config.kvstore_cmd_port,
+                        area=area if area is not None else "",
+                        neighbor_node_name=msg.node_name,
+                    )
+                ),
+            )
+        if neighbor.state != SparkNeighState.NEGOTIATE:
+            return
+        # area negotiation: both sides must agree
+        my_area = self.config.area_for(msg.node_name)
+        if my_area is None or (msg.area and msg.area != my_area):
+            self._bump("spark.invalid_area")
+            neighbor.fsm(SparkNeighEvent.NEGOTIATION_FAILURE)
+            if neighbor._handshake_timer is not None:
+                neighbor._handshake_timer.cancel()
+            if neighbor._negotiate_timer is not None:
+                neighbor._negotiate_timer.cancel()
+                neighbor._negotiate_timer = None
+            return
+        neighbor.area = my_area
+        neighbor.transport_address_v4 = msg.transport_address_v4
+        neighbor.transport_address_v6 = msg.transport_address_v6
+        neighbor.kvstore_cmd_port = msg.kvstore_cmd_port
+        neighbor.openr_ctrl_thrift_port = msg.openr_ctrl_thrift_port
+        neighbor.fsm(SparkNeighEvent.HANDSHAKE_RCVD)
+        neighbor.cancel_timers()
+        self.interfaces[neighbor.local_if] = False  # leave fast-init
+        self._start_hold_timer(neighbor)
+        self.publish_event(NeighborEventType.NEIGHBOR_UP, neighbor)
+
+    def _process_heartbeat(self, received: ReceivedPacket) -> None:
+        msg = received.packet.heartbeat_msg
+        iface = received.if_name
+        neighbor = self.neighbors.get(iface, {}).get(msg.node_name)
+        if neighbor is None or neighbor.state != SparkNeighState.ESTABLISHED:
+            return
+        self._bump("spark.heartbeat_packet_recv")
+        neighbor.fsm(SparkNeighEvent.HEARTBEAT_RCVD)
+        self._start_hold_timer(neighbor)  # refresh
+
+    # ------------------------------------------------------------------
+    # neighbor lifecycle
+    # ------------------------------------------------------------------
+
+    def _start_hold_timer(self, neighbor: _Neighbor) -> None:
+        if neighbor._hold_timer is not None:
+            neighbor._hold_timer.cancel()
+        neighbor._hold_timer = self.loop().call_later(
+            self.config.hold_time, self._hold_expired, neighbor
+        )
+
+    def _hold_expired(self, neighbor: _Neighbor) -> None:
+        if neighbor.state == SparkNeighState.ESTABLISHED:
+            neighbor.fsm(SparkNeighEvent.HEARTBEAT_TIMER_EXPIRE)
+            self._neighbor_down(neighbor)
+
+    def _neighbor_down(self, neighbor: _Neighbor) -> None:
+        neighbor.cancel_timers()
+        self.publish_event(NeighborEventType.NEIGHBOR_DOWN, neighbor)
+        self.neighbors.get(neighbor.local_if, {}).pop(
+            neighbor.node_name, None
+        )
+        self.interfaces[neighbor.local_if] = True  # back to fast-init
+
+    def _neighbor_restarting(self, neighbor: _Neighbor) -> None:
+        neighbor.cancel_timers()
+        self.publish_event(NeighborEventType.NEIGHBOR_RESTARTING, neighbor)
+        neighbor._gr_timer = self.loop().call_later(
+            self.config.graceful_restart_time, self._gr_expired, neighbor
+        )
+
+    def _gr_expired(self, neighbor: _Neighbor) -> None:
+        if neighbor.state == SparkNeighState.RESTART:
+            neighbor.fsm(SparkNeighEvent.GR_TIMER_EXPIRE)
+            self._neighbor_down(neighbor)
+
+    def _neighbor_restarted(self, neighbor: _Neighbor) -> None:
+        if neighbor._gr_timer is not None:
+            neighbor._gr_timer.cancel()
+        self._start_hold_timer(neighbor)
+        self.publish_event(NeighborEventType.NEIGHBOR_RESTARTED, neighbor)
+
+    def publish_event(
+        self, event_type: NeighborEventType, neighbor: _Neighbor
+    ) -> None:
+        self.neighbor_events_queue.push(
+            NeighborEvent(
+                event_type=event_type,
+                node_name=neighbor.node_name,
+                local_if_name=neighbor.local_if,
+                remote_if_name=neighbor.remote_if,
+                area=neighbor.area or "",
+                rtt_us=neighbor.rtt_us,
+                label=neighbor.label,
+                transport_address_v4=neighbor.transport_address_v4,
+                transport_address_v6=neighbor.transport_address_v6,
+                kvstore_cmd_port=neighbor.kvstore_cmd_port,
+                openr_ctrl_thrift_port=neighbor.openr_ctrl_thrift_port,
+            )
+        )
+
+    # ------------------------------------------------------------------
+
+    def get_neighbors(
+        self, state: Optional[SparkNeighState] = None
+    ) -> List[_Neighbor]:
+        out = []
+        for by_node in self.neighbors.values():
+            for neighbor in by_node.values():
+                if state is None or neighbor.state == state:
+                    out.append(neighbor)
+        return out
+
+    def flood_restarting(self) -> None:
+        """Announce graceful restart on all interfaces (Spark GR exit)."""
+        for iface in self.interfaces:
+            self._send_hello(iface, restarting=True)
+
+    def stop(self) -> None:
+        self._stopped = True
+        for t in self._hello_timers.values():
+            t.cancel()
+        for t in self._heartbeat_timers.values():
+            t.cancel()
+        for by_node in self.neighbors.values():
+            for neighbor in by_node.values():
+                neighbor.cancel_timers()
+

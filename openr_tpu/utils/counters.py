@@ -1,0 +1,23 @@
+"""Shared counters mixin (the fb303 fbData equivalent).
+
+Every module exposes a `counters` dict of monotonically increasing values
+(naming convention `<module>.<counter>`, docs/Monitoring.md:19-31); the
+monitor module aggregates them across modules for the ctrl API.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class CountersMixin:
+    counters: Dict[str, int]
+
+    def _ensure_counters(self) -> Dict[str, int]:
+        if not hasattr(self, "counters"):
+            self.counters = {}
+        return self.counters
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        counters = self._ensure_counters()
+        counters[counter] = counters.get(counter, 0) + n

@@ -1,0 +1,377 @@
+"""Spark + LinkMonitor tests mirroring openr/spark/tests/SparkTest.cpp and
+openr/link-monitor/tests/LinkMonitorTest.cpp core scenarios, over MockIo."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.kvstore import InProcessTransport, KvStore, KvStoreParams
+from openr_tpu.linkmonitor import LinkMonitor, LinkMonitorConfig
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.spark import (
+    MockIoNetwork,
+    NeighborEventType,
+    Spark,
+    SparkConfig,
+    SparkNeighState,
+)
+from openr_tpu.types import adj_key
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=15.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def fast_config(name, **kw):
+    return SparkConfig(
+        node_name=name,
+        fastinit_hello_time=0.02,
+        hello_time=0.5,
+        handshake_time=0.02,
+        keepalive_time=0.05,
+        hold_time=0.25,
+        graceful_restart_time=0.5,
+        negotiate_hold_time=0.2,
+        **kw,
+    )
+
+
+def make_spark(name, net, **kw):
+    q = ReplicateQueue()
+    spark = Spark(fast_config(name, **kw), net.provider(name), q)
+    return spark, q.get_reader(), q
+
+
+async def wait_event(reader, event_type, timeout=5.0):
+    while True:
+        ev = await asyncio.wait_for(reader.get(), timeout)
+        if ev.event_type == event_type:
+            return ev
+
+
+class TestSparkDiscovery:
+    def test_two_nodes_establish(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"), latency_ms=2)
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            up_a = await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            up_b = await wait_event(rb, NeighborEventType.NEIGHBOR_UP)
+            assert up_a.node_name == "b"
+            assert up_a.local_if_name == "if-a"
+            assert up_a.remote_if_name == "if-b"
+            assert up_a.area == "0"
+            assert up_b.node_name == "a"
+            # transport addresses learned through the handshake
+            assert up_a.transport_address_v6 == "fe80::1"
+            spark_a.stop()
+            spark_b.stop()
+
+        run(body())
+
+    def test_rtt_measured(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"), latency_ms=20)
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            nbr = spark_a.get_neighbors(SparkNeighState.ESTABLISHED)[0]
+            # rtt should be about 2x 20ms = 40000us (mock latency)
+            assert 20_000 < nbr.rtt_us < 120_000, nbr.rtt_us
+            spark_a.stop()
+            spark_b.stop()
+
+        run(body())
+
+    def test_hold_expiry_neighbor_down(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            # kill b entirely (no graceful restart)
+            spark_b.stop()
+            down = await wait_event(ra, NeighborEventType.NEIGHBOR_DOWN)
+            assert down.node_name == "b"
+            spark_a.stop()
+
+        run(body())
+
+    def test_graceful_restart_flow(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, qb = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            # b announces graceful restart, then "restarts"
+            spark_b.flood_restarting()
+            restarting = await wait_event(
+                ra, NeighborEventType.NEIGHBOR_RESTARTING
+            )
+            assert restarting.node_name == "b"
+            nbr = spark_a.get_neighbors(SparkNeighState.RESTART)
+            assert len(nbr) == 1
+            spark_b.stop()
+            # new incarnation of b comes back before GR expires
+            spark_b2, rb2, _ = make_spark("b", net)
+            spark_b2.update_interfaces(["if-b"])
+            restarted = await wait_event(
+                ra, NeighborEventType.NEIGHBOR_RESTARTED
+            )
+            assert restarted.node_name == "b"
+            assert spark_a.get_neighbors(SparkNeighState.ESTABLISHED)
+            spark_a.stop()
+            spark_b2.stop()
+
+        run(body())
+
+    def test_gr_expiry_neighbor_down(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            spark_b.flood_restarting()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            spark_b.stop()  # never comes back
+            down = await wait_event(ra, NeighborEventType.NEIGHBOR_DOWN)
+            assert down.node_name == "b"
+            spark_a.stop()
+
+        run(body())
+
+    def test_area_negotiation_failure(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            # a only accepts neighbors matching 'x.*' into area 1
+            spark_a, ra, _ = make_spark(
+                "a", net, area_configs=[("1", "x.*")]
+            )
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await asyncio.sleep(0.5)
+            assert spark_a.get_neighbors(SparkNeighState.ESTABLISHED) == []
+            assert spark_a.counters.get("spark.invalid_area", 0) >= 1
+            spark_a.stop()
+            spark_b.stop()
+
+        run(body())
+
+    def test_three_nodes_on_lan(self):
+        async def body():
+            # hub-like wiring: every pair connected (multicast LAN emulation)
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            net.connect(("a", "if-a"), ("c", "if-c"))
+            net.connect(("b", "if-b"), ("c", "if-c"))
+            sparks = {}
+            readers = {}
+            for n in "abc":
+                sparks[n], readers[n], _ = make_spark(n, net)
+                sparks[n].update_interfaces([f"if-{n}"])
+            for n in "abc":
+                await wait_event(readers[n], NeighborEventType.NEIGHBOR_UP)
+            await asyncio.sleep(0.3)
+            for n in "abc":
+                established = sparks[n].get_neighbors(
+                    SparkNeighState.ESTABLISHED
+                )
+                assert len(established) == 2, (n, established)
+            for s in sparks.values():
+                s.stop()
+
+        run(body())
+
+
+class TestLinkMonitor:
+    def make_node(self, name, net, transport, loop_areas=("0",)):
+        kv = KvStore(
+            name, list(loop_areas), transport,
+            params=KvStoreParams(node_id=name),
+        )
+        events = ReplicateQueue()
+        spark = Spark(fast_config(name), net.provider(name), events)
+        lm = LinkMonitor(
+            LinkMonitorConfig(
+                node_name=name, node_label=100 + ord(name[-1])
+            ),
+            events.get_reader(),
+            kv,
+            spark,
+        )
+        lm.start()
+        return kv, spark, lm
+
+    def test_adjacency_advertised_into_kvstore(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            kv_b, spark_b, lm_b = self.make_node("b", net, transport)
+            lm_a.update_interface("if-a", True)
+            lm_b.update_interface("if-b", True)
+
+            async def adj_in_store():
+                while True:
+                    val = kv_a.get_key(adj_key("a"))
+                    if val is not None:
+                        db = serializer.loads(val.value)
+                        if db.adjacencies:
+                            return db
+                    await asyncio.sleep(0.02)
+
+            adj_db = await asyncio.wait_for(adj_in_store(), 5)
+            assert adj_db.adjacencies[0].other_node_name == "b"
+            assert adj_db.node_label == lm_a.config.node_label
+            # peering established -> b's store learns a's key by flooding
+            async def synced():
+                while kv_b.get_key(adj_key("a")) is None:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(synced(), 5)
+            # and vice versa
+            async def synced_b():
+                while kv_a.get_key(adj_key("b")) is None:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(synced_b(), 5)
+            for x in (lm_a, lm_b):
+                x.stop()
+            for s in (spark_a, spark_b):
+                s.stop()
+
+        run(body())
+
+    def test_neighbor_down_withdraws_adjacency(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            kv_b, spark_b, lm_b = self.make_node("b", net, transport)
+            lm_a.update_interface("if-a", True)
+            lm_b.update_interface("if-b", True)
+
+            async def until(pred):
+                while not pred():
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(
+                until(lambda: ("b", "if-a") in lm_a.adjacencies), 5
+            )
+            spark_b.stop()  # hard kill
+            await asyncio.wait_for(
+                until(lambda: ("b", "if-a") not in lm_a.adjacencies), 5
+            )
+            # advertised db now empty
+            await asyncio.wait_for(
+                until(
+                    lambda: (
+                        kv_a.get_key(adj_key("a")) is not None
+                        and not serializer.loads(
+                            kv_a.get_key(adj_key("a")).value
+                        ).adjacencies
+                    )
+                ),
+                5,
+            )
+            # peering torn down
+            assert "b" not in kv_a.dbs["0"].get_peers()
+            lm_a.stop()
+            lm_b.stop()
+            spark_a.stop()
+
+        run(body())
+
+    def test_drain_sets_overload_bit(self):
+        async def body():
+            net = MockIoNetwork()
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            lm_a.set_node_overload(True)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert db.is_overloaded
+            lm_a.set_node_overload(False)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert not db.is_overloaded
+            lm_a.stop()
+            spark_a.stop()
+
+        run(body())
+
+    def test_link_metric_override(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            kv_b, spark_b, lm_b = self.make_node("b", net, transport)
+            lm_a.update_interface("if-a", True)
+            lm_b.update_interface("if-b", True)
+
+            async def until(pred):
+                while not pred():
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(
+                until(lambda: ("b", "if-a") in lm_a.adjacencies), 5
+            )
+            lm_a.set_link_metric("if-a", 42)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert db.adjacencies[0].metric == 42
+            lm_a.set_link_metric("if-a", None)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert db.adjacencies[0].metric == 1
+            for x in (lm_a, lm_b):
+                x.stop()
+            for s in (spark_a, spark_b):
+                s.stop()
+
+        run(body())
+
+    def test_flap_dampening(self):
+        async def body():
+            net = MockIoNetwork()
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            lm_a.update_interface("flappy", True)
+            assert spark_a.interfaces  # first up is immediate
+            # flap repeatedly: interface goes into dampening
+            for _ in range(4):
+                lm_a.update_interface("flappy", False)
+                lm_a.update_interface("flappy", True)
+            assert not lm_a.interfaces["flappy"].is_active()
+            assert "flappy" not in spark_a.interfaces
+            # after backoff expires it comes back
+            await asyncio.sleep(1.1)
+            assert "flappy" in spark_a.interfaces
+            lm_a.stop()
+            spark_a.stop()
+
+        run(body())
