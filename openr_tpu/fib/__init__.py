@@ -1,0 +1,14 @@
+"""Fib module: programs computed routes into the platform FIB agent.
+
+Equivalent of openr/fib/Fib.{h,cpp}.
+"""
+
+from openr_tpu.fib.fib import Fib, FibConfig, get_best_nexthops_mpls, get_best_nexthops_unicast, longest_prefix_match
+
+__all__ = [
+    "Fib",
+    "FibConfig",
+    "get_best_nexthops_unicast",
+    "get_best_nexthops_mpls",
+    "longest_prefix_match",
+]

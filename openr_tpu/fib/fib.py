@@ -1,0 +1,580 @@
+"""Fib module: consumes DecisionRouteUpdate deltas and programs them into a
+platform FIB agent, with restart detection and full-resync recovery.
+
+Behavioral port of openr/fib/Fib.{h,cpp}:
+  - RouteState caches (Fib.h:183-207): unicast/mpls route maps, dirty
+    prefix/label sets (link-down shrunk groups), dirtyRouteDb flag.
+  - processRouteUpdates (Fib.cpp:303-352): drop doNotInstall routes, update
+    caches, program the delta.
+  - processInterfaceDb (Fib.cpp:355-484): on interface down, shrink ECMP
+    groups to nexthops on still-up interfaces (delete route if none remain);
+    on interface up, restore the full group for dirty routes.
+  - updateRoutes (Fib.cpp:498-610): best-nexthop (min-metric) selection;
+    skip delta when a full sync is pending; failure marks dirtyRouteDb and
+    schedules debounced full sync with exponential backoff (8ms..4096ms,
+    Fib.cpp:37-38).
+  - syncRouteDb (Fib.cpp:612-672): syncFib/syncMplsFib full-state push,
+    clears dirty sets on success.
+  - keepAliveCheck (Fib.cpp:681-695): poll agent aliveSince; a change means
+    agent restart → enforce full sync.
+  - longestPrefixMatch + filtered route getters (Fib.cpp:157-299).
+  - perf-event convergence logging (Fib.cpp:760-843): appends
+    FIB_ROUTE_DB_RECVD / OPENR_FIB_ROUTES_PROGRAMMED, keeps a bounded
+    perfDb_ ring, exports fib.convergence_time_ms; ordered-FIB mode persists
+    the local programming time into KvStore under 'fibTime:<node>'.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.platform import FIB_CLIENT_OPENR, FibService
+from openr_tpu.solver import DecisionRouteUpdate
+from openr_tpu.types import (
+    InterfaceDatabase,
+    IpPrefix,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    PerfEvents,
+    UnicastRoute,
+)
+from openr_tpu.utils import ExponentialBackoff
+from openr_tpu.utils.counters import CountersMixin
+
+log = logging.getLogger(__name__)
+
+# Constants.h kPerfBufferSize / kConvergenceMaxDuration
+PERF_BUFFER_SIZE = 10
+CONVERGENCE_MAX_MS = 3000.0
+FIB_TIME_MARKER = "fibTime:"  # Constants::kFibTimeMarker
+
+
+def get_best_nexthops_unicast(nexthops: List[NextHop]) -> List[NextHop]:
+    """Min-metric ECMP group (+ useNonShortestRoute KSP2 members).
+
+    Reference: openr/common/Util.cpp getBestNextHopsUnicast:474-495.
+    """
+    if len(nexthops) <= 1:
+        return list(nexthops)
+    min_cost = min(nh.metric for nh in nexthops)
+    return [
+        nh
+        for nh in nexthops
+        if nh.metric == min_cost or nh.use_non_shortest_route
+    ]
+
+
+def get_best_nexthops_mpls(nexthops: List[NextHop]) -> List[NextHop]:
+    """Min-metric MPLS group, preferring PHP over SWAP at equal cost.
+
+    Reference: openr/common/Util.cpp getBestNextHopsMpls:497-535.
+    """
+    if len(nexthops) <= 1:
+        return list(nexthops)
+    min_cost = min(nh.metric for nh in nexthops)
+    action = MplsActionCode.SWAP
+    for nh in nexthops:
+        if (
+            nh.metric == min_cost
+            and nh.mpls_action is not None
+            and nh.mpls_action.action == MplsActionCode.PHP
+        ):
+            action = MplsActionCode.PHP
+    return [
+        nh
+        for nh in nexthops
+        if nh.metric == min_cost
+        and nh.mpls_action is not None
+        and nh.mpls_action.action == action
+    ]
+
+
+def longest_prefix_match(
+    addr_prefix: str, unicast_routes: Dict[IpPrefix, UnicastRoute]
+) -> Optional[IpPrefix]:
+    """Longest-prefix match of 'addr' or 'addr/len' against the route table.
+
+    Reference: openr/fib/Fib.cpp longestPrefixMatch:157-177.
+    """
+    import ipaddress
+
+    if "/" not in addr_prefix:
+        addr_prefix += (
+            "/128" if ":" in addr_prefix else "/32"
+        )
+    net = ipaddress.ip_network(addr_prefix, strict=False)
+    best: Optional[IpPrefix] = None
+    best_len = -1
+    for prefix in unicast_routes:
+        db_net = prefix.network
+        if db_net.version != net.version:
+            continue
+        if (
+            best_len < db_net.prefixlen <= net.prefixlen
+            and net.subnet_of(db_net)
+        ):
+            best_len = db_net.prefixlen
+            best = prefix
+    return best
+
+
+@dataclass
+class FibConfig:
+    my_node_name: str
+    dryrun: bool = False
+    enable_segment_routing: bool = False
+    enable_ordered_fib: bool = False
+    cold_start_duration: float = 0.0
+    keep_alive_interval: float = 30.0  # Constants::kKeepAliveCheckInterval
+    backoff_min: float = 0.008  # Fib.cpp:37-38
+    backoff_max: float = 4.096
+    has_eor_time: bool = False  # eor_time_s set → Decision gates first sync
+
+
+@dataclass
+class _RouteState:
+    """Fib.h:183-207 RouteState."""
+
+    unicast_routes: Dict[IpPrefix, UnicastRoute] = field(default_factory=dict)
+    mpls_routes: Dict[int, MplsRoute] = field(default_factory=dict)
+    has_routes_from_decision: bool = False
+    dirty_prefixes: Set[IpPrefix] = field(default_factory=set)
+    dirty_labels: Set[int] = field(default_factory=set)
+    dirty_route_db: bool = False
+
+
+class Fib(CountersMixin):
+    def __init__(
+        self,
+        config: FibConfig,
+        fib_service: FibService,
+        route_updates: RQueue,
+        interface_updates: Optional[RQueue] = None,
+        kvstore_client=None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.fib_service = fib_service
+        self.route_updates = route_updates
+        self.interface_updates = interface_updates
+        self.kvstore_client = kvstore_client
+        self._loop = loop
+
+        self.route_state = _RouteState()
+        self.interface_status_db: Dict[str, bool] = {}
+        self.perf_db: List[PerfEvents] = []
+        self._recent_perf_ts = 0
+        self.has_synced_fib = False
+        self._backoff = ExponentialBackoff(
+            config.backoff_min, config.backoff_max
+        )
+        # single-slot semaphore serializing route programming across the
+        # route-update and interface-update consumers (Fib.h:270)
+        self._program_lock = asyncio.Lock()
+        self._sync_scheduled = False
+        self._sync_handle: Optional[asyncio.TimerHandle] = None
+        self._tasks: List[asyncio.Task] = []
+        self.counters: Dict[str, int] = {}
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.config.has_eor_time:
+            # no EOR gating: sync once cold-start hold expires (Fib.cpp:73-76)
+            self.route_state.has_routes_from_decision = True
+            self._schedule_sync(self.config.cold_start_duration)
+        self._tasks.append(self.loop().create_task(self._consume_routes()))
+        if self.interface_updates is not None:
+            self._tasks.append(
+                self.loop().create_task(self._consume_interfaces())
+            )
+        if not self.config.dryrun:
+            self._tasks.append(self.loop().create_task(self._keep_alive()))
+
+    def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+        if self._sync_handle is not None:
+            self._sync_handle.cancel()
+            self._sync_handle = None
+
+    async def _consume_routes(self) -> None:
+        while True:
+            try:
+                delta = await self.route_updates.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            await self.process_route_updates(delta)
+
+    async def _consume_interfaces(self) -> None:
+        while True:
+            try:
+                if_db = await self.interface_updates.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            await self.process_interface_db(if_db)
+
+    async def _keep_alive(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.config.keep_alive_interval)
+                await self.keep_alive_check()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                self._bump("fib.thrift.failure.keepalive")
+                log.exception("fib keepalive failed")
+
+    # ------------------------------------------------------------------
+    # route update processing
+    # ------------------------------------------------------------------
+
+    async def process_route_updates(self, delta: DecisionRouteUpdate) -> None:
+        """Fib.cpp:303-352."""
+        self.route_state.has_routes_from_decision = True
+        perf_events = delta.perf_events
+        if isinstance(perf_events, PerfEvents):
+            perf_events.add(self.config.my_node_name, "FIB_ROUTE_DB_RECVD")
+
+        unicast_to_update: List[UnicastRoute] = []
+        for entry in delta.unicast_routes_to_update:
+            if entry.do_not_install:
+                continue
+            route = entry.to_unicast_route()
+            self.route_state.unicast_routes[route.dest] = route
+            self.route_state.dirty_prefixes.discard(route.dest)
+            unicast_to_update.append(route)
+        mpls_to_update: List[MplsRoute] = []
+        for mpls_entry in delta.mpls_routes_to_update:
+            route = mpls_entry.to_mpls_route()
+            self.route_state.mpls_routes[route.top_label] = route
+            self.route_state.dirty_labels.discard(route.top_label)
+            mpls_to_update.append(route)
+        for dest in delta.unicast_routes_to_delete:
+            self.route_state.unicast_routes.pop(dest, None)
+            self.route_state.dirty_prefixes.discard(dest)
+        for label in delta.mpls_routes_to_delete:
+            self.route_state.mpls_routes.pop(label, None)
+            self.route_state.dirty_labels.discard(label)
+
+        self._bump("fib.process_route_db")
+        await self._update_routes(
+            unicast_to_update,
+            list(delta.unicast_routes_to_delete),
+            mpls_to_update,
+            list(delta.mpls_routes_to_delete),
+            perf_events,
+        )
+
+    async def process_interface_db(self, if_db: InterfaceDatabase) -> None:
+        """Fast local reaction to link events: shrink/restore ECMP groups
+        (Fib.cpp:355-484)."""
+        self._bump("fib.process_interface_db")
+        perf_events = if_db.perf_events
+        if isinstance(perf_events, PerfEvents):
+            perf_events.add(self.config.my_node_name, "FIB_INTF_DB_RECEIVED")
+        for if_name, info in if_db.interfaces.items():
+            self.interface_status_db[if_name] = info.is_up
+
+        unicast_to_update: List[UnicastRoute] = []
+        unicast_to_delete: List[IpPrefix] = []
+        for dest, route in self.route_state.unicast_routes.items():
+            valid = [
+                nh
+                for nh in route.nexthops
+                if nh.iface is None
+                or self.interface_status_db.get(nh.iface, False)
+            ]
+            prev_best = get_best_nexthops_unicast(list(route.nexthops))
+            valid_best = get_best_nexthops_unicast(valid)
+            if not valid_best:
+                unicast_to_delete.append(dest)
+                self.route_state.dirty_prefixes.add(dest)
+            elif set(valid_best) != set(prev_best):
+                unicast_to_update.append(UnicastRoute(dest, tuple(valid_best)))
+                self.route_state.dirty_prefixes.add(dest)
+            elif dest in self.route_state.dirty_prefixes:
+                # interfaces back up: restore the full group
+                unicast_to_update.append(route)
+                self.route_state.dirty_prefixes.discard(dest)
+
+        mpls_to_update: List[MplsRoute] = []
+        mpls_to_delete: List[int] = []
+        for label, mpls_route in self.route_state.mpls_routes.items():
+            valid = [
+                nh
+                for nh in mpls_route.nexthops
+                if nh.iface is None
+                or self.interface_status_db.get(nh.iface, False)
+            ]
+            prev_best = get_best_nexthops_mpls(list(mpls_route.nexthops))
+            valid_best = get_best_nexthops_mpls(valid)
+            if not valid_best:
+                mpls_to_delete.append(label)
+                self.route_state.dirty_labels.add(label)
+            elif set(valid_best) != set(prev_best):
+                mpls_to_update.append(MplsRoute(label, tuple(valid_best)))
+                self.route_state.dirty_labels.add(label)
+            elif label in self.route_state.dirty_labels:
+                mpls_to_update.append(mpls_route)
+                self.route_state.dirty_labels.discard(label)
+
+        await self._update_routes(
+            unicast_to_update,
+            unicast_to_delete,
+            mpls_to_update,
+            mpls_to_delete,
+            perf_events,
+        )
+
+    # ------------------------------------------------------------------
+    # programming
+    # ------------------------------------------------------------------
+
+    async def _update_routes(
+        self,
+        unicast_to_update: List[UnicastRoute],
+        unicast_to_delete: List[IpPrefix],
+        mpls_to_update: List[MplsRoute],
+        mpls_to_delete: List[int],
+        perf_events: Optional[PerfEvents],
+    ) -> None:
+        """Incremental delta programming (Fib.cpp:498-610)."""
+        async with self._program_lock:
+            self.update_global_counters()
+            # best-nexthop (min-metric) groups actually get programmed
+            unicast_best = [
+                UnicastRoute(
+                    r.dest, tuple(get_best_nexthops_unicast(list(r.nexthops)))
+                )
+                for r in unicast_to_update
+            ]
+            mpls_best = [
+                MplsRoute(
+                    r.top_label, tuple(get_best_nexthops_mpls(list(r.nexthops)))
+                )
+                for r in mpls_to_update
+            ]
+
+            if self.config.dryrun:
+                self.log_perf_events(perf_events)
+                return
+            if self._sync_scheduled:
+                return  # pending full sync subsumes this delta
+            if self.route_state.dirty_route_db or not self.has_synced_fib:
+                self._schedule_sync(0.0)
+                return
+
+            try:
+                n = 0
+                if unicast_to_delete:
+                    n += len(unicast_to_delete)
+                    await self.fib_service.delete_unicast_routes(
+                        FIB_CLIENT_OPENR, unicast_to_delete
+                    )
+                if unicast_best:
+                    n += len(unicast_best)
+                    await self.fib_service.add_unicast_routes(
+                        FIB_CLIENT_OPENR, unicast_best
+                    )
+                if self.config.enable_segment_routing and mpls_to_delete:
+                    n += len(mpls_to_delete)
+                    await self.fib_service.delete_mpls_routes(
+                        FIB_CLIENT_OPENR, mpls_to_delete
+                    )
+                if self.config.enable_segment_routing and mpls_best:
+                    n += len(mpls_best)
+                    await self.fib_service.add_mpls_routes(
+                        FIB_CLIENT_OPENR, mpls_best
+                    )
+                self._bump("fib.num_of_route_updates", n)
+                self.route_state.dirty_route_db = False
+                self.log_perf_events(perf_events)
+            except Exception:
+                self._bump("fib.thrift.failure.add_del_route")
+                self.route_state.dirty_route_db = True
+                log.exception("failed to program route delta; scheduling sync")
+                self._schedule_sync(0.0)
+
+    async def sync_route_db(self) -> bool:
+        """Full-state push (Fib.cpp:612-672)."""
+        unicast = [
+            UnicastRoute(
+                r.dest, tuple(get_best_nexthops_unicast(list(r.nexthops)))
+            )
+            for r in self.route_state.unicast_routes.values()
+        ]
+        mpls = [
+            MplsRoute(
+                r.top_label, tuple(get_best_nexthops_mpls(list(r.nexthops)))
+            )
+            for r in self.route_state.mpls_routes.values()
+        ]
+        if self.config.dryrun:
+            return True
+        try:
+            self._bump("fib.sync_fib_calls")
+            await self.fib_service.sync_fib(FIB_CLIENT_OPENR, unicast)
+            self.route_state.dirty_prefixes.clear()
+            if self.config.enable_segment_routing:
+                await self.fib_service.sync_mpls_fib(FIB_CLIENT_OPENR, mpls)
+            self.route_state.dirty_labels.clear()
+            self.route_state.dirty_route_db = False
+            return True
+        except Exception:
+            self._bump("fib.thrift.failure.sync_fib")
+            self.route_state.dirty_route_db = True
+            log.exception("failed to sync route db with fib agent")
+            return False
+
+    def _schedule_sync(self, delay: float) -> None:
+        """syncRouteDbDebounced (Fib.cpp:675-680): one pending sync max."""
+        if self._sync_scheduled:
+            return
+        self._sync_scheduled = True
+        self._sync_handle = self.loop().call_later(
+            delay, lambda: self.loop().create_task(self._run_sync())
+        )
+
+    async def _run_sync(self) -> None:
+        """syncRoutesTimer_ callback (Fib.cpp:48-62)."""
+        async with self._program_lock:
+            self._sync_scheduled = False
+            self._sync_handle = None
+            if not self.route_state.has_routes_from_decision:
+                return
+            if await self.sync_route_db():
+                self.has_synced_fib = True
+                self._backoff.report_success()
+            else:
+                self._backoff.report_error()
+                self._schedule_sync(
+                    self._backoff.get_time_remaining_until_retry()
+                )
+
+    async def keep_alive_check(self) -> None:
+        """Agent-restart detection (Fib.cpp:681-695)."""
+        alive_since = await self.fib_service.alive_since()
+        if getattr(self, "_latest_alive_since", None) not in (
+            None,
+            alive_since,
+        ):
+            log.warning("fib agent restarted; scheduling full sync")
+            self.route_state.dirty_route_db = True
+            self._backoff.report_success()
+            self._schedule_sync(0.0)
+        self._latest_alive_since = alive_since
+
+    # ------------------------------------------------------------------
+    # read APIs (OpenrCtrl surface)
+    # ------------------------------------------------------------------
+
+    def get_route_db(self) -> Dict[str, list]:
+        return {
+            "this_node_name": self.config.my_node_name,
+            "unicast_routes": list(self.route_state.unicast_routes.values()),
+            "mpls_routes": list(self.route_state.mpls_routes.values()),
+        }
+
+    def get_unicast_routes(
+        self, prefixes: Optional[List[str]] = None
+    ) -> List[UnicastRoute]:
+        """All routes, or longest-prefix matches of the filters
+        (Fib.cpp:233-281)."""
+        if not prefixes:
+            return list(self.route_state.unicast_routes.values())
+        matched: Set[IpPrefix] = set()
+        for prefix_str in prefixes:
+            match = longest_prefix_match(
+                prefix_str, self.route_state.unicast_routes
+            )
+            if match is not None:
+                matched.add(match)
+        return [
+            self.route_state.unicast_routes[p] for p in sorted(matched)
+        ]
+
+    def get_mpls_routes(
+        self, labels: Optional[List[int]] = None
+    ) -> List[MplsRoute]:
+        if not labels:
+            return list(self.route_state.mpls_routes.values())
+        label_set = set(labels)
+        return [
+            r
+            for label, r in self.route_state.mpls_routes.items()
+            if label in label_set
+        ]
+
+    def get_perf_db(self) -> List[PerfEvents]:
+        return list(self.perf_db)
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def update_global_counters(self) -> None:
+        """Fib.cpp:735-758."""
+        counters = self._ensure_counters()
+        counters["fib.num_unicast_routes"] = len(
+            self.route_state.unicast_routes
+        )
+        counters["fib.num_mpls_routes"] = len(self.route_state.mpls_routes)
+        counters["fib.num_routes"] = (
+            counters["fib.num_unicast_routes"]
+            + counters["fib.num_mpls_routes"]
+        )
+        counters["fib.num_dirty_prefixes"] = len(
+            self.route_state.dirty_prefixes
+        )
+        counters["fib.num_dirty_labels"] = len(self.route_state.dirty_labels)
+        counters["fib.synced"] = 0 if self._sync_scheduled else 1
+
+    def log_perf_events(self, perf_events: Optional[PerfEvents]) -> None:
+        """Convergence measurement (Fib.cpp:760-843)."""
+        if not isinstance(perf_events, PerfEvents) or not perf_events.events:
+            return
+        first_ts = perf_events.events[0].unix_ts
+        if self._recent_perf_ts >= first_ts:
+            return  # stale sample
+        self._recent_perf_ts = first_ts
+        perf_events.add(
+            self.config.my_node_name, "OPENR_FIB_ROUTES_PROGRAMMED"
+        )
+        total_ms = perf_events.events[-1].unix_ts - first_ts
+        if self.config.enable_ordered_fib and self.kvstore_client is not None:
+            # local programming time from holds-expiry → programmed
+            hold_ts = next(
+                (
+                    e.unix_ts
+                    for e in perf_events.events
+                    if e.event_descr == "ORDERED_FIB_HOLDS_EXPIRED"
+                ),
+                None,
+            )
+            if hold_ts is not None:
+                local_ms = perf_events.events[-1].unix_ts - hold_ts
+                if 0 <= local_ms <= CONVERGENCE_MAX_MS:
+                    self.kvstore_client.persist_key(
+                        FIB_TIME_MARKER + self.config.my_node_name,
+                        str(local_ms).encode(),
+                    )
+        if total_ms < 0 or total_ms > CONVERGENCE_MAX_MS:
+            return
+        self.perf_db.append(perf_events.copy())
+        while len(self.perf_db) >= PERF_BUFFER_SIZE:
+            self.perf_db.pop(0)
+        self._bump("fib.convergence_time_ms", int(total_ms))
+        self._bump("fib.route_convergence_events")

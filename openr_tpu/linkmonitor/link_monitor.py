@@ -85,6 +85,7 @@ class LinkMonitor(CountersMixin):
         kvstore: KvStore,
         spark,  # Spark instance (update_interfaces target)
         config_store=None,  # optional PersistentStore-like (dict interface)
+        interface_updates_queue=None,  # ReplicateQueue[InterfaceDatabase]
         loop: Optional[asyncio.AbstractEventLoop] = None,
     ) -> None:
         self.config = config
@@ -93,6 +94,7 @@ class LinkMonitor(CountersMixin):
         self.kvstore_client = KvStoreClient(kvstore, config.node_name, loop)
         self.spark = spark
         self.config_store = config_store
+        self.interface_updates_queue = interface_updates_queue
         self._loop = loop
 
         self.interfaces: Dict[str, InterfaceEntry] = {}
@@ -191,6 +193,23 @@ class LinkMonitor(CountersMixin):
             self._iface_timer = None
         active = [e.if_name for e in self.interfaces.values() if e.is_active()]
         self.spark.update_interfaces(active)
+        if self.interface_updates_queue is not None:
+            # publish raw (un-dampened) status so Fib can shrink ECMP groups
+            # immediately on a down event (LinkMonitor.cpp:726-749 →
+            # interfaceUpdatesQueue consumed by Fib::processInterfaceDb)
+            from openr_tpu.types import InterfaceDatabase, InterfaceInfo
+
+            self.interface_updates_queue.push(
+                InterfaceDatabase(
+                    self.config.node_name,
+                    {
+                        e.if_name: InterfaceInfo(
+                            is_up=e.is_up, networks=tuple(e.addresses)
+                        )
+                        for e in self.interfaces.values()
+                    },
+                )
+            )
         # schedule re-evaluation at the earliest backoff expiry
         pending = [
             e.backoff.get_time_remaining_until_retry()

@@ -1,0 +1,21 @@
+"""Platform layer: route-programming service interface + implementations.
+
+Equivalent of openr/platform/ + the FibService thrift interface
+(openr/if/Platform.thrift:116-202). The real Linux backend programs routes
+through the native netlink library (openr_tpu/nl); tests use MockFibHandler
+(equivalent of openr/tests/mocks/MockNetlinkFibHandler.{h,cpp}).
+"""
+
+from openr_tpu.platform.fib_service import (
+    FIB_CLIENT_OPENR,
+    FibService,
+    MockFibHandler,
+    PlatformError,
+)
+
+__all__ = [
+    "FIB_CLIENT_OPENR",
+    "FibService",
+    "MockFibHandler",
+    "PlatformError",
+]

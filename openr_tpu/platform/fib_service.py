@@ -1,0 +1,194 @@
+"""FibService interface + in-memory mock handler.
+
+Reference: openr/if/Platform.thrift service FibService:116-202 (unicast +
+MPLS route add/delete/sync per clientId, aliveSince from fb303 BaseService)
+and openr/tests/mocks/MockNetlinkFibHandler.{h,cpp} (the fake FIB agent the
+module tests program against, with per-API call counters and sync events).
+
+All methods are coroutines: the real handler performs socket/netlink I/O and
+the Fib module treats any raised exception as a failed programming attempt
+(like a thrift call failure in the reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List
+
+from openr_tpu.types import IpPrefix, MplsRoute, UnicastRoute
+
+# openr/if/Platform.thrift FibClient::OPENR
+FIB_CLIENT_OPENR = 786
+
+
+class PlatformError(RuntimeError):
+    """openr/if/Platform.thrift PlatformError."""
+
+
+class FibService:
+    """Abstract route programming service (FibService thrift equivalent)."""
+
+    async def alive_since(self) -> int:
+        """Epoch seconds this agent started (fb303 BaseService aliveSince)."""
+        raise NotImplementedError
+
+    async def add_unicast_routes(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_unicast_routes(
+        self, client_id: int, prefixes: List[IpPrefix]
+    ) -> None:
+        raise NotImplementedError
+
+    async def sync_fib(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        raise NotImplementedError
+
+    async def add_mpls_routes(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_mpls_routes(
+        self, client_id: int, labels: List[int]
+    ) -> None:
+        raise NotImplementedError
+
+    async def sync_mpls_fib(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        raise NotImplementedError
+
+    async def get_route_table_by_client(
+        self, client_id: int
+    ) -> List[UnicastRoute]:
+        raise NotImplementedError
+
+    async def get_mpls_route_table_by_client(
+        self, client_id: int
+    ) -> List[MplsRoute]:
+        raise NotImplementedError
+
+
+class MockFibHandler(FibService):
+    """In-memory FIB agent with fault injection + sync signaling.
+
+    Mirrors MockNetlinkFibHandler: per-API counters, an event to await the
+    next syncFib, and knobs to simulate agent failure/restart.
+    """
+
+    def __init__(self) -> None:
+        self.unicast_routes: Dict[int, Dict[IpPrefix, UnicastRoute]] = {}
+        self.mpls_routes: Dict[int, Dict[int, MplsRoute]] = {}
+        self.counters: Dict[str, int] = {}
+        self._alive_since = int(time.time())
+        self._fail_next = 0  # raise on the next N programming calls
+        self._unhealthy = False  # raise on every call until healed
+        self._sync_event = asyncio.Event()
+        self._mpls_sync_event = asyncio.Event()
+
+    # -- fault injection -------------------------------------------------
+
+    def fail_next(self, n: int = 1) -> None:
+        self._fail_next += n
+
+    def set_unhealthy(self, unhealthy: bool = True) -> None:
+        self._unhealthy = unhealthy
+
+    def restart(self) -> None:
+        """Simulate agent restart: state wiped, aliveSince bumped."""
+        self.unicast_routes.clear()
+        self.mpls_routes.clear()
+        self._alive_since += 1
+
+    def _maybe_fail(self) -> None:
+        if self._unhealthy:
+            raise PlatformError("fib agent unhealthy")
+        if self._fail_next > 0:
+            self._fail_next -= 1
+            raise PlatformError("injected fib agent failure")
+
+    def _bump(self, name: str) -> None:
+        self.counters[name] = self.counters.get(name, 0) + 1
+
+    # -- sync signaling (MockNetlinkFibHandler::waitForSyncFib) ----------
+
+    async def wait_for_sync_fib(self, timeout: float = 5.0) -> None:
+        await asyncio.wait_for(self._sync_event.wait(), timeout)
+        self._sync_event.clear()
+
+    async def wait_for_sync_mpls_fib(self, timeout: float = 5.0) -> None:
+        await asyncio.wait_for(self._mpls_sync_event.wait(), timeout)
+        self._mpls_sync_event.clear()
+
+    # -- FibService ------------------------------------------------------
+
+    async def alive_since(self) -> int:
+        self._maybe_fail()
+        return self._alive_since
+
+    async def add_unicast_routes(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("add_unicast_routes")
+        table = self.unicast_routes.setdefault(client_id, {})
+        for route in routes:
+            table[route.dest] = route
+
+    async def delete_unicast_routes(
+        self, client_id: int, prefixes: List[IpPrefix]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("delete_unicast_routes")
+        table = self.unicast_routes.setdefault(client_id, {})
+        for prefix in prefixes:
+            table.pop(prefix, None)
+
+    async def sync_fib(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("sync_fib")
+        self.unicast_routes[client_id] = {r.dest: r for r in routes}
+        self._sync_event.set()
+
+    async def add_mpls_routes(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("add_mpls_routes")
+        table = self.mpls_routes.setdefault(client_id, {})
+        for route in routes:
+            table[route.top_label] = route
+
+    async def delete_mpls_routes(
+        self, client_id: int, labels: List[int]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("delete_mpls_routes")
+        table = self.mpls_routes.setdefault(client_id, {})
+        for label in labels:
+            table.pop(label, None)
+
+    async def sync_mpls_fib(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        self._maybe_fail()
+        self._bump("sync_mpls_fib")
+        self.mpls_routes[client_id] = {r.top_label: r for r in routes}
+        self._mpls_sync_event.set()
+
+    async def get_route_table_by_client(
+        self, client_id: int
+    ) -> List[UnicastRoute]:
+        return list(self.unicast_routes.get(client_id, {}).values())
+
+    async def get_mpls_route_table_by_client(
+        self, client_id: int
+    ) -> List[MplsRoute]:
+        return list(self.mpls_routes.get(client_id, {}).values())

@@ -298,6 +298,31 @@ class PrefixDatabase:
 
 
 # ---------------------------------------------------------------------------
+# LinkMonitor types (openr/if/LinkMonitor.thrift)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class InterfaceInfo:
+    """openr/if/LinkMonitor.thrift InterfaceInfo — isUp, ifIndex, networks."""
+
+    is_up: bool
+    if_index: int = 0
+    networks: Tuple[str, ...] = ()
+
+
+@dataclass
+class InterfaceDatabase:
+    """openr/if/LinkMonitor.thrift InterfaceDatabase — thisNodeName +
+    ifName → InterfaceInfo map + perfEvents; published by LinkMonitor,
+    consumed by Spark (discovery) and Fib (fast nexthop shrink)."""
+
+    this_node_name: str
+    interfaces: Dict[str, InterfaceInfo] = field(default_factory=dict)
+    perf_events: Optional[PerfEvents] = None
+
+
+# ---------------------------------------------------------------------------
 # KvStore types (openr/if/KvStore.thrift)
 # ---------------------------------------------------------------------------
 
@@ -421,6 +446,8 @@ __all__ = [
     "MetricVector",
     "PrefixEntry",
     "PrefixDatabase",
+    "InterfaceInfo",
+    "InterfaceDatabase",
     "Value",
     "KeyVals",
     "generate_hash",

@@ -1,0 +1,454 @@
+"""Fib module tests, mirroring openr/fib/tests/FibTest.cpp scenarios:
+programming deltas against a mock agent, doNotInstall filtering, sync-on-
+failure with backoff, agent-restart detection via aliveSince, interface-down
+ECMP shrink/restore, longest-prefix-match filtered getters."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.fib import (
+    Fib,
+    FibConfig,
+    get_best_nexthops_mpls,
+    get_best_nexthops_unicast,
+    longest_prefix_match,
+)
+from openr_tpu.messaging import ReplicateQueue, RWQueue
+from openr_tpu.platform import FIB_CLIENT_OPENR, MockFibHandler
+from openr_tpu.solver import DecisionRouteUpdate
+from openr_tpu.solver.routes import RibMplsEntry, RibUnicastEntry
+from openr_tpu.types import (
+    InterfaceDatabase,
+    InterfaceInfo,
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    NextHop,
+    PerfEvents,
+    UnicastRoute,
+)
+
+
+def run(coro, timeout=10.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def nh(addr, iface=None, metric=0, weight=0, label=None):
+    action = None
+    if label is not None:
+        action = MplsAction(MplsActionCode.SWAP, swap_label=label)
+    return NextHop(
+        address=addr, iface=iface, metric=metric, weight=weight,
+        mpls_action=action,
+    )
+
+
+def unicast_entry(prefix, *nexthops, do_not_install=False):
+    return RibUnicastEntry(
+        prefix=IpPrefix(prefix),
+        nexthops=set(nexthops),
+        do_not_install=do_not_install,
+    )
+
+
+def mpls_entry(label, *nexthops):
+    return RibMplsEntry(label=label, nexthops=set(nexthops))
+
+
+def make_fib(handler=None, **cfg_kw):
+    handler = handler or MockFibHandler()
+    route_q = RWQueue()
+    if_q = RWQueue()
+    cfg = FibConfig(my_node_name="node-1", **cfg_kw)
+    fib = Fib(cfg, handler, route_q, if_q)
+    return fib, handler, route_q, if_q
+
+
+async def wait_until(predicate, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_event_loop().time() < deadline, "timed out"
+        await asyncio.sleep(0.01)
+
+
+class TestProgramming:
+    def test_initial_sync_then_delta(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            fib.start()
+            # initial full sync happens (empty routes)
+            await handler.wait_for_sync_fib()
+            assert fib.has_synced_fib
+
+            delta = DecisionRouteUpdate(
+                unicast_routes_to_update=[
+                    unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0")),
+                    unicast_entry("10.0.1.0/24", nh("fe80::2", "eth1")),
+                ]
+            )
+            route_q.push(delta)
+            await wait_until(
+                lambda: len(handler.unicast_routes.get(FIB_CLIENT_OPENR, {}))
+                == 2
+            )
+            assert handler.counters["add_unicast_routes"] == 1
+
+            # incremental delete
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_delete=[IpPrefix("10.0.1.0/24")]
+                )
+            )
+            await wait_until(
+                lambda: len(handler.unicast_routes[FIB_CLIENT_OPENR]) == 1
+            )
+            fib.stop()
+
+        run(body())
+
+    def test_do_not_install_filtered(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry(
+                            "10.1.0.0/24",
+                            nh("fe80::1", "eth0"),
+                            do_not_install=True,
+                        ),
+                        unicast_entry("10.2.0.0/24", nh("fe80::1", "eth0")),
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: IpPrefix("10.2.0.0/24")
+                in handler.unicast_routes.get(FIB_CLIENT_OPENR, {})
+            )
+            assert (
+                IpPrefix("10.1.0.0/24")
+                not in handler.unicast_routes[FIB_CLIENT_OPENR]
+            )
+            assert IpPrefix("10.1.0.0/24") not in fib.route_state.unicast_routes
+            fib.stop()
+
+        run(body())
+
+    def test_mpls_routes_programmed_with_segment_routing(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib(enable_segment_routing=True)
+            fib.start()
+            await handler.wait_for_sync_fib()
+            await handler.wait_for_sync_mpls_fib()
+            route_q.push(
+                DecisionRouteUpdate(
+                    mpls_routes_to_update=[
+                        mpls_entry(100, nh("fe80::1", "eth0", label=101))
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: 100 in handler.mpls_routes.get(FIB_CLIENT_OPENR, {})
+            )
+            route_q.push(DecisionRouteUpdate(mpls_routes_to_delete=[100]))
+            await wait_until(
+                lambda: 100 not in handler.mpls_routes[FIB_CLIENT_OPENR]
+            )
+            fib.stop()
+
+        run(body())
+
+    def test_mpls_ignored_without_segment_routing(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib(enable_segment_routing=False)
+            fib.start()
+            await handler.wait_for_sync_fib()
+            route_q.push(
+                DecisionRouteUpdate(
+                    mpls_routes_to_update=[
+                        mpls_entry(100, nh("fe80::1", "eth0", label=101))
+                    ]
+                )
+            )
+            await asyncio.sleep(0.05)
+            assert 100 not in handler.mpls_routes.get(FIB_CLIENT_OPENR, {})
+            # still cached locally for getters
+            assert 100 in fib.route_state.mpls_routes
+            fib.stop()
+
+        run(body())
+
+
+class TestFailureRecovery:
+    def test_programming_failure_triggers_full_sync(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            handler.fail_next(1)  # fail the incremental add
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0"))
+                    ]
+                )
+            )
+            # recovery full sync must land the route
+            await handler.wait_for_sync_fib()
+            assert (
+                IpPrefix("10.0.0.0/24")
+                in handler.unicast_routes[FIB_CLIENT_OPENR]
+            )
+            assert not fib.route_state.dirty_route_db
+            assert fib.counters["fib.thrift.failure.add_del_route"] == 1
+            fib.stop()
+
+        run(body())
+
+    def test_sync_failure_retries_with_backoff(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            handler.set_unhealthy(True)
+            fib.start()
+            await asyncio.sleep(0.05)
+            assert not fib.has_synced_fib
+            assert fib.counters.get("fib.thrift.failure.sync_fib", 0) >= 1
+            handler.set_unhealthy(False)
+            await handler.wait_for_sync_fib()
+            assert fib.has_synced_fib
+            fib.stop()
+
+        run(body())
+
+    def test_agent_restart_detected_by_alive_since(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0"))
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: handler.unicast_routes.get(FIB_CLIENT_OPENR)
+            )
+            await fib.keep_alive_check()  # records aliveSince
+            handler.restart()  # wipes agent state
+            assert not handler.unicast_routes.get(FIB_CLIENT_OPENR)
+            await fib.keep_alive_check()  # detects the restart
+            await handler.wait_for_sync_fib()
+            assert (
+                IpPrefix("10.0.0.0/24")
+                in handler.unicast_routes[FIB_CLIENT_OPENR]
+            )
+            fib.stop()
+
+        run(body())
+
+
+class TestInterfaceEvents:
+    def test_interface_down_shrinks_and_restores_ecmp(self):
+        async def body():
+            fib, handler, route_q, if_q = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            # both interfaces up
+            if_q.push(
+                InterfaceDatabase(
+                    "node-1",
+                    {
+                        "eth0": InterfaceInfo(is_up=True),
+                        "eth1": InterfaceInfo(is_up=True),
+                    },
+                )
+            )
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry(
+                            "10.0.0.0/24",
+                            nh("fe80::1", "eth0"),
+                            nh("fe80::2", "eth1"),
+                        )
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: handler.unicast_routes.get(FIB_CLIENT_OPENR)
+            )
+
+            # eth0 down → group shrinks to eth1 only
+            if_q.push(
+                InterfaceDatabase(
+                    "node-1", {"eth0": InterfaceInfo(is_up=False)}
+                )
+            )
+            await wait_until(
+                lambda: len(
+                    handler.unicast_routes[FIB_CLIENT_OPENR][
+                        IpPrefix("10.0.0.0/24")
+                    ].nexthops
+                )
+                == 1
+            )
+            route = handler.unicast_routes[FIB_CLIENT_OPENR][
+                IpPrefix("10.0.0.0/24")
+            ]
+            assert route.nexthops[0].iface == "eth1"
+            assert IpPrefix("10.0.0.0/24") in fib.route_state.dirty_prefixes
+
+            # eth0 back up → full group restored
+            if_q.push(
+                InterfaceDatabase(
+                    "node-1", {"eth0": InterfaceInfo(is_up=True)}
+                )
+            )
+            await wait_until(
+                lambda: len(
+                    handler.unicast_routes[FIB_CLIENT_OPENR][
+                        IpPrefix("10.0.0.0/24")
+                    ].nexthops
+                )
+                == 2
+            )
+            assert (
+                IpPrefix("10.0.0.0/24") not in fib.route_state.dirty_prefixes
+            )
+            fib.stop()
+
+        run(body())
+
+    def test_all_interfaces_down_deletes_route(self):
+        async def body():
+            fib, handler, route_q, if_q = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            if_q.push(
+                InterfaceDatabase(
+                    "node-1", {"eth0": InterfaceInfo(is_up=True)}
+                )
+            )
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0"))
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: handler.unicast_routes.get(FIB_CLIENT_OPENR)
+            )
+            if_q.push(
+                InterfaceDatabase(
+                    "node-1", {"eth0": InterfaceInfo(is_up=False)}
+                )
+            )
+            await wait_until(
+                lambda: IpPrefix("10.0.0.0/24")
+                not in handler.unicast_routes[FIB_CLIENT_OPENR]
+            )
+            # route survives in local cache for restore
+            assert IpPrefix("10.0.0.0/24") in fib.route_state.unicast_routes
+            fib.stop()
+
+        run(body())
+
+
+class TestHelpers:
+    def test_best_nexthops_unicast_min_metric(self):
+        hops = [
+            nh("fe80::1", "eth0", metric=10),
+            nh("fe80::2", "eth1", metric=20),
+            nh("fe80::3", "eth2", metric=10),
+        ]
+        best = get_best_nexthops_unicast(hops)
+        assert {h.address for h in best} == {"fe80::1", "fe80::3"}
+
+    def test_best_nexthops_unicast_keeps_non_shortest(self):
+        hops = [
+            nh("fe80::1", "eth0", metric=10),
+            NextHop(
+                address="fe80::2",
+                iface="eth1",
+                metric=20,
+                use_non_shortest_route=True,
+            ),
+        ]
+        best = get_best_nexthops_unicast(hops)
+        assert len(best) == 2
+
+    def test_best_nexthops_mpls_prefers_php(self):
+        php = NextHop(
+            address="fe80::1",
+            iface="eth0",
+            metric=10,
+            mpls_action=MplsAction(MplsActionCode.PHP),
+        )
+        swap = nh("fe80::2", "eth1", metric=10, label=99)
+        best = get_best_nexthops_mpls([php, swap])
+        assert best == [php]
+
+    def test_longest_prefix_match(self):
+        routes = {
+            IpPrefix(p): UnicastRoute(IpPrefix(p), ())
+            for p in ["10.0.0.0/8", "10.1.0.0/16", "10.1.1.0/24"]
+        }
+        assert longest_prefix_match("10.1.1.5", routes) == IpPrefix(
+            "10.1.1.0/24"
+        )
+        assert longest_prefix_match("10.2.0.1", routes) == IpPrefix(
+            "10.0.0.0/8"
+        )
+        assert longest_prefix_match("10.1.0.0/16", routes) == IpPrefix(
+            "10.1.0.0/16"
+        )
+        assert longest_prefix_match("192.168.0.1", routes) is None
+
+    def test_get_unicast_routes_filtered(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib(dryrun=True)
+            await fib.process_route_updates(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/8", nh("fe80::1", "eth0")),
+                        unicast_entry("10.1.0.0/16", nh("fe80::1", "eth0")),
+                        unicast_entry("20.0.0.0/8", nh("fe80::2", "eth1")),
+                    ]
+                )
+            )
+            assert len(fib.get_unicast_routes()) == 3
+            filtered = fib.get_unicast_routes(["10.1.2.3"])
+            assert [r.dest for r in filtered] == [IpPrefix("10.1.0.0/16")]
+
+        run(body())
+
+    def test_perf_events_convergence_recorded(self):
+        async def body():
+            fib, handler, route_q, _ = make_fib(dryrun=True)
+            perf = PerfEvents()
+            perf.add("node-0", "DECISION_RECEIVED")
+            await fib.process_route_updates(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0"))
+                    ],
+                    perf_events=perf,
+                )
+            )
+            assert len(fib.get_perf_db()) == 1
+            events = fib.get_perf_db()[0].events
+            assert events[-1].event_descr == "OPENR_FIB_ROUTES_PROGRAMMED"
+            assert any(
+                e.event_descr == "FIB_ROUTE_DB_RECVD" for e in events
+            )
+
+        run(body())
