@@ -1,0 +1,719 @@
+/*
+ * openr-tpu native netlink library — implementation.
+ *
+ * Design (vs. reference openr/nl/):
+ *   - The reference pipelines async requests on a folly EventBase with
+ *     per-request ack futures (NetlinkProtocolSocket.h:92-255). Here the
+ *     control plane lives in Python asyncio; the native layer instead
+ *     offers bounded synchronous transactions (send + drain until ack /
+ *     NLMSG_DONE with SO_RCVTIMEO) that Python runs on an executor. Event
+ *     delivery stays async via a separate multicast-subscribed socket whose
+ *     fd plugs into the Python event loop.
+ *   - Message building mirrors NetlinkMessage.h:143 (bounded buffer,
+ *     nlmsghdr + ancillary struct + rtattr appends incl. nested).
+ *   - Route semantics mirror NetlinkRoute.cpp: RTA_MULTIPATH ECMP,
+ *     AF_MPLS label routes (RTA_NEWDST), MPLS push via RTA_ENCAP.
+ */
+
+#include "onl_netlink.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <linux/lwtunnel.h>
+#include <linux/mpls.h>
+#include <linux/mpls_iptunnel.h>
+#include <linux/netlink.h>
+#include <linux/rtnetlink.h>
+#include <net/if.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kRcvTimeoutSec = 2;
+constexpr size_t kMsgBufSize = 32768;
+
+/* ---------------- message builder ---------------- */
+
+class MsgBuilder {
+ public:
+  MsgBuilder(uint16_t type, uint16_t flags, uint32_t seq) {
+    buf_.resize(NLMSG_SPACE(0), 0);
+    auto* h = hdr();
+    h->nlmsg_len = NLMSG_LENGTH(0);
+    h->nlmsg_type = type;
+    h->nlmsg_flags = flags;
+    h->nlmsg_seq = seq;
+    h->nlmsg_pid = 0;
+  }
+
+  nlmsghdr* hdr() { return reinterpret_cast<nlmsghdr*>(buf_.data()); }
+
+  /* append the fixed ancillary struct (rtmsg / ifinfomsg / ifaddrmsg) */
+  template <typename T>
+  T* add_payload() {
+    size_t off = grow(NLMSG_ALIGN(sizeof(T)));
+    return reinterpret_cast<T*>(buf_.data() + off);
+  }
+
+  void add_attr(uint16_t type, const void* data, size_t len) {
+    size_t off = grow(RTA_SPACE(len));
+    auto* rta = reinterpret_cast<rtattr*>(buf_.data() + off);
+    rta->rta_type = type;
+    rta->rta_len = RTA_LENGTH(len);
+    if (len) memcpy(RTA_DATA(rta), data, len);
+  }
+
+  template <typename T>
+  void add_attr(uint16_t type, const T& v) {
+    add_attr(type, &v, sizeof(T));
+  }
+
+  /* nested attribute: returns offset to patch the length at close */
+  size_t nest_begin(uint16_t type) {
+    size_t off = grow(RTA_SPACE(0));
+    auto* rta = reinterpret_cast<rtattr*>(buf_.data() + off);
+    rta->rta_type = type;
+    rta->rta_len = RTA_LENGTH(0);
+    return off;
+  }
+
+  void nest_end(size_t off) {
+    auto* rta = reinterpret_cast<rtattr*>(buf_.data() + off);
+    rta->rta_len = buf_.size() - off;
+  }
+
+  /* rtnexthop inside RTA_MULTIPATH */
+  size_t rtnh_begin() {
+    size_t off = grow(RTNH_SPACE(0));
+    auto* rtnh = reinterpret_cast<rtnexthop*>(buf_.data() + off);
+    rtnh->rtnh_len = RTNH_LENGTH(0);
+    rtnh->rtnh_flags = 0;
+    rtnh->rtnh_hops = 0;
+    rtnh->rtnh_ifindex = 0;
+    return off;
+  }
+
+  void rtnh_end(size_t off) {
+    auto* rtnh = reinterpret_cast<rtnexthop*>(buf_.data() + off);
+    rtnh->rtnh_len = buf_.size() - off;
+  }
+
+  rtnexthop* rtnh_at(size_t off) {
+    return reinterpret_cast<rtnexthop*>(buf_.data() + off);
+  }
+
+  const void* data() { return buf_.data(); }
+  size_t size() const { return buf_.size(); }
+
+ private:
+  size_t grow(size_t bytes) {
+    size_t off = buf_.size();
+    buf_.resize(off + bytes, 0);
+    hdr()->nlmsg_len = buf_.size();
+    return off;
+  }
+
+  std::vector<char> buf_;
+};
+
+/* ---------------- address helpers ---------------- */
+
+struct IpAddr {
+  int family = 0;
+  uint8_t bytes[16] = {0};
+  int len = 0; /* 4 or 16 */
+};
+
+bool parse_addr(const char* s, IpAddr* out) {
+  if (inet_pton(AF_INET, s, out->bytes) == 1) {
+    out->family = AF_INET;
+    out->len = 4;
+    return true;
+  }
+  if (inet_pton(AF_INET6, s, out->bytes) == 1) {
+    out->family = AF_INET6;
+    out->len = 16;
+    return true;
+  }
+  return false;
+}
+
+bool parse_prefix(const char* s, IpAddr* addr, int* prefixlen) {
+  std::string str(s);
+  auto slash = str.find('/');
+  if (slash == std::string::npos) return false;
+  std::string ip = str.substr(0, slash);
+  *prefixlen = atoi(str.c_str() + slash + 1);
+  return parse_addr(ip.c_str(), addr);
+}
+
+void format_addr(int family, const void* data, char* out, size_t outlen) {
+  inet_ntop(family, data, out, outlen);
+}
+
+/* mpls label stack entry encoding (RFC 3032): label<<12 | tc<<9 | S<<8 */
+uint32_t mpls_lse(uint32_t label, bool bottom) {
+  uint32_t v = (label << MPLS_LS_LABEL_SHIFT);
+  if (bottom) v |= (1u << MPLS_LS_S_SHIFT);
+  return htonl(v);
+}
+
+/* ---------------- the handle ---------------- */
+
+struct Handle {
+  int fd = -1;       /* transactional socket */
+  int event_fd = -1; /* multicast-subscribed event socket */
+  uint32_t seq = 1;
+  std::string error;
+  char evbuf[kMsgBufSize];
+
+  bool fail(const std::string& msg) {
+    error = msg + ": " + strerror(errno);
+    return false;
+  }
+};
+
+bool open_socket(int* out_fd, uint32_t groups) {
+  int fd = socket(AF_NETLINK, SOCK_RAW | SOCK_CLOEXEC, NETLINK_ROUTE);
+  if (fd < 0) return false;
+  struct timeval tv = {kRcvTimeoutSec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int bufsz = 1 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+  sockaddr_nl sa = {};
+  sa.nl_family = AF_NETLINK;
+  sa.nl_groups = groups;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    close(fd);
+    return false;
+  }
+  *out_fd = fd;
+  return true;
+}
+
+/* send one request; invoke cb on every data message; stop on ack/done.
+ * Returns true on success (ack with error==0, or DONE for dumps). */
+template <typename Cb>
+bool transact(Handle* h, MsgBuilder& msg, Cb&& cb) {
+  msg.hdr()->nlmsg_seq = ++h->seq;
+  if (send(h->fd, msg.data(), msg.size(), 0) < 0) {
+    return h->fail("netlink send");
+  }
+  char buf[kMsgBufSize];
+  while (true) {
+    ssize_t n = recv(h->fd, buf, sizeof(buf), 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return h->fail("netlink recv");
+    }
+    for (auto* nh = reinterpret_cast<nlmsghdr*>(buf); NLMSG_OK(nh, n);
+         nh = NLMSG_NEXT(nh, n)) {
+      if (nh->nlmsg_seq != h->seq) continue; /* stale */
+      if (nh->nlmsg_type == NLMSG_DONE) return true;
+      if (nh->nlmsg_type == NLMSG_ERROR) {
+        auto* err = reinterpret_cast<nlmsgerr*>(NLMSG_DATA(nh));
+        if (err->error == 0) return true; /* ack */
+        errno = -err->error;
+        return h->fail("netlink error");
+      }
+      cb(nh);
+      if (!(nh->nlmsg_flags & NLM_F_MULTI)) return true;
+    }
+  }
+}
+
+void add_nexthop_attrs(MsgBuilder& msg, const onl_nexthop& nh, int family,
+                       bool in_multipath, size_t rtnh_off) {
+  IpAddr via;
+  bool has_via = nh.via[0] != '\0' && parse_addr(nh.via, &via);
+
+  if (nh.mpls_action == ONL_MPLS_SWAP || nh.mpls_action == ONL_MPLS_PHP) {
+    /* label route nexthop: RTA_NEWDST carries the out-label for SWAP */
+    if (nh.mpls_action == ONL_MPLS_SWAP && nh.num_labels > 0) {
+      uint32_t lse = mpls_lse(nh.labels[0], true);
+      msg.add_attr(RTA_NEWDST, &lse, sizeof(lse));
+    }
+    if (has_via) {
+      /* RTA_VIA: family + raw address */
+      char viabuf[2 + 16];
+      uint16_t fam = via.family;
+      memcpy(viabuf, &fam, 2);
+      memcpy(viabuf + 2, via.bytes, via.len);
+      msg.add_attr(RTA_VIA, viabuf, 2 + via.len);
+    }
+  } else {
+    if (nh.mpls_action == ONL_MPLS_PUSH && nh.num_labels > 0) {
+      /* IP->MPLS: lwtunnel encap */
+      size_t encap = msg.nest_begin(RTA_ENCAP);
+      std::vector<uint32_t> stack;
+      for (int i = 0; i < nh.num_labels; i++) {
+        stack.push_back(mpls_lse(nh.labels[i], i == nh.num_labels - 1));
+      }
+      msg.add_attr(MPLS_IPTUNNEL_DST, stack.data(),
+                   stack.size() * sizeof(uint32_t));
+      msg.nest_end(encap);
+      uint16_t etype = LWTUNNEL_ENCAP_MPLS;
+      msg.add_attr(RTA_ENCAP_TYPE, etype);
+    }
+    if (has_via) {
+      if (via.family == family) {
+        msg.add_attr(RTA_GATEWAY, via.bytes, via.len);
+      } else {
+        /* v4-over-v6 nexthop etc: RTA_VIA */
+        char viabuf[2 + 16];
+        uint16_t fam = via.family;
+        memcpy(viabuf, &fam, 2);
+        memcpy(viabuf + 2, via.bytes, via.len);
+        msg.add_attr(RTA_VIA, viabuf, 2 + via.len);
+      }
+    }
+  }
+  if (!in_multipath && nh.ifindex > 0) {
+    uint32_t oif = nh.ifindex;
+    msg.add_attr(RTA_OIF, oif);
+  }
+  if (in_multipath) {
+    auto* rtnh = msg.rtnh_at(rtnh_off);
+    rtnh->rtnh_ifindex = nh.ifindex;
+    rtnh->rtnh_hops = nh.weight > 0 ? nh.weight - 1 : 0;
+  }
+}
+
+} /* namespace */
+
+/* ================= C ABI ================= */
+
+extern "C" {
+
+void* onl_open(void) {
+  auto* h = new Handle();
+  if (!open_socket(&h->fd, 0)) {
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void onl_close(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  if (!h) return;
+  if (h->fd >= 0) close(h->fd);
+  if (h->event_fd >= 0) close(h->event_fd);
+  delete h;
+}
+
+const char* onl_strerror(void* hv) {
+  return static_cast<Handle*>(hv)->error.c_str();
+}
+
+int onl_get_links(void* hv, onl_link* out, int max) {
+  auto* h = static_cast<Handle*>(hv);
+  MsgBuilder msg(RTM_GETLINK, NLM_F_REQUEST | NLM_F_DUMP, 0);
+  auto* ifi = msg.add_payload<ifinfomsg>();
+  ifi->ifi_family = AF_UNSPEC;
+  int count = 0;
+  bool ok = transact(h, msg, [&](nlmsghdr* nh) {
+    if (nh->nlmsg_type != RTM_NEWLINK || count >= max) return;
+    auto* m = reinterpret_cast<ifinfomsg*>(NLMSG_DATA(nh));
+    onl_link& l = out[count];
+    memset(&l, 0, sizeof(l));
+    l.ifindex = m->ifi_index;
+    l.up = (m->ifi_flags & IFF_UP) ? 1 : 0;
+    int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+    for (auto* rta = IFLA_RTA(m); RTA_OK(rta, len);
+         rta = RTA_NEXT(rta, len)) {
+      if (rta->rta_type == IFLA_IFNAME) {
+        snprintf(l.name, sizeof(l.name), "%s",
+                 static_cast<char*>(RTA_DATA(rta)));
+      }
+    }
+    count++;
+  });
+  return ok ? count : -1;
+}
+
+int onl_get_addrs(void* hv, onl_addr* out, int max) {
+  auto* h = static_cast<Handle*>(hv);
+  MsgBuilder msg(RTM_GETADDR, NLM_F_REQUEST | NLM_F_DUMP, 0);
+  auto* ifa = msg.add_payload<ifaddrmsg>();
+  ifa->ifa_family = AF_UNSPEC;
+  int count = 0;
+  bool ok = transact(h, msg, [&](nlmsghdr* nh) {
+    if (nh->nlmsg_type != RTM_NEWADDR || count >= max) return;
+    auto* m = reinterpret_cast<ifaddrmsg*>(NLMSG_DATA(nh));
+    onl_addr& a = out[count];
+    memset(&a, 0, sizeof(a));
+    a.ifindex = m->ifa_index;
+    a.prefixlen = m->ifa_prefixlen;
+    a.family = m->ifa_family;
+    int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+    bool have = false;
+    for (auto* rta = IFA_RTA(m); RTA_OK(rta, len);
+         rta = RTA_NEXT(rta, len)) {
+      if (rta->rta_type == IFA_ADDRESS || rta->rta_type == IFA_LOCAL) {
+        format_addr(m->ifa_family, RTA_DATA(rta), a.addr, sizeof(a.addr));
+        have = true;
+        if (rta->rta_type == IFA_LOCAL) break; /* prefer local */
+      }
+    }
+    if (have) count++;
+  });
+  return ok ? count : -1;
+}
+
+static int addr_op(Handle* h, uint16_t op, uint16_t flags, int ifindex,
+                   const char* addr, int prefixlen) {
+  IpAddr ip;
+  if (!parse_addr(addr, &ip)) {
+    h->error = "bad address";
+    return -1;
+  }
+  MsgBuilder msg(op, NLM_F_REQUEST | NLM_F_ACK | flags, 0);
+  auto* ifa = msg.add_payload<ifaddrmsg>();
+  ifa->ifa_family = ip.family;
+  ifa->ifa_prefixlen = prefixlen;
+  ifa->ifa_index = ifindex;
+  msg.add_attr(IFA_LOCAL, ip.bytes, ip.len);
+  msg.add_attr(IFA_ADDRESS, ip.bytes, ip.len);
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+int onl_add_addr(void* hv, int ifindex, const char* addr, int prefixlen) {
+  return addr_op(static_cast<Handle*>(hv), RTM_NEWADDR,
+                 NLM_F_CREATE | NLM_F_REPLACE, ifindex, addr, prefixlen);
+}
+
+int onl_del_addr(void* hv, int ifindex, const char* addr, int prefixlen) {
+  return addr_op(static_cast<Handle*>(hv), RTM_DELADDR, 0, ifindex, addr,
+                 prefixlen);
+}
+
+int onl_add_unicast_route(void* hv, const char* dest, int proto, int table,
+                          const onl_nexthop* nhs, int n_nhs, int replace) {
+  auto* h = static_cast<Handle*>(hv);
+  IpAddr dst;
+  int prefixlen = 0;
+  if (!parse_prefix(dest, &dst, &prefixlen)) {
+    h->error = "bad prefix";
+    return -1;
+  }
+  uint16_t flags = NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE;
+  if (replace) flags |= NLM_F_REPLACE;
+  MsgBuilder msg(RTM_NEWROUTE, flags, 0);
+  auto* rtm = msg.add_payload<rtmsg>();
+  rtm->rtm_family = dst.family;
+  rtm->rtm_dst_len = prefixlen;
+  rtm->rtm_table = RT_TABLE_UNSPEC;
+  rtm->rtm_protocol = proto;
+  rtm->rtm_scope = RT_SCOPE_UNIVERSE;
+  rtm->rtm_type = RTN_UNICAST;
+  uint32_t tbl = table;
+  msg.add_attr(RTA_TABLE, tbl);
+  msg.add_attr(RTA_DST, dst.bytes, dst.len);
+
+  if (n_nhs == 1) {
+    add_nexthop_attrs(msg, nhs[0], dst.family, false, 0);
+  } else {
+    size_t mp = msg.nest_begin(RTA_MULTIPATH);
+    for (int i = 0; i < n_nhs; i++) {
+      size_t off = msg.rtnh_begin();
+      add_nexthop_attrs(msg, nhs[i], dst.family, true, off);
+      msg.rtnh_end(off);
+    }
+    msg.nest_end(mp);
+  }
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+int onl_del_unicast_route(void* hv, const char* dest, int proto, int table) {
+  auto* h = static_cast<Handle*>(hv);
+  IpAddr dst;
+  int prefixlen = 0;
+  if (!parse_prefix(dest, &dst, &prefixlen)) {
+    h->error = "bad prefix";
+    return -1;
+  }
+  MsgBuilder msg(RTM_DELROUTE, NLM_F_REQUEST | NLM_F_ACK, 0);
+  auto* rtm = msg.add_payload<rtmsg>();
+  rtm->rtm_family = dst.family;
+  rtm->rtm_dst_len = prefixlen;
+  rtm->rtm_table = RT_TABLE_UNSPEC;
+  rtm->rtm_protocol = proto;
+  uint32_t tbl = table;
+  msg.add_attr(RTA_TABLE, tbl);
+  msg.add_attr(RTA_DST, dst.bytes, dst.len);
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+int onl_add_mpls_route(void* hv, int label, const onl_nexthop* nhs, int n_nhs,
+                       int replace) {
+  auto* h = static_cast<Handle*>(hv);
+  uint16_t flags = NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE;
+  if (replace) flags |= NLM_F_REPLACE;
+  MsgBuilder msg(RTM_NEWROUTE, flags, 0);
+  auto* rtm = msg.add_payload<rtmsg>();
+  rtm->rtm_family = AF_MPLS;
+  rtm->rtm_dst_len = 20; /* label length in bits */
+  rtm->rtm_table = RT_TABLE_MAIN;
+  rtm->rtm_protocol = RTPROT_STATIC;
+  rtm->rtm_scope = RT_SCOPE_UNIVERSE;
+  rtm->rtm_type = RTN_UNICAST;
+  uint32_t in_lse = mpls_lse(label, true);
+  msg.add_attr(RTA_DST, &in_lse, sizeof(in_lse));
+  if (n_nhs == 1) {
+    add_nexthop_attrs(msg, nhs[0], AF_MPLS, false, 0);
+  } else {
+    size_t mp = msg.nest_begin(RTA_MULTIPATH);
+    for (int i = 0; i < n_nhs; i++) {
+      size_t off = msg.rtnh_begin();
+      add_nexthop_attrs(msg, nhs[i], AF_MPLS, true, off);
+      msg.rtnh_end(off);
+    }
+    msg.nest_end(mp);
+  }
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+int onl_del_mpls_route(void* hv, int label) {
+  auto* h = static_cast<Handle*>(hv);
+  MsgBuilder msg(RTM_DELROUTE, NLM_F_REQUEST | NLM_F_ACK, 0);
+  auto* rtm = msg.add_payload<rtmsg>();
+  rtm->rtm_family = AF_MPLS;
+  rtm->rtm_dst_len = 20;
+  rtm->rtm_table = RT_TABLE_MAIN;
+  uint32_t in_lse = mpls_lse(label, true);
+  msg.add_attr(RTA_DST, &in_lse, sizeof(in_lse));
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+namespace {
+
+/* append "via,ifindex,weight" (+ ",swap:l" / ",push:l1/l2") */
+void format_nexthop(std::string* line, const char* via, int ifindex,
+                    int weight, const uint32_t* labels, int n_labels,
+                    int action) {
+  char tmp[160];
+  snprintf(tmp, sizeof(tmp), "%s,%d,%d", via, ifindex, weight);
+  *line += tmp;
+  if (action == ONL_MPLS_SWAP || action == ONL_MPLS_PUSH) {
+    *line += action == ONL_MPLS_SWAP ? ",swap:" : ",push:";
+    for (int i = 0; i < n_labels; i++) {
+      if (i) *line += '/';
+      snprintf(tmp, sizeof(tmp), "%u", labels[i]);
+      *line += tmp;
+    }
+  } else if (action == ONL_MPLS_PHP) {
+    *line += ",php";
+  }
+}
+
+/* parse one nexthop attr set (top-level or inside rtnexthop) */
+void parse_nh_attrs(int family, rtattr* rta, int len, int ifindex_hint,
+                    int weight, std::string* line) {
+  char via[64] = "";
+  int ifindex = ifindex_hint;
+  uint32_t labels[8];
+  int n_labels = 0;
+  int action = ONL_MPLS_NONE;
+  if (family == AF_MPLS) action = ONL_MPLS_PHP; /* no NEWDST => pop */
+  for (; RTA_OK(rta, len); rta = RTA_NEXT(rta, len)) {
+    switch (rta->rta_type) {
+      case RTA_GATEWAY:
+        format_addr(family, RTA_DATA(rta), via, sizeof(via));
+        break;
+      case RTA_VIA: {
+        auto* p = static_cast<char*>(RTA_DATA(rta));
+        uint16_t fam;
+        memcpy(&fam, p, 2);
+        format_addr(fam, p + 2, via, sizeof(via));
+        break;
+      }
+      case RTA_OIF:
+        ifindex = *static_cast<int32_t*>(RTA_DATA(rta));
+        break;
+      case RTA_NEWDST: {
+        auto* lse = static_cast<uint32_t*>(RTA_DATA(rta));
+        int cnt = RTA_PAYLOAD(rta) / 4;
+        action = ONL_MPLS_SWAP;
+        for (int i = 0; i < cnt && i < 8; i++) {
+          labels[n_labels++] =
+              (ntohl(lse[i]) & MPLS_LS_LABEL_MASK) >> MPLS_LS_LABEL_SHIFT;
+        }
+        break;
+      }
+      case RTA_ENCAP: {
+        auto* erta = static_cast<rtattr*>(RTA_DATA(rta));
+        int elen = RTA_PAYLOAD(rta);
+        for (; RTA_OK(erta, elen); erta = RTA_NEXT(erta, elen)) {
+          if (erta->rta_type == MPLS_IPTUNNEL_DST) {
+            auto* lse = static_cast<uint32_t*>(RTA_DATA(erta));
+            int cnt = RTA_PAYLOAD(erta) / 4;
+            action = ONL_MPLS_PUSH;
+            for (int i = 0; i < cnt && i < 8; i++) {
+              labels[n_labels++] =
+                  (ntohl(lse[i]) & MPLS_LS_LABEL_MASK) >> MPLS_LS_LABEL_SHIFT;
+            }
+          }
+        }
+        break;
+      }
+    }
+  }
+  format_nexthop(line, via, ifindex, weight, labels, n_labels, action);
+}
+
+} /* namespace */
+
+int onl_get_routes(void* hv, int family, int proto, int table, char* buf,
+                   int buflen) {
+  auto* h = static_cast<Handle*>(hv);
+  MsgBuilder msg(RTM_GETROUTE, NLM_F_REQUEST | NLM_F_DUMP, 0);
+  auto* rtm = msg.add_payload<rtmsg>();
+  rtm->rtm_family = family;
+  std::string out;
+  int count = 0;
+  bool ok = transact(h, msg, [&](nlmsghdr* nh) {
+    if (nh->nlmsg_type != RTM_NEWROUTE) return;
+    auto* m = reinterpret_cast<rtmsg*>(NLMSG_DATA(nh));
+    if (family != 0 && m->rtm_family != family) return;
+    if (family == 0 &&
+        (m->rtm_family != AF_INET && m->rtm_family != AF_INET6)) {
+      return;
+    }
+    if (proto != 0 && m->rtm_protocol != proto) return;
+    int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+    uint32_t rt_table = m->rtm_table;
+    /* first pass: find RTA_TABLE + RTA_DST */
+    char dst[80] = "";
+    rtattr* multipath = nullptr;
+    for (auto* rta = RTM_RTA(m); RTA_OK(rta, len);
+         rta = RTA_NEXT(rta, len)) {
+      if (rta->rta_type == RTA_TABLE) {
+        rt_table = *static_cast<uint32_t*>(RTA_DATA(rta));
+      } else if (rta->rta_type == RTA_DST) {
+        if (m->rtm_family == AF_MPLS) {
+          auto* lse = static_cast<uint32_t*>(RTA_DATA(rta));
+          snprintf(dst, sizeof(dst), "mpls:%u",
+                   (ntohl(*lse) & MPLS_LS_LABEL_MASK) >> MPLS_LS_LABEL_SHIFT);
+        } else {
+          char a[64];
+          format_addr(m->rtm_family, RTA_DATA(rta), a, sizeof(a));
+          snprintf(dst, sizeof(dst), "%s/%d", a, m->rtm_dst_len);
+        }
+      } else if (rta->rta_type == RTA_MULTIPATH) {
+        multipath = rta;
+      }
+    }
+    if (table != 0 && rt_table != static_cast<uint32_t>(table)) return;
+    if (dst[0] == '\0') {
+      if (m->rtm_family == AF_MPLS) return;
+      snprintf(dst, sizeof(dst), "%s/0",
+               m->rtm_family == AF_INET ? "0.0.0.0" : "::");
+    }
+    std::string line(dst);
+    line += '|';
+    if (multipath != nullptr) {
+      auto* rtnh = static_cast<rtnexthop*>(RTA_DATA(multipath));
+      int mplen = RTA_PAYLOAD(multipath);
+      bool first = true;
+      while (RTNH_OK(rtnh, mplen)) {
+        if (!first) line += ';';
+        first = false;
+        parse_nh_attrs(m->rtm_family, RTNH_DATA(rtnh),
+                       rtnh->rtnh_len - RTNH_LENGTH(0), rtnh->rtnh_ifindex,
+                       rtnh->rtnh_hops + 1, &line);
+        mplen -= RTNH_ALIGN(rtnh->rtnh_len);
+        rtnh = RTNH_NEXT(rtnh);
+      }
+    } else {
+      int len2 = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+      parse_nh_attrs(m->rtm_family, RTM_RTA(m), len2, 0, 1, &line);
+    }
+    line += '\n';
+    out += line;
+    count++;
+  });
+  if (!ok) return -1;
+  if (static_cast<int>(out.size()) >= buflen) {
+    h->error = "route dump buffer too small";
+    return -1;
+  }
+  memcpy(buf, out.c_str(), out.size() + 1);
+  return count;
+}
+
+int onl_subscribe(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  if (h->event_fd >= 0) return 0;
+  uint32_t groups = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR;
+  if (!open_socket(&h->event_fd, groups)) {
+    h->fail("event socket");
+    return -1;
+  }
+  return 0;
+}
+
+int onl_event_fd(void* hv) {
+  return static_cast<Handle*>(hv)->event_fd;
+}
+
+int onl_next_event(void* hv, onl_event* out) {
+  auto* h = static_cast<Handle*>(hv);
+  if (h->event_fd < 0) {
+    h->error = "not subscribed";
+    return -1;
+  }
+  ssize_t n = recv(h->event_fd, h->evbuf, sizeof(h->evbuf), MSG_DONTWAIT);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    h->fail("event recv");
+    return -1;
+  }
+  for (auto* nh = reinterpret_cast<nlmsghdr*>(h->evbuf); NLMSG_OK(nh, n);
+       nh = NLMSG_NEXT(nh, n)) {
+    memset(out, 0, sizeof(*out));
+    if (nh->nlmsg_type == RTM_NEWLINK || nh->nlmsg_type == RTM_DELLINK) {
+      auto* m = reinterpret_cast<ifinfomsg*>(NLMSG_DATA(nh));
+      out->kind = 1;
+      out->ifindex = m->ifi_index;
+      out->up = (nh->nlmsg_type == RTM_NEWLINK && (m->ifi_flags & IFF_UP))
+                    ? 1
+                    : 0;
+      int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+      for (auto* rta = IFLA_RTA(m); RTA_OK(rta, len);
+           rta = RTA_NEXT(rta, len)) {
+        if (rta->rta_type == IFLA_IFNAME) {
+          snprintf(out->name, sizeof(out->name), "%s",
+                   static_cast<char*>(RTA_DATA(rta)));
+        }
+      }
+      return 1;
+    }
+    if (nh->nlmsg_type == RTM_NEWADDR || nh->nlmsg_type == RTM_DELADDR) {
+      auto* m = reinterpret_cast<ifaddrmsg*>(NLMSG_DATA(nh));
+      out->kind = 2;
+      out->ifindex = m->ifa_index;
+      out->up = nh->nlmsg_type == RTM_NEWADDR ? 1 : 0;
+      out->prefixlen = m->ifa_prefixlen;
+      int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+      for (auto* rta = IFA_RTA(m); RTA_OK(rta, len);
+           rta = RTA_NEXT(rta, len)) {
+        if (rta->rta_type == IFA_ADDRESS || rta->rta_type == IFA_LOCAL) {
+          format_addr(m->ifa_family, RTA_DATA(rta), out->addr,
+                      sizeof(out->addr));
+        }
+      }
+      return 1;
+    }
+  }
+  return 0;
+}
+
+} /* extern "C" */

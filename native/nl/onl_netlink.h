@@ -1,0 +1,106 @@
+/*
+ * openr-tpu native netlink library — C ABI.
+ *
+ * Native equivalent of the reference's from-scratch rtnetlink stack
+ * (openr/nl/NetlinkProtocolSocket.h:92, NetlinkMessage.h:143,
+ * NetlinkTypes.h, NetlinkRoute.cpp): message serialization, seq-numbered
+ * request/ack matching, dump iteration, route/link/addr object model and
+ * MPLS route support — redesigned as a compact synchronous C++17 core with
+ * a flat C ABI so the Python control plane binds via ctypes (no pybind11 in
+ * this image). Blocking is bounded: every transaction is a single
+ * send+drain on a socket with a receive timeout.
+ */
+
+#ifndef OPENR_TPU_ONL_NETLINK_H
+#define OPENR_TPU_ONL_NETLINK_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* MPLS nexthop actions (mirrors openr/if/Network.thrift MplsActionCode) */
+enum onl_mpls_action {
+  ONL_MPLS_NONE = 0,
+  ONL_MPLS_PUSH = 1,
+  ONL_MPLS_SWAP = 2,
+  ONL_MPLS_PHP = 3, /* pop-and-forward */
+};
+
+typedef struct onl_link {
+  int32_t ifindex;
+  int32_t up; /* IFF_UP && IFF_RUNNING */
+  char name[32];
+} onl_link;
+
+typedef struct onl_addr {
+  int32_t ifindex;
+  int32_t prefixlen;
+  int32_t family; /* AF_INET / AF_INET6 */
+  char addr[64];  /* presentation form */
+} onl_addr;
+
+typedef struct onl_nexthop {
+  char via[64];  /* gateway address, presentation form; "" = direct */
+  int32_t ifindex;
+  int32_t weight;      /* ECMP weight, 0 => 1 */
+  int32_t mpls_action; /* enum onl_mpls_action */
+  int32_t num_labels;
+  int32_t labels[8]; /* PUSH: label stack (top first); SWAP: labels[0] */
+} onl_nexthop;
+
+typedef struct onl_event {
+  int32_t kind; /* 1=link 2=addr 3=route */
+  int32_t ifindex;
+  int32_t up;        /* link: admin+oper up; addr: 1=added 0=deleted */
+  int32_t prefixlen; /* addr only */
+  char name[32];     /* link name */
+  char addr[64];     /* addr, presentation form */
+} onl_event;
+
+/* Lifecycle. onl_open returns NULL on failure. */
+void* onl_open(void);
+void onl_close(void* h);
+/* Last error string for this handle (valid until next call). */
+const char* onl_strerror(void* h);
+
+/* Link / address dumps. Return count written (<= max), or -1 on error. */
+int onl_get_links(void* h, onl_link* out, int max);
+int onl_get_addrs(void* h, onl_addr* out, int max);
+
+/* Interface address management (NetlinkSystemHandler equivalent). */
+int onl_add_addr(void* h, int ifindex, const char* addr, int prefixlen);
+int onl_del_addr(void* h, int ifindex, const char* addr, int prefixlen);
+
+/* Unicast routes. dest is "addr/len". Multi-nexthop => RTA_MULTIPATH ECMP.
+ * Returns 0 on success, -1 on error. replace=1 uses NLM_F_REPLACE. */
+int onl_add_unicast_route(void* h, const char* dest, int proto, int table,
+                          const onl_nexthop* nhs, int n_nhs, int replace);
+int onl_del_unicast_route(void* h, const char* dest, int proto, int table);
+
+/* MPLS label routes (AF_MPLS): swap/php per nexthop. */
+int onl_add_mpls_route(void* h, int label, const onl_nexthop* nhs, int n_nhs,
+                       int replace);
+int onl_del_mpls_route(void* h, int label);
+
+/* Dump routes for (proto, table). Writes one route per line into buf:
+ *   dest|via,ifindex,weight[,action:l1/l2];via,ifindex,weight...
+ * Returns number of routes, or -1 on error. family: AF_INET/AF_INET6/
+ * AF_MPLS/0 (0 = v4+v6). */
+int onl_get_routes(void* h, int family, int proto, int table, char* buf,
+                   int buflen);
+
+/* Event subscription (PlatformPublisher equivalent): join RTNLGRP_LINK +
+ * v4/v6 IFADDR groups on a second socket. onl_event_fd can be polled from
+ * an event loop; onl_next_event is non-blocking (returns 1 = event, 0 =
+ * none, -1 = error). */
+int onl_subscribe(void* h);
+int onl_event_fd(void* h);
+int onl_next_event(void* h, onl_event* out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* OPENR_TPU_ONL_NETLINK_H */

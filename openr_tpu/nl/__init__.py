@@ -1,0 +1,26 @@
+"""Native netlink bindings (ctypes over native/nl/libopenr_nl.so).
+
+Python-side equivalent of the reference's netlink object model
+(openr/nl/NetlinkTypes.h, NetlinkSocket.h) on top of the native protocol
+core (native/nl/onl_netlink.cpp ≙ openr/nl/NetlinkProtocolSocket.{h,cpp}).
+"""
+
+from openr_tpu.nl.netlink import (
+    Link,
+    IfAddress,
+    NetlinkError,
+    NetlinkSocket,
+    NlNextHop,
+    NlRoute,
+    native_available,
+)
+
+__all__ = [
+    "Link",
+    "IfAddress",
+    "NetlinkError",
+    "NetlinkSocket",
+    "NlNextHop",
+    "NlRoute",
+    "native_available",
+]

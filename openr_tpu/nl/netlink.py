@@ -1,0 +1,414 @@
+"""ctypes bindings for the native netlink library.
+
+Auto-builds openr_tpu/_native/libopenr_nl.so from native/nl via `make` on
+first use if the artifact is missing (the image bakes g++; no pip installs).
+All calls are thin wrappers over the C ABI in native/nl/onl_netlink.h; the
+blocking transactional calls are fast (single send+drain), so async callers
+run them via loop.run_in_executor (see platform/netlink_fib.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libopenr_nl.so")
+_MAKE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
+)
+
+# openr programs kernel routes with its own protocol id so it can identify
+# and clean its routes (reference uses protocol 99, openr/nl docs)
+RT_PROT_OPENR = 99
+RT_TABLE_MAIN = 254
+
+MPLS_NONE, MPLS_PUSH, MPLS_SWAP, MPLS_PHP = 0, 1, 2, 3
+
+
+class NetlinkError(RuntimeError):
+    pass
+
+
+class _CLink(ctypes.Structure):
+    _fields_ = [
+        ("ifindex", ctypes.c_int32),
+        ("up", ctypes.c_int32),
+        ("name", ctypes.c_char * 32),
+    ]
+
+
+class _CAddr(ctypes.Structure):
+    _fields_ = [
+        ("ifindex", ctypes.c_int32),
+        ("prefixlen", ctypes.c_int32),
+        ("family", ctypes.c_int32),
+        ("addr", ctypes.c_char * 64),
+    ]
+
+
+class _CNextHop(ctypes.Structure):
+    _fields_ = [
+        ("via", ctypes.c_char * 64),
+        ("ifindex", ctypes.c_int32),
+        ("weight", ctypes.c_int32),
+        ("mpls_action", ctypes.c_int32),
+        ("num_labels", ctypes.c_int32),
+        ("labels", ctypes.c_int32 * 8),
+    ]
+
+
+class _CEvent(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("ifindex", ctypes.c_int32),
+        ("up", ctypes.c_int32),
+        ("prefixlen", ctypes.c_int32),
+        ("name", ctypes.c_char * 32),
+        ("addr", ctypes.c_char * 64),
+    ]
+
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+def _build_native() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _MAKE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_error
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH) and not _build_native():
+        _lib_error = "libopenr_nl.so missing and native build failed"
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.onl_open.restype = ctypes.c_void_p
+    lib.onl_close.argtypes = [ctypes.c_void_p]
+    lib.onl_strerror.argtypes = [ctypes.c_void_p]
+    lib.onl_strerror.restype = ctypes.c_char_p
+    lib.onl_get_links.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(_CLink),
+        ctypes.c_int,
+    ]
+    lib.onl_get_addrs.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(_CAddr),
+        ctypes.c_int,
+    ]
+    lib.onl_add_addr.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.onl_del_addr.argtypes = lib.onl_add_addr.argtypes
+    lib.onl_add_unicast_route.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(_CNextHop),
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.onl_del_unicast_route.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.onl_add_mpls_route.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(_CNextHop),
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.onl_del_mpls_route.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.onl_get_routes.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.onl_subscribe.argtypes = [ctypes.c_void_p]
+    lib.onl_event_fd.argtypes = [ctypes.c_void_p]
+    lib.onl_next_event.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CEvent)]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    """True if the native library loads and a netlink socket can open."""
+    lib = _load()
+    if lib is None:
+        return False
+    h = lib.onl_open()
+    if not h:
+        return False
+    lib.onl_close(h)
+    return True
+
+
+@dataclass(frozen=True)
+class Link:
+    ifindex: int
+    name: str
+    is_up: bool
+
+
+@dataclass(frozen=True)
+class IfAddress:
+    ifindex: int
+    addr: str
+    prefixlen: int
+    family: int
+
+
+@dataclass(frozen=True)
+class NlNextHop:
+    """Kernel-facing nexthop (openr/nl/NetlinkTypes.h NextHop builder)."""
+
+    via: str = ""
+    ifindex: int = 0
+    weight: int = 1
+    mpls_action: int = MPLS_NONE
+    labels: Tuple[int, ...] = ()
+
+
+@dataclass
+class NlRoute:
+    """Kernel-facing route (openr/nl/NetlinkTypes.h Route builder)."""
+
+    dest: str  # "addr/len" or "mpls:<label>"
+    nexthops: List[NlNextHop] = field(default_factory=list)
+
+
+class NetlinkSocket:
+    """RAII handle over the native protocol socket.
+
+    Mirrors openr/nl/NetlinkSocket.h surface: link/addr dumps, route
+    add/del/dump (unicast v4/v6 + MPLS), addr management, event reads.
+    """
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise NetlinkError(_lib_error or "native library unavailable")
+        self._lib = lib
+        self._h = lib.onl_open()
+        if not self._h:
+            raise NetlinkError("failed to open netlink socket")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.onl_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NetlinkSocket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _err(self) -> str:
+        return self._lib.onl_strerror(self._h).decode()
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc < 0:
+            raise NetlinkError(f"{what}: {self._err()}")
+
+    # -- dumps -----------------------------------------------------------
+
+    def get_links(self) -> List[Link]:
+        arr = (_CLink * 1024)()
+        n = self._lib.onl_get_links(self._h, arr, 1024)
+        self._check(n, "get_links")
+        return [
+            Link(a.ifindex, a.name.decode(), bool(a.up)) for a in arr[:n]
+        ]
+
+    def get_addrs(self) -> List[IfAddress]:
+        arr = (_CAddr * 4096)()
+        n = self._lib.onl_get_addrs(self._h, arr, 4096)
+        self._check(n, "get_addrs")
+        return [
+            IfAddress(a.ifindex, a.addr.decode(), a.prefixlen, a.family)
+            for a in arr[:n]
+        ]
+
+    # -- addresses -------------------------------------------------------
+
+    def add_addr(self, ifindex: int, addr: str, prefixlen: int) -> None:
+        self._check(
+            self._lib.onl_add_addr(
+                self._h, ifindex, addr.encode(), prefixlen
+            ),
+            "add_addr",
+        )
+
+    def del_addr(self, ifindex: int, addr: str, prefixlen: int) -> None:
+        self._check(
+            self._lib.onl_del_addr(
+                self._h, ifindex, addr.encode(), prefixlen
+            ),
+            "del_addr",
+        )
+
+    # -- routes ----------------------------------------------------------
+
+    @staticmethod
+    def _c_nexthops(nexthops: List[NlNextHop]):
+        arr = (_CNextHop * max(1, len(nexthops)))()
+        for i, nh in enumerate(nexthops):
+            arr[i].via = nh.via.encode()
+            arr[i].ifindex = nh.ifindex
+            arr[i].weight = nh.weight
+            arr[i].mpls_action = nh.mpls_action
+            arr[i].num_labels = len(nh.labels)
+            for j, label in enumerate(nh.labels[:8]):
+                arr[i].labels[j] = label
+        return arr
+
+    def add_unicast_route(
+        self,
+        dest: str,
+        nexthops: List[NlNextHop],
+        proto: int = RT_PROT_OPENR,
+        table: int = RT_TABLE_MAIN,
+        replace: bool = True,
+    ) -> None:
+        assert nexthops, "route needs at least one nexthop"
+        arr = self._c_nexthops(nexthops)
+        self._check(
+            self._lib.onl_add_unicast_route(
+                self._h,
+                dest.encode(),
+                proto,
+                table,
+                arr,
+                len(nexthops),
+                1 if replace else 0,
+            ),
+            f"add_unicast_route {dest}",
+        )
+
+    def del_unicast_route(
+        self,
+        dest: str,
+        proto: int = RT_PROT_OPENR,
+        table: int = RT_TABLE_MAIN,
+    ) -> None:
+        self._check(
+            self._lib.onl_del_unicast_route(
+                self._h, dest.encode(), proto, table
+            ),
+            f"del_unicast_route {dest}",
+        )
+
+    def add_mpls_route(
+        self, label: int, nexthops: List[NlNextHop], replace: bool = True
+    ) -> None:
+        assert nexthops
+        arr = self._c_nexthops(nexthops)
+        self._check(
+            self._lib.onl_add_mpls_route(
+                self._h, label, arr, len(nexthops), 1 if replace else 0
+            ),
+            f"add_mpls_route {label}",
+        )
+
+    def del_mpls_route(self, label: int) -> None:
+        self._check(
+            self._lib.onl_del_mpls_route(self._h, label),
+            f"del_mpls_route {label}",
+        )
+
+    def get_routes(
+        self,
+        family: int = 0,
+        proto: int = RT_PROT_OPENR,
+        table: int = RT_TABLE_MAIN,
+    ) -> List[NlRoute]:
+        buf = ctypes.create_string_buffer(1 << 22)
+        n = self._lib.onl_get_routes(
+            self._h, family, proto, table, buf, len(buf)
+        )
+        self._check(n, "get_routes")
+        routes: List[NlRoute] = []
+        for line in buf.value.decode().splitlines():
+            if not line:
+                continue
+            dest, _, nhs = line.partition("|")
+            route = NlRoute(dest)
+            for part in nhs.split(";"):
+                if not part:
+                    continue
+                fields = part.split(",")
+                via, ifindex, weight = (
+                    fields[0],
+                    int(fields[1]),
+                    int(fields[2]),
+                )
+                action, labels = MPLS_NONE, ()
+                if len(fields) > 3:
+                    tag = fields[3]
+                    if tag.startswith("swap:"):
+                        action = MPLS_SWAP
+                        labels = tuple(
+                            int(x) for x in tag[5:].split("/") if x
+                        )
+                    elif tag.startswith("push:"):
+                        action = MPLS_PUSH
+                        labels = tuple(
+                            int(x) for x in tag[5:].split("/") if x
+                        )
+                    elif tag == "php":
+                        action = MPLS_PHP
+                route.nexthops.append(
+                    NlNextHop(via, ifindex, weight, action, labels)
+                )
+            routes.append(route)
+        return routes
+
+    # -- events ----------------------------------------------------------
+
+    def subscribe(self) -> int:
+        """Join link/addr multicast groups; returns pollable fd."""
+        self._check(self._lib.onl_subscribe(self._h), "subscribe")
+        return self._lib.onl_event_fd(self._h)
+
+    def next_event(self):
+        """Non-blocking event read → (kind, ifindex, up, name, addr,
+        prefixlen) or None."""
+        ev = _CEvent()
+        rc = self._lib.onl_next_event(self._h, ctypes.byref(ev))
+        self._check(rc, "next_event")
+        if rc == 0:
+            return None
+        return (
+            ev.kind,
+            ev.ifindex,
+            bool(ev.up),
+            ev.name.decode(),
+            ev.addr.decode(),
+            ev.prefixlen,
+        )

@@ -12,10 +12,13 @@ from openr_tpu.platform.fib_service import (
     MockFibHandler,
     PlatformError,
 )
+from openr_tpu.platform.netlink_fib import NetlinkFibHandler, NetlinkPublisher
 
 __all__ = [
     "FIB_CLIENT_OPENR",
     "FibService",
     "MockFibHandler",
+    "NetlinkFibHandler",
+    "NetlinkPublisher",
     "PlatformError",
 ]

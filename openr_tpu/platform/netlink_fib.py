@@ -1,0 +1,311 @@
+"""Linux FIB agent: FibService implemented over the native netlink library.
+
+Equivalent of openr/platform/NetlinkFibHandler.{h,cpp}: programs unicast +
+MPLS routes into the kernel FIB tagged with openr's protocol id; syncFib
+diffs the kernel's current openr-owned routes against the desired set and
+applies adds/deletes (NetlinkFibHandler::syncFib semantics). Blocking
+netlink transactions run on the default executor so the asyncio control
+plane never stalls.
+
+Also hosts NetlinkPublisher — the PlatformPublisher equivalent
+(openr/platform/PlatformPublisher.h:33): subscribes to kernel link/addr
+multicast groups and feeds link events straight into LinkMonitor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from openr_tpu.nl import NetlinkError, NetlinkSocket, NlNextHop, NlRoute
+from openr_tpu.nl.netlink import (
+    MPLS_NONE,
+    MPLS_PHP,
+    MPLS_PUSH,
+    MPLS_SWAP,
+    RT_PROT_OPENR,
+    RT_TABLE_MAIN,
+)
+from openr_tpu.platform.fib_service import FibService, PlatformError
+from openr_tpu.types import (
+    IpPrefix,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
+
+log = logging.getLogger(__name__)
+
+_ACTION_TO_NL = {
+    MplsActionCode.PUSH: MPLS_PUSH,
+    MplsActionCode.SWAP: MPLS_SWAP,
+    MplsActionCode.PHP: MPLS_PHP,
+    MplsActionCode.POP_AND_LOOKUP: MPLS_PHP,
+}
+
+
+class NetlinkFibHandler(FibService):
+    """FibService programming the Linux kernel FIB via openr_tpu.nl."""
+
+    def __init__(
+        self,
+        proto: int = RT_PROT_OPENR,
+        table: int = RT_TABLE_MAIN,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.proto = proto
+        self.table = table
+        self._loop = loop
+        self._sock = NetlinkSocket()
+        self._alive_since = int(time.time())
+        # name -> ifindex cache for nexthop iface resolution
+        self._if_index: Dict[str, int] = {}
+        self._refresh_links()
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def _refresh_links(self) -> None:
+        self._if_index = {
+            link.name: link.ifindex for link in self._sock.get_links()
+        }
+
+    def _resolve_ifindex(self, iface: Optional[str]) -> int:
+        if iface is None:
+            return 0
+        idx = self._if_index.get(iface)
+        if idx is None:
+            self._refresh_links()
+            idx = self._if_index.get(iface)
+        if idx is None:
+            raise PlatformError(f"unknown interface {iface}")
+        return idx
+
+    def _to_nl_nexthop(self, nh: NextHop) -> NlNextHop:
+        action, labels = MPLS_NONE, ()
+        if nh.mpls_action is not None:
+            action = _ACTION_TO_NL[nh.mpls_action.action]
+            if nh.mpls_action.action == MplsActionCode.SWAP:
+                labels = (nh.mpls_action.swap_label,)
+            elif nh.mpls_action.action == MplsActionCode.PUSH:
+                labels = tuple(nh.mpls_action.push_labels)
+        # link-local or unspecified gateways program as direct routes
+        via = nh.address
+        if via in ("", "0.0.0.0", "::"):
+            via = ""
+        return NlNextHop(
+            via=via,
+            ifindex=self._resolve_ifindex(nh.iface),
+            weight=max(1, nh.weight),
+            mpls_action=action,
+            labels=labels,
+        )
+
+    async def _run(self, fn: Callable, *args):
+        loop = self._loop or asyncio.get_event_loop()
+        try:
+            return await loop.run_in_executor(None, fn, *args)
+        except NetlinkError as exc:
+            raise PlatformError(str(exc)) from exc
+
+    # -- FibService ------------------------------------------------------
+
+    async def alive_since(self) -> int:
+        return self._alive_since
+
+    async def add_unicast_routes(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        def work() -> None:
+            for route in routes:
+                self._sock.add_unicast_route(
+                    str(route.dest),
+                    [self._to_nl_nexthop(nh) for nh in route.nexthops],
+                    proto=self.proto,
+                    table=self.table,
+                )
+
+        await self._run(work)
+
+    async def delete_unicast_routes(
+        self, client_id: int, prefixes: List[IpPrefix]
+    ) -> None:
+        def work() -> None:
+            for prefix in prefixes:
+                try:
+                    self._sock.del_unicast_route(
+                        str(prefix), proto=self.proto, table=self.table
+                    )
+                except NetlinkError as exc:
+                    if "No such process" not in str(exc):  # ESRCH = gone
+                        raise
+
+        await self._run(work)
+
+    async def sync_fib(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        """Diff-based full sync (NetlinkFibHandler::syncFib)."""
+
+        def work() -> None:
+            desired = {str(r.dest): r for r in routes}
+            current = {
+                r.dest: r
+                for r in self._sock.get_routes(
+                    family=0, proto=self.proto, table=self.table
+                )
+            }
+            for dest in current:
+                if dest not in desired:
+                    self._sock.del_unicast_route(
+                        dest, proto=self.proto, table=self.table
+                    )
+            for dest, route in desired.items():
+                self._sock.add_unicast_route(
+                    dest,
+                    [self._to_nl_nexthop(nh) for nh in route.nexthops],
+                    proto=self.proto,
+                    table=self.table,
+                )
+
+        await self._run(work)
+
+    async def add_mpls_routes(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        def work() -> None:
+            for route in routes:
+                self._sock.add_mpls_route(
+                    route.top_label,
+                    [self._to_nl_nexthop(nh) for nh in route.nexthops],
+                )
+
+        await self._run(work)
+
+    async def delete_mpls_routes(
+        self, client_id: int, labels: List[int]
+    ) -> None:
+        def work() -> None:
+            for label in labels:
+                try:
+                    self._sock.del_mpls_route(label)
+                except NetlinkError as exc:
+                    if "No such process" not in str(exc):
+                        raise
+
+        await self._run(work)
+
+    async def sync_mpls_fib(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        def work() -> None:
+            desired = {r.top_label: r for r in routes}
+            current = self._sock.get_routes(
+                family=28, proto=0, table=0  # AF_MPLS
+            )
+            for r in current:
+                if not r.dest.startswith("mpls:"):
+                    continue
+                label = int(r.dest[5:])
+                if label not in desired:
+                    self._sock.del_mpls_route(label)
+            for label, route in desired.items():
+                self._sock.add_mpls_route(
+                    label, [self._to_nl_nexthop(nh) for nh in route.nexthops]
+                )
+
+        await self._run(work)
+
+    async def get_route_table_by_client(
+        self, client_id: int
+    ) -> List[UnicastRoute]:
+        def work() -> List[NlRoute]:
+            return self._sock.get_routes(
+                family=0, proto=self.proto, table=self.table
+            )
+
+        nl_routes = await self._run(work)
+        index_to_name = {v: k for k, v in self._if_index.items()}
+        out: List[UnicastRoute] = []
+        for r in nl_routes:
+            nexthops = tuple(
+                NextHop(
+                    address=nh.via,
+                    iface=index_to_name.get(nh.ifindex),
+                    weight=nh.weight,
+                )
+                for nh in r.nexthops
+            )
+            out.append(UnicastRoute(IpPrefix(r.dest), nexthops))
+        return out
+
+    async def get_mpls_route_table_by_client(
+        self, client_id: int
+    ) -> List[MplsRoute]:
+        def work() -> List[NlRoute]:
+            return self._sock.get_routes(family=28, proto=0, table=0)
+
+        nl_routes = await self._run(work)
+        out: List[MplsRoute] = []
+        for r in nl_routes:
+            if not r.dest.startswith("mpls:"):
+                continue
+            nexthops = tuple(
+                NextHop(address=nh.via, weight=nh.weight)
+                for nh in r.nexthops
+            )
+            out.append(MplsRoute(int(r.dest[5:]), nexthops))
+        return out
+
+
+class NetlinkPublisher:
+    """Kernel link/addr event pump (PlatformPublisher equivalent).
+
+    Subscribes the native socket to rtnetlink multicast groups and invokes
+    `on_link(ifname, is_up)` / `on_addr(ifindex, addr, prefixlen, added)`
+    callbacks from the asyncio loop — LinkMonitor plugs its
+    update_interface here (the reference routes these through a ZMQ PUB
+    socket; in-process callbacks replace that hop).
+    """
+
+    def __init__(
+        self,
+        on_link: Callable[[str, bool], None],
+        on_addr: Optional[Callable[[int, str, int, bool], None]] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.on_link = on_link
+        self.on_addr = on_addr
+        self._loop = loop
+        self._sock = NetlinkSocket()
+        self._fd: Optional[int] = None
+
+    def start(self) -> None:
+        self._fd = self._sock.subscribe()
+        loop = self._loop or asyncio.get_event_loop()
+        loop.add_reader(self._fd, self._drain)
+
+    def stop(self) -> None:
+        if self._fd is not None:
+            loop = self._loop or asyncio.get_event_loop()
+            loop.remove_reader(self._fd)
+            self._fd = None
+        self._sock.close()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                ev = self._sock.next_event()
+            except NetlinkError:
+                log.exception("netlink event read failed")
+                return
+            if ev is None:
+                return
+            kind, ifindex, up, name, addr, prefixlen = ev
+            if kind == 1 and name:
+                self.on_link(name, up)
+            elif kind == 2 and self.on_addr is not None:
+                self.on_addr(ifindex, addr, prefixlen, up)
