@@ -1,0 +1,21 @@
+"""Distributed allocators over KvStore.
+
+Equivalents of openr/allocators/: RangeAllocator (generic distributed value
+election) and PrefixAllocator (plug-and-play prefix assignment).
+"""
+
+from openr_tpu.allocators.range_allocator import RangeAllocator
+from openr_tpu.allocators.prefix_allocator import (
+    PrefixAllocationMode,
+    PrefixAllocationParams,
+    PrefixAllocator,
+    PrefixAllocatorConfig,
+)
+
+__all__ = [
+    "RangeAllocator",
+    "PrefixAllocationMode",
+    "PrefixAllocationParams",
+    "PrefixAllocator",
+    "PrefixAllocatorConfig",
+]

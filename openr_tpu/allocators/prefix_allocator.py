@@ -1,0 +1,287 @@
+"""Plug-and-play prefix election.
+
+Behavioral port of openr/allocators/PrefixAllocator.{h,cpp}: each node
+elects a unique sub-prefix of a seed prefix and advertises it via
+PrefixManager. Three modes (OpenrConfig.thrift:93-97):
+  - DYNAMIC_LEAF_NODE: learn seed params from the KvStore key
+    'e2e-network-prefix' (Constants.h:109).
+  - DYNAMIC_ROOT_NODE: seed params from config; also advertise them into
+    KvStore for the leaves.
+  - STATIC: a mapping node → prefix under 'e2e-network-allocations'
+    (Constants.h:113).
+The elected sub-prefix index comes from RangeAllocator over
+[0, 2^(alloc_len - seed_len)); the winning index is persisted in the
+config store so reboots retry the same index, and the address can be
+synced onto the loopback interface (PrefixAllocator.cpp:654-699).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import ipaddress
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from openr_tpu.allocators.range_allocator import RangeAllocator
+from openr_tpu.configstore import PersistentStore
+from openr_tpu.kvstore import KvStoreClient
+from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType, Value
+from openr_tpu.utils import serializer
+
+log = logging.getLogger(__name__)
+
+SEED_PREFIX_KEY = "e2e-network-prefix"  # Constants.h:109
+STATIC_ALLOC_KEY = "e2e-network-allocations"  # Constants.h:113
+ALLOC_KEY_MARKER = "allocprefix:"  # Constants.h:199
+CONFIG_STORE_KEY = "prefix-allocator-config"
+
+
+class PrefixAllocationMode(enum.Enum):
+    DYNAMIC_LEAF_NODE = "DYNAMIC_LEAF_NODE"
+    DYNAMIC_ROOT_NODE = "DYNAMIC_ROOT_NODE"
+    STATIC = "STATIC"
+
+
+@dataclass(frozen=True)
+class PrefixAllocationParams:
+    seed_prefix: IpPrefix
+    alloc_prefix_len: int
+
+    def __post_init__(self) -> None:
+        assert self.alloc_prefix_len > self.seed_prefix.prefix_length, (
+            "allocation length must exceed seed prefix length"
+        )
+
+    @property
+    def range_size(self) -> int:
+        return 1 << (self.alloc_prefix_len - self.seed_prefix.prefix_length)
+
+    @staticmethod
+    def parse(text: str) -> "PrefixAllocationParams":
+        """Parse 'fc00:cafe::/56,64' (the KvStore seed-param format)."""
+        seed, _, alloc_len = text.partition(",")
+        return PrefixAllocationParams(IpPrefix(seed), int(alloc_len))
+
+    def encode(self) -> str:
+        return f"{self.seed_prefix},{self.alloc_prefix_len}"
+
+
+def get_nth_prefix(params: PrefixAllocationParams, index: int) -> IpPrefix:
+    """The index-th sub-prefix of alloc_prefix_len under the seed."""
+    assert 0 <= index < params.range_size, index
+    net = params.seed_prefix.network
+    addr_bits = net.max_prefixlen
+    base = int(net.network_address)
+    sub = base | (index << (addr_bits - params.alloc_prefix_len))
+    addr = ipaddress.ip_address(sub)
+    return IpPrefix(f"{addr}/{params.alloc_prefix_len}")
+
+
+@dataclass
+class PrefixAllocatorConfig:
+    node_name: str
+    mode: PrefixAllocationMode = PrefixAllocationMode.DYNAMIC_LEAF_NODE
+    # required for DYNAMIC_ROOT_NODE; ignored otherwise
+    params: Optional[PrefixAllocationParams] = None
+    area: str = "0"
+    set_loopback_addr: bool = False
+    loopback_iface: str = "lo"
+
+
+class PrefixAllocator:
+    def __init__(
+        self,
+        config: PrefixAllocatorConfig,
+        kvstore_client: KvStoreClient,
+        config_store: Optional[PersistentStore] = None,
+        # advertise/withdraw hooks: PrefixManager APIs in the full daemon
+        on_advertise: Optional[Callable[[PrefixEntry], None]] = None,
+        on_withdraw: Optional[Callable[[IpPrefix], None]] = None,
+        system_handler=None,  # NetlinkSocket-like, for loopback addr sync
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.client = kvstore_client
+        self.config_store = config_store
+        self.on_advertise = on_advertise
+        self.on_withdraw = on_withdraw
+        self.system_handler = system_handler
+        self._loop = loop
+        self.params: Optional[PrefixAllocationParams] = None
+        self.my_prefix: Optional[IpPrefix] = None
+        self._range_alloc: Optional[RangeAllocator] = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        assert not self._started
+        self._started = True
+        mode = self.config.mode
+        if mode == PrefixAllocationMode.DYNAMIC_LEAF_NODE:
+            self.client.subscribe_key(
+                SEED_PREFIX_KEY, self._seed_param_updated, area=self.config.area
+            )
+            existing = self.client.get_key(
+                SEED_PREFIX_KEY, area=self.config.area
+            )
+            if existing is not None and existing.value is not None:
+                self._apply_params(
+                    PrefixAllocationParams.parse(existing.value.decode())
+                )
+        elif mode == PrefixAllocationMode.DYNAMIC_ROOT_NODE:
+            assert self.config.params is not None, "root mode needs params"
+            # advertise seed for the leaves
+            self.client.persist_key(
+                SEED_PREFIX_KEY,
+                self.config.params.encode().encode(),
+                area=self.config.area,
+            )
+            self._apply_params(self.config.params)
+        else:  # STATIC
+            self.client.subscribe_key(
+                STATIC_ALLOC_KEY,
+                self._static_alloc_updated,
+                area=self.config.area,
+            )
+            existing = self.client.get_key(
+                STATIC_ALLOC_KEY, area=self.config.area
+            )
+            if existing is not None and existing.value is not None:
+                self._static_alloc_updated(STATIC_ALLOC_KEY, existing)
+
+    def stop(self) -> None:
+        if self._range_alloc is not None:
+            self._range_alloc.stop()
+            self._range_alloc = None
+
+    def get_prefix(self) -> Optional[IpPrefix]:
+        return self.my_prefix
+
+    # ------------------------------------------------------------------
+    # dynamic modes
+    # ------------------------------------------------------------------
+
+    def _seed_param_updated(self, key: str, value: Optional[Value]) -> None:
+        if value is None or value.value is None:
+            return
+        try:
+            params = PrefixAllocationParams.parse(value.value.decode())
+        except Exception:
+            log.exception("malformed seed prefix param: %r", value.value)
+            return
+        self._apply_params(params)
+
+    def _apply_params(self, params: PrefixAllocationParams) -> None:
+        if params == self.params:
+            return
+        if self._range_alloc is not None:
+            self._range_alloc.stop()
+            self._withdraw()
+        self.params = params
+        init_index = self._load_index()
+        self._range_alloc = RangeAllocator(
+            self.config.node_name,
+            ALLOC_KEY_MARKER,
+            self.client,
+            self._index_allocated,
+            area=self.config.area,
+            loop=self._loop,
+        )
+        self._range_alloc.start_allocator(
+            (0, params.range_size - 1), init_index
+        )
+
+    def _index_allocated(self, index: Optional[int]) -> None:
+        if index is None:
+            self._withdraw()
+            return
+        assert self.params is not None
+        prefix = get_nth_prefix(self.params, index)
+        self._save_index(index)
+        self._announce(prefix)
+
+    # ------------------------------------------------------------------
+    # static mode
+    # ------------------------------------------------------------------
+
+    def _static_alloc_updated(self, key: str, value: Optional[Value]) -> None:
+        if value is None or value.value is None:
+            return
+        try:
+            alloc = serializer.loads(value.value)
+            node_prefixes = dict(alloc)
+        except Exception:
+            log.exception("malformed static allocation value")
+            return
+        mine = node_prefixes.get(self.config.node_name)
+        if mine is None:
+            self._withdraw()
+        else:
+            self._announce(IpPrefix(str(mine)))
+
+    # ------------------------------------------------------------------
+    # announce / withdraw
+    # ------------------------------------------------------------------
+
+    def _announce(self, prefix: IpPrefix) -> None:
+        if prefix == self.my_prefix:
+            return
+        self._withdraw()
+        self.my_prefix = prefix
+        log.info("%s allocated prefix %s", self.config.node_name, prefix)
+        if self.on_advertise is not None:
+            self.on_advertise(
+                PrefixEntry(prefix=prefix, type=PrefixType.PREFIX_ALLOCATOR)
+            )
+        if self.config.set_loopback_addr and self.system_handler is not None:
+            self._sync_loopback(prefix)
+
+    def _withdraw(self) -> None:
+        if self.my_prefix is None:
+            return
+        prefix, self.my_prefix = self.my_prefix, None
+        if self.on_withdraw is not None:
+            self.on_withdraw(prefix)
+
+    def _sync_loopback(self, prefix: IpPrefix) -> None:
+        """Assign the first host address of the prefix to loopback
+        (PrefixAllocator.cpp:654-699)."""
+        try:
+            links = {l.name: l for l in self.system_handler.get_links()}
+            lo = links.get(self.config.loopback_iface)
+            if lo is None:
+                return
+            addr = str(next(prefix.network.hosts()))
+            self.system_handler.add_addr(
+                lo.ifindex, addr, prefix.prefix_length
+            )
+        except Exception:
+            log.exception("failed to sync loopback address")
+
+    # ------------------------------------------------------------------
+    # persisted index
+    # ------------------------------------------------------------------
+
+    def _load_index(self) -> Optional[int]:
+        if self.config_store is None:
+            return None
+        state = self.config_store.load_obj(CONFIG_STORE_KEY)
+        if not isinstance(state, dict):
+            return None
+        # index only reusable under identical params
+        if state.get("params") != (
+            self.params.encode() if self.params else None
+        ):
+            return None
+        return state.get("index")
+
+    def _save_index(self, index: int) -> None:
+        if self.config_store is None or self.params is None:
+            return
+        self.config_store.store_obj(
+            CONFIG_STORE_KEY,
+            {"params": self.params.encode(), "index": index},
+        )

@@ -1,0 +1,250 @@
+"""Distributed value election over KvStore.
+
+Behavioral port of openr/allocators/RangeAllocator.{h,-inl.h}: claim a value
+from an integer range by advertising `<keyPrefix><value>` into KvStore;
+conflicts resolve by the CRDT tie-break (higher originatorId wins at equal
+version). Losing triggers a re-try with a seeded-random fresh value under
+exponential backoff (50ms..2s). `override_owner=False` keeps joiners from
+stealing values owned by lower-id incumbents (Terragraph semantics,
+RangeAllocator.h:46-49).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Callable, Optional, Tuple
+
+from openr_tpu.kvstore import KvStoreClient
+from openr_tpu.types import TTL_INFINITY, Value
+from openr_tpu.utils import ExponentialBackoff
+
+RANGE_ALLOC_TTL_MS = 30_000  # Constants::kRangeAllocTtl
+
+
+def _encode(value: int) -> bytes:
+    return value.to_bytes(8, "little", signed=False)
+
+
+def _decode(blob: bytes) -> int:
+    return int.from_bytes(blob, "little", signed=False)
+
+
+class RangeAllocator:
+    def __init__(
+        self,
+        node_name: str,
+        key_prefix: str,
+        kvstore_client: KvStoreClient,
+        callback: Callable[[Optional[int]], None],
+        min_backoff: float = 0.05,
+        max_backoff: float = 2.0,
+        override_owner: bool = True,
+        check_value_in_use: Optional[Callable[[int], bool]] = None,
+        ttl_ms: int = RANGE_ALLOC_TTL_MS,
+        area: str = "0",
+        rng: Optional[random.Random] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.key_prefix = key_prefix
+        self.client = kvstore_client
+        self.callback = callback
+        self.override_owner = override_owner
+        self.check_value_in_use = check_value_in_use
+        self.ttl_ms = ttl_ms
+        self.area = area
+        self._rng = rng or random.Random()
+        self._loop = loop
+        self._backoff = ExponentialBackoff(min_backoff, max_backoff)
+        self._range: Optional[Tuple[int, int]] = None
+        self.my_value: Optional[int] = None
+        self._requested_value: Optional[int] = None
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._started = False
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+
+    def start_allocator(
+        self,
+        alloc_range: Tuple[int, int],
+        init_value: Optional[int] = None,
+    ) -> None:
+        assert not self._started, "already started"
+        assert alloc_range[0] <= alloc_range[1], "invalid range"
+        self._started = True
+        self._range = alloc_range
+        if init_value is None:
+            init_value = alloc_range[0]
+        else:
+            # a stale persisted index may fall outside the configured range
+            init_value = min(max(init_value, alloc_range[0]), alloc_range[1])
+        self._schedule_try(init_value)
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self.my_value is not None:
+            self.client.unset_key(self._key(self.my_value), area=self.area)
+
+    def get_value(self) -> Optional[int]:
+        return self.my_value
+
+    def get_value_from_kvstore(self) -> Optional[int]:
+        for key, value in self._dump_range().items():
+            if value.originator_id == self.node_name:
+                return _decode(value.value)
+        return None
+
+    def is_range_consumed(self) -> bool:
+        assert self._range is not None
+        lo, hi = self._range
+        count = sum(
+            1
+            for value in self._dump_range().values()
+            if lo <= _decode(value.value) <= hi
+        )
+        return count == hi - lo + 1
+
+    # ------------------------------------------------------------------
+
+    def _key(self, value: int) -> str:
+        return f"{self.key_prefix}{value}"
+
+    def _dump_range(self):
+        from openr_tpu.kvstore.store import KvStoreFilters
+
+        pub = self.client.kvstore.dump_all(
+            area=self.area,
+            filters=KvStoreFilters(key_prefixes=[self.key_prefix]),
+        )
+        return {
+            k: v for k, v in pub.key_vals.items() if v.value is not None
+        }
+
+    def _schedule_try(self, value: int) -> None:
+        self._backoff.report_error()
+        self._timer = self.loop().call_later(
+            self._backoff.get_time_remaining_until_retry(),
+            self._try_allocate,
+            value,
+        )
+
+    def _try_allocate(self, new_val: int) -> None:
+        """tryAllocate (RangeAllocator-inl.h:170-250)."""
+        self._timer = None
+        if self.my_value is not None:
+            return
+        key = self._key(new_val)
+        existing = self.client.get_key(key, area=self.area)
+
+        should_own_other = (
+            existing is None
+            or (self.override_owner and self.node_name > existing.originator_id)
+            # prefer TTL'd keys over infinite-ttl leftovers when not stealing
+            or (not self.override_owner and existing.ttl == TTL_INFINITY)
+        )
+        should_own_mine = (
+            existing is not None
+            and existing.originator_id == self.node_name
+        )
+        if not should_own_other and not should_own_mine:
+            self._schedule_allocate(new_val)
+            return
+        if self.check_value_in_use is not None and self.check_value_in_use(
+            new_val
+        ):
+            self._schedule_allocate(new_val)
+            return
+
+        if should_own_other:
+            self._requested_value = new_val
+            ttl_version = existing.ttl_version + 1 if existing else 0
+            self.client.kvstore.set_key(
+                key,
+                Value(
+                    version=1,
+                    originator_id=self.node_name,
+                    value=_encode(new_val),
+                    ttl=self.ttl_ms,
+                    ttl_version=ttl_version,
+                ),
+                area=self.area,
+            )
+            # our write may have lost the CRDT merge to a concurrent claim
+            stored = self.client.get_key(key, area=self.area)
+            if stored is not None and stored.originator_id == self.node_name:
+                self._on_won(new_val)
+            else:
+                self._schedule_allocate(new_val)
+                return
+        else:
+            # reboot with kvstore intact: refresh ttl and accept
+            refreshed = existing.copy()
+            refreshed.ttl_version += 1
+            refreshed.ttl = self.ttl_ms
+            self.client.kvstore.set_key(key, refreshed, area=self.area)
+            self._on_won(new_val)
+
+        self.client.subscribe_key(key, self._key_updated, area=self.area)
+
+    def _on_won(self, value: int) -> None:
+        self.my_value = value
+        self._requested_value = None
+        self._backoff.report_success()
+        # keep the claim alive: persist re-advertises on clobber + ttl refresh
+        self.client.persist_key(
+            self._key(value),
+            _encode(value),
+            area=self.area,
+            ttl=self.ttl_ms,
+        )
+        self.callback(value)
+
+    def _schedule_allocate(self, seed_val: int) -> None:
+        """Pick a fresh random value not owned by a higher id
+        (RangeAllocator-inl.h:259-304)."""
+        assert self._range is not None
+        lo, hi = self._range
+        size = hi - lo + 1
+        new_val = self._rng.randint(lo, hi)
+        owners = {
+            _decode(v.value): v.originator_id
+            for v in self._dump_range().values()
+        }
+        for _ in range(size):
+            owner = owners.get(new_val)
+            if owner is None or (
+                self.override_owner and self.node_name >= owner
+            ):
+                if self.check_value_in_use is None or not (
+                    self.check_value_in_use(new_val)
+                ):
+                    break
+            new_val = new_val + 1 if new_val < hi else lo
+        self._schedule_try(new_val)
+
+    def _key_updated(self, key: str, value: Optional[Value]) -> None:
+        """keyValUpdated (RangeAllocator-inl.h:306-345): detect losing our
+        claimed/allocated value to a higher originator."""
+        if value is None or value.value is None:
+            return
+        if value.originator_id < self.node_name:
+            return  # an intermediate lower id; ours will override
+        if value.originator_id == self.node_name:
+            if self.my_value is None and self._requested_value is not None:
+                self._on_won(_decode(value.value))
+            return
+        # lost to a higher originator: release and try another value
+        lost = _decode(value.value)
+        if self.my_value == lost or self._requested_value == lost:
+            self.my_value = None
+            self._requested_value = None
+            self.client.unset_key(key, area=self.area)
+            self.callback(None)
+            if self._timer is None:
+                self._schedule_allocate(lost)

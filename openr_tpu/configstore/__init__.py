@@ -1,0 +1,8 @@
+"""Durable on-disk key-value store (config-store).
+
+Equivalent of openr/config-store/PersistentStore.{h,cpp}.
+"""
+
+from openr_tpu.configstore.persistent_store import PersistentStore
+
+__all__ = ["PersistentStore"]

@@ -19,8 +19,10 @@ from openr_tpu.kvstore.store import (
     merge_key_values,
 )
 from openr_tpu.kvstore.transport import InProcessTransport, KvStoreTransport
+from openr_tpu.kvstore.client import KvStoreClient
 
 __all__ = [
+    "KvStoreClient",
     "KvStore",
     "KvStoreDb",
     "KvStoreFilters",

@@ -1,0 +1,18 @@
+"""Prefix origination (PrefixManager).
+
+Equivalent of openr/prefix-manager/PrefixManager.{h,cpp}.
+"""
+
+from openr_tpu.prefixmanager.prefix_manager import (
+    PrefixEventCommand,
+    PrefixManager,
+    PrefixManagerConfig,
+    PrefixUpdateRequest,
+)
+
+__all__ = [
+    "PrefixEventCommand",
+    "PrefixManager",
+    "PrefixManagerConfig",
+    "PrefixUpdateRequest",
+]

@@ -1,0 +1,367 @@
+"""PrefixManager: tracks prefixes this node originates, advertises them
+into KvStore, and redistributes computed routes across areas.
+
+Behavioral port of openr/prefix-manager/PrefixManager.{h,cpp}:
+  - per-type prefix map; for the same prefix advertised under several
+    types, the lowest PrefixType wins deterministically
+    (PrefixManager.h:178-181).
+  - per-prefix keys 'prefix:<node>:<area>:[<prefix>]' with persist
+    semantics and a tombstone (deletePrefix) on withdraw; keysToClear
+    tracks stale keys seen in KvStore so they get withdrawn
+    (PrefixManager.cpp:159-192).
+  - throttled KvStore sync batching multiple API calls
+    (syncKvStoreThrottled_, PrefixManager.h:166).
+  - non-ephemeral state persisted in the config store so originated
+    prefixes survive restart (persistPrefixDb).
+  - consumes PrefixUpdateRequest queue (ADD/WITHDRAW/SYNC per type) and
+    Decision route updates for cross-area redistribution: learned unicast
+    routes are re-advertised into areas they did NOT come from, with
+    bestArea appended to area_stack and type normalized to RIB
+    (PrefixManager.cpp:603-645).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.kvstore import KvStoreClient
+from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.types import (
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixType,
+    prefix_key,
+    replace,
+)
+from openr_tpu.utils import AsyncThrottle, serializer
+from openr_tpu.utils.counters import CountersMixin
+
+log = logging.getLogger(__name__)
+
+CONFIG_STORE_KEY = "prefix-manager-config"
+# deterministic type preference: lowest enum position wins
+_TYPE_ORDER = {t: i for i, t in enumerate(PrefixType)}
+
+
+class PrefixEventCommand(enum.Enum):
+    """openr/if/PrefixManager.thrift PrefixUpdateCommand:16."""
+
+    ADD_PREFIXES = "ADD_PREFIXES"
+    WITHDRAW_PREFIXES = "WITHDRAW_PREFIXES"
+    WITHDRAW_PREFIXES_BY_TYPE = "WITHDRAW_PREFIXES_BY_TYPE"
+    SYNC_PREFIXES_BY_TYPE = "SYNC_PREFIXES_BY_TYPE"
+
+
+@dataclass
+class PrefixUpdateRequest:
+    """openr/if/PrefixManager.thrift PrefixUpdateRequest:23."""
+
+    cmd: PrefixEventCommand
+    type: Optional[PrefixType] = None
+    prefixes: List[PrefixEntry] = field(default_factory=list)
+
+
+@dataclass
+class PrefixManagerConfig:
+    node_name: str
+    areas: List[str] = field(default_factory=lambda: ["0"])
+    ttl_ms: int = -(2**31)  # TTL_INFINITY by default
+    sync_throttle: float = 0.005
+    persist: bool = True
+
+
+@dataclass
+class _Entry:
+    """PrefixEntry + destination areas (PrefixManager.h:92-106)."""
+
+    entry: PrefixEntry
+    dst_areas: Set[str]
+
+
+class PrefixManager(CountersMixin):
+    def __init__(
+        self,
+        config: PrefixManagerConfig,
+        kvstore_client: KvStoreClient,
+        config_store=None,
+        prefix_updates: Optional[RQueue] = None,
+        route_updates: Optional[RQueue] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self.client = kvstore_client
+        self.config_store = config_store
+        self.prefix_updates = prefix_updates
+        self.route_updates = route_updates
+        self._loop = loop
+
+        # type -> prefix -> _Entry (ordered by type preference at lookup)
+        self.prefix_map: Dict[PrefixType, Dict[IpPrefix, _Entry]] = {}
+        self.keys_to_clear: Set[Tuple[str, str]] = set()  # (area, key)
+        self._advertised: Set[Tuple[str, str]] = set()
+        self._sync_throttle = AsyncThrottle(
+            config.sync_throttle, self.sync_kvstore, loop=loop
+        )
+        self._tasks: List[asyncio.Task] = []
+        self.counters: Dict[str, int] = {}
+        self._load_persisted()
+        # reclaim stale keys from a previous incarnation
+        for area in config.areas:
+            pub = self.client.kvstore.dump_all(area=area)
+            marker = prefix_key(config.node_name)
+            for key, value in pub.key_vals.items():
+                if key.startswith(marker + ":") or key == marker:
+                    self.keys_to_clear.add((area, key))
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.prefix_updates is not None:
+            self._tasks.append(
+                self.loop().create_task(self._consume_requests())
+            )
+        if self.route_updates is not None:
+            self._tasks.append(self.loop().create_task(self._consume_routes()))
+        if self.prefix_map:
+            self._sync_throttle()
+
+    def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+        self._sync_throttle.cancel()
+
+    async def _consume_requests(self) -> None:
+        while True:
+            try:
+                req = await self.prefix_updates.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            self.process_request(req)
+
+    async def _consume_routes(self) -> None:
+        while True:
+            try:
+                update = await self.route_updates.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            self.process_decision_route_updates(update)
+
+    # ------------------------------------------------------------------
+    # write APIs
+    # ------------------------------------------------------------------
+
+    def process_request(self, req: PrefixUpdateRequest) -> None:
+        if req.cmd == PrefixEventCommand.ADD_PREFIXES:
+            self.advertise_prefixes(req.prefixes)
+        elif req.cmd == PrefixEventCommand.WITHDRAW_PREFIXES:
+            self.withdraw_prefixes(req.prefixes)
+        elif req.cmd == PrefixEventCommand.WITHDRAW_PREFIXES_BY_TYPE:
+            assert req.type is not None
+            self.withdraw_prefixes_by_type(req.type)
+        elif req.cmd == PrefixEventCommand.SYNC_PREFIXES_BY_TYPE:
+            assert req.type is not None
+            self.sync_prefixes_by_type(req.type, req.prefixes)
+
+    def advertise_prefixes(
+        self,
+        prefixes: List[PrefixEntry],
+        dst_areas: Optional[Set[str]] = None,
+    ) -> bool:
+        dst = set(dst_areas) if dst_areas is not None else set(
+            self.config.areas
+        )
+        changed = False
+        for entry in prefixes:
+            by_prefix = self.prefix_map.setdefault(entry.type, {})
+            existing = by_prefix.get(entry.prefix)
+            new = _Entry(entry, set(dst))
+            if existing is not None:
+                new.dst_areas |= existing.dst_areas
+                if (
+                    existing.entry == entry
+                    and existing.dst_areas == new.dst_areas
+                ):
+                    continue
+            by_prefix[entry.prefix] = new
+            changed = True
+        if changed:
+            self._persist()
+            self._sync_throttle()
+        return changed
+
+    def withdraw_prefixes(self, prefixes: List[PrefixEntry]) -> bool:
+        changed = False
+        for entry in prefixes:
+            by_prefix = self.prefix_map.get(entry.type, {})
+            if by_prefix.pop(entry.prefix, None) is not None:
+                changed = True
+        if changed:
+            self._persist()
+            self._sync_throttle()
+        return changed
+
+    def withdraw_prefixes_by_type(self, ptype: PrefixType) -> bool:
+        removed = bool(self.prefix_map.pop(ptype, None))
+        if removed:
+            self._persist()
+            self._sync_throttle()
+        return removed
+
+    def sync_prefixes_by_type(
+        self, ptype: PrefixType, prefixes: List[PrefixEntry]
+    ) -> bool:
+        desired = {e.prefix: e for e in prefixes}
+        current = self.prefix_map.get(ptype, {})
+        if {p: e.entry for p, e in current.items()} == desired:
+            return False
+        self.prefix_map[ptype] = {
+            p: _Entry(e, set(self.config.areas)) for p, e in desired.items()
+        }
+        self._persist()
+        self._sync_throttle()
+        return True
+
+    # ------------------------------------------------------------------
+    # read APIs
+    # ------------------------------------------------------------------
+
+    def get_prefixes(self) -> List[PrefixEntry]:
+        return [
+            e.entry
+            for by_prefix in self.prefix_map.values()
+            for e in by_prefix.values()
+        ]
+
+    def get_prefixes_by_type(self, ptype: PrefixType) -> List[PrefixEntry]:
+        return [e.entry for e in self.prefix_map.get(ptype, {}).values()]
+
+    # ------------------------------------------------------------------
+    # KvStore sync
+    # ------------------------------------------------------------------
+
+    def _best_entries(self) -> Dict[IpPrefix, _Entry]:
+        """Collapse types: lowest PrefixType wins per prefix."""
+        best: Dict[IpPrefix, Tuple[int, _Entry]] = {}
+        for ptype, by_prefix in self.prefix_map.items():
+            rank = _TYPE_ORDER[ptype]
+            for prefix, entry in by_prefix.items():
+                cur = best.get(prefix)
+                if cur is None or rank < cur[0]:
+                    best[prefix] = (rank, entry)
+        return {p: e for p, (_, e) in best.items()}
+
+    def sync_kvstore(self) -> None:
+        """Advertise the current best set as per-prefix keys; tombstone
+        everything stale (PrefixManager.cpp syncKvStore)."""
+        self._bump("prefix_manager.kvstore_syncs")
+        node = self.config.node_name
+        now_advertised: Set[Tuple[str, str]] = set()
+        for prefix, entry in self._best_entries().items():
+            for area in entry.dst_areas:
+                key = prefix_key(node, prefix, area)
+                db = PrefixDatabase(
+                    this_node_name=node,
+                    prefix_entries=[entry.entry],
+                    area=area,
+                )
+                self.client.persist_key(
+                    key,
+                    serializer.dumps(db),
+                    area=area,
+                    ttl=self.config.ttl_ms,
+                )
+                now_advertised.add((area, key))
+                self.keys_to_clear.discard((area, key))
+
+        for area, key in (self._advertised - now_advertised) | set(
+            self.keys_to_clear
+        ):
+            tombstone = PrefixDatabase(
+                this_node_name=node, delete_prefix=True, area=area
+            )
+            self.client.clear_key(
+                key, serializer.dumps(tombstone), area=area
+            )
+            self._bump("prefix_manager.keys_cleared")
+        self.keys_to_clear.clear()
+        self._advertised = now_advertised
+
+    # ------------------------------------------------------------------
+    # cross-area redistribution
+    # ------------------------------------------------------------------
+
+    def process_decision_route_updates(self, update) -> None:
+        """Re-originate learned routes into other areas
+        (PrefixManager.cpp:603-645)."""
+        if len(self.config.areas) == 1:
+            return
+        to_advertise: List[Tuple[PrefixEntry, Set[str]]] = []
+        to_withdraw: List[PrefixEntry] = []
+        for route in update.unicast_routes_to_update:
+            best = route.best_prefix_entry
+            if best is None:
+                continue
+            entry = replace(
+                best,
+                type=PrefixType.RIB,
+                area_stack=tuple(best.area_stack)
+                + ((route.best_area,) if route.best_area else ()),
+            )
+            dst = set(self.config.areas)
+            for nh in route.nexthops:
+                if nh.area is not None:
+                    dst.discard(nh.area)
+            if dst:
+                to_advertise.append((entry, dst))
+        for prefix in update.unicast_routes_to_delete:
+            to_withdraw.append(
+                PrefixEntry(prefix=prefix, type=PrefixType.RIB)
+            )
+        for entry, dst in to_advertise:
+            self.advertise_prefixes([entry], dst_areas=dst)
+        if to_withdraw:
+            self.withdraw_prefixes(to_withdraw)
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def _persist(self) -> None:
+        if self.config_store is None or not self.config.persist:
+            return
+        self.config_store.store_obj(
+            CONFIG_STORE_KEY,
+            {
+                ptype.value: list(
+                    (e.entry, sorted(e.dst_areas))
+                    for e in by_prefix.values()
+                )
+                for ptype, by_prefix in self.prefix_map.items()
+            },
+        )
+
+    def _load_persisted(self) -> None:
+        if self.config_store is None or not self.config.persist:
+            return
+        state = self.config_store.load_obj(CONFIG_STORE_KEY)
+        if not isinstance(state, dict):
+            return
+        for type_name, entries in state.items():
+            try:
+                ptype = PrefixType(type_name)
+            except ValueError:
+                continue
+            by_prefix = self.prefix_map.setdefault(ptype, {})
+            for entry, dst_areas in entries:
+                by_prefix[entry.prefix] = _Entry(entry, set(dst_areas))

@@ -1,0 +1,369 @@
+"""RangeAllocator + PrefixAllocator tests, mirroring
+openr/allocators/tests/RangeAllocatorTest.cpp (unique elections, conflict
+resolution by originator id) and PrefixAllocatorTest.cpp (sub-prefix
+computation, modes, persisted index reuse)."""
+
+import asyncio
+import random
+
+import pytest
+
+from openr_tpu.allocators import (
+    PrefixAllocationMode,
+    PrefixAllocationParams,
+    PrefixAllocator,
+    PrefixAllocatorConfig,
+    RangeAllocator,
+)
+from openr_tpu.allocators.prefix_allocator import (
+    SEED_PREFIX_KEY,
+    STATIC_ALLOC_KEY,
+    get_nth_prefix,
+)
+from openr_tpu.configstore import PersistentStore
+from openr_tpu.kvstore import InProcessTransport, KvStore, KvStoreClient
+from openr_tpu.types import IpPrefix
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=20.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def make_store_mesh(names, transport=None):
+    """Full-mesh KvStores over the in-process transport."""
+    transport = transport or InProcessTransport()
+    stores = {
+        name: KvStore(name, ["0"], transport) for name in names
+    }
+    from openr_tpu.kvstore import PeerSpec
+
+    for name, store in stores.items():
+        store.add_peers(
+            {other: PeerSpec(other) for other in names if other != name}
+        )
+    return stores
+
+
+async def wait_until(predicate, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_event_loop().time() < deadline, "timed out"
+        await asyncio.sleep(0.02)
+
+
+class TestRangeAllocator:
+    def test_single_node_allocates_init_value(self):
+        async def body():
+            stores = make_store_mesh(["n1"])
+            client = KvStoreClient(stores["n1"])
+            got = []
+            alloc = RangeAllocator(
+                "n1", "alloc:", client, got.append, min_backoff=0.001
+            )
+            alloc.start_allocator((0, 15), init_value=7)
+            await wait_until(lambda: got)
+            assert got == [7]
+            assert alloc.get_value() == 7
+            assert alloc.get_value_from_kvstore() == 7
+            alloc.stop()
+            client.stop()
+
+        run(body())
+
+    def test_unique_values_across_nodes(self):
+        async def body():
+            names = [f"node-{i}" for i in range(4)]
+            stores = make_store_mesh(names)
+            clients = {n: KvStoreClient(stores[n]) for n in names}
+            results = {}
+            allocators = {}
+            for i, n in enumerate(names):
+                results[n] = []
+                allocators[n] = RangeAllocator(
+                    n,
+                    "alloc:",
+                    clients[n],
+                    results[n].append,
+                    min_backoff=0.001,
+                    max_backoff=0.05,
+                    rng=random.Random(i),
+                )
+            for i, n in enumerate(names):
+                # everyone wants value 0 initially: conflicts must resolve
+                allocators[n].start_allocator((0, 7), init_value=0)
+            await wait_until(
+                lambda: all(
+                    a.get_value() is not None for a in allocators.values()
+                ),
+                timeout=15,
+            )
+            # let elections settle (steals can still be in flight)
+            for _ in range(50):
+                await asyncio.sleep(0.02)
+                values = [a.get_value() for a in allocators.values()]
+                if None not in values and len(set(values)) == len(names):
+                    break
+            values = [a.get_value() for a in allocators.values()]
+            assert len(set(values)) == len(names), values
+            assert all(0 <= v <= 7 for v in values)
+            for a in allocators.values():
+                a.stop()
+            for c in clients.values():
+                c.stop()
+
+        run(body())
+
+    def test_higher_originator_steals_with_override(self):
+        async def body():
+            stores = make_store_mesh(["aaa", "zzz"])
+            ca = KvStoreClient(stores["aaa"])
+            cz = KvStoreClient(stores["zzz"])
+            got_a, got_z = [], []
+            # range of exactly one value: they must fight for it
+            alloc_a = RangeAllocator(
+                "aaa", "alloc:", ca, got_a.append, min_backoff=0.001
+            )
+            alloc_a.start_allocator((5, 5), init_value=5)
+            await wait_until(lambda: alloc_a.get_value() == 5)
+
+            alloc_z = RangeAllocator(
+                "zzz", "alloc:", cz, got_z.append, min_backoff=0.001
+            )
+            alloc_z.start_allocator((5, 5), init_value=5)
+            await wait_until(lambda: alloc_z.get_value() == 5)
+            # lower originator loses its value (callback with None)
+            await wait_until(lambda: None in got_a)
+            assert alloc_a.get_value() is None
+            alloc_a.stop()
+            alloc_z.stop()
+            ca.stop()
+            cz.stop()
+
+        run(body())
+
+    def test_no_steal_without_override(self):
+        async def body():
+            stores = make_store_mesh(["aaa", "zzz"])
+            ca = KvStoreClient(stores["aaa"])
+            cz = KvStoreClient(stores["zzz"])
+            alloc_a = RangeAllocator(
+                "aaa", "alloc:", ca, lambda v: None, min_backoff=0.001
+            )
+            alloc_a.start_allocator((5, 5), init_value=5)
+            await wait_until(lambda: alloc_a.get_value() == 5)
+
+            alloc_z = RangeAllocator(
+                "zzz",
+                "alloc:",
+                cz,
+                lambda v: None,
+                min_backoff=0.001,
+                max_backoff=0.02,
+                override_owner=False,
+            )
+            alloc_z.start_allocator((5, 5), init_value=5)
+            await asyncio.sleep(0.5)
+            # zzz never steals; aaa keeps the value
+            assert alloc_a.get_value() == 5
+            assert alloc_z.get_value() is None
+            assert alloc_z.is_range_consumed()
+            alloc_a.stop()
+            alloc_z.stop()
+            ca.stop()
+            cz.stop()
+
+        run(body())
+
+
+class TestGetNthPrefix:
+    def test_v6_subprefixes(self):
+        params = PrefixAllocationParams(IpPrefix("fc00:cafe::/56"), 64)
+        assert params.range_size == 256
+        assert get_nth_prefix(params, 0) == IpPrefix("fc00:cafe::/64")
+        assert get_nth_prefix(params, 1) == IpPrefix("fc00:cafe:0:1::/64")
+        assert get_nth_prefix(params, 255) == IpPrefix("fc00:cafe:0:ff::/64")
+
+    def test_v4_subprefixes(self):
+        params = PrefixAllocationParams(IpPrefix("10.0.0.0/16"), 24)
+        assert params.range_size == 256
+        assert get_nth_prefix(params, 0) == IpPrefix("10.0.0.0/24")
+        assert get_nth_prefix(params, 17) == IpPrefix("10.0.17.0/24")
+
+    def test_parse_encode_roundtrip(self):
+        params = PrefixAllocationParams.parse("fc00:cafe::/56,64")
+        assert params.seed_prefix == IpPrefix("fc00:cafe::/56")
+        assert params.alloc_prefix_len == 64
+        assert PrefixAllocationParams.parse(params.encode()) == params
+
+
+class TestPrefixAllocator:
+    def test_root_node_allocates_and_advertises_seed(self):
+        async def body():
+            stores = make_store_mesh(["root"])
+            client = KvStoreClient(stores["root"])
+            advertised = []
+            alloc = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name="root",
+                    mode=PrefixAllocationMode.DYNAMIC_ROOT_NODE,
+                    params=PrefixAllocationParams(
+                        IpPrefix("fc00:cafe::/56"), 64
+                    ),
+                ),
+                client,
+                on_advertise=advertised.append,
+            )
+            alloc.start()
+            await wait_until(lambda: advertised)
+            prefix = alloc.get_prefix()
+            assert prefix is not None
+            assert prefix.prefix_length == 64
+            assert prefix.network.subnet_of(
+                IpPrefix("fc00:cafe::/56").network
+            )
+            # seed advertised into kvstore for leaves
+            seed = stores["root"].get_key(SEED_PREFIX_KEY)
+            assert seed is not None
+            assert seed.value == b"fc00:cafe::/56,64"
+            alloc.stop()
+            client.stop()
+
+        run(body())
+
+    def test_leaf_learns_params_from_kvstore(self):
+        async def body():
+            stores = make_store_mesh(["leaf"])
+            client = KvStoreClient(stores["leaf"])
+            advertised = []
+            alloc = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name="leaf",
+                    mode=PrefixAllocationMode.DYNAMIC_LEAF_NODE,
+                ),
+                client,
+                on_advertise=advertised.append,
+            )
+            alloc.start()
+            await asyncio.sleep(0.05)
+            assert alloc.get_prefix() is None  # no params yet
+            # seed arrives via kvstore (e.g. from a root node)
+            client.set_key(SEED_PREFIX_KEY, b"10.1.0.0/16,24")
+            await wait_until(lambda: advertised)
+            assert alloc.get_prefix().prefix_length == 24
+            alloc.stop()
+            client.stop()
+
+        run(body())
+
+    def test_two_nodes_unique_prefixes(self):
+        async def body():
+            stores = make_store_mesh(["node-a", "node-b"])
+            clients = {n: KvStoreClient(stores[n]) for n in stores}
+            allocs = {}
+            for n, c in clients.items():
+                allocs[n] = PrefixAllocator(
+                    PrefixAllocatorConfig(
+                        node_name=n,
+                        mode=PrefixAllocationMode.DYNAMIC_ROOT_NODE,
+                        params=PrefixAllocationParams(
+                            IpPrefix("fc00:cafe::/56"), 64
+                        ),
+                    ),
+                    c,
+                )
+                allocs[n].start()
+            await wait_until(
+                lambda: all(
+                    a.get_prefix() is not None for a in allocs.values()
+                )
+            )
+            for _ in range(50):
+                await asyncio.sleep(0.02)
+                prefixes = [a.get_prefix() for a in allocs.values()]
+                if None not in prefixes and len(set(prefixes)) == 2:
+                    break
+            prefixes = [a.get_prefix() for a in allocs.values()]
+            assert len(set(prefixes)) == 2, prefixes
+            for a in allocs.values():
+                a.stop()
+            for c in clients.values():
+                c.stop()
+
+        run(body())
+
+    def test_static_mode(self):
+        async def body():
+            stores = make_store_mesh(["s1"])
+            client = KvStoreClient(stores["s1"])
+            advertised, withdrawn = [], []
+            alloc = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name="s1", mode=PrefixAllocationMode.STATIC
+                ),
+                client,
+                on_advertise=advertised.append,
+                on_withdraw=withdrawn.append,
+            )
+            alloc.start()
+            client.set_key(
+                STATIC_ALLOC_KEY,
+                serializer.dumps({"s1": "10.5.0.0/24", "s2": "10.5.1.0/24"}),
+            )
+            await wait_until(lambda: advertised)
+            assert alloc.get_prefix() == IpPrefix("10.5.0.0/24")
+            # removal from the static map withdraws
+            client.set_key(
+                STATIC_ALLOC_KEY, serializer.dumps({"s2": "10.5.1.0/24"})
+            )
+            await wait_until(lambda: withdrawn)
+            assert alloc.get_prefix() is None
+            alloc.stop()
+            client.stop()
+
+        run(body())
+
+    def test_persisted_index_reused_after_restart(self, tmp_path):
+        async def body():
+            config_store = PersistentStore(str(tmp_path / "cs.bin"))
+            stores = make_store_mesh(["n1"])
+            client = KvStoreClient(stores["n1"])
+            params = PrefixAllocationParams(IpPrefix("10.0.0.0/16"), 24)
+            alloc = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name="n1",
+                    mode=PrefixAllocationMode.DYNAMIC_ROOT_NODE,
+                    params=params,
+                ),
+                client,
+                config_store=config_store,
+            )
+            alloc.start()
+            await wait_until(lambda: alloc.get_prefix() is not None)
+            first = alloc.get_prefix()
+            alloc.stop()
+            client.stop()
+            config_store.flush()
+
+            # "restart": fresh kvstore, same config store
+            stores2 = make_store_mesh(["n1"])
+            client2 = KvStoreClient(stores2["n1"])
+            alloc2 = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name="n1",
+                    mode=PrefixAllocationMode.DYNAMIC_ROOT_NODE,
+                    params=params,
+                ),
+                client2,
+                config_store=PersistentStore(str(tmp_path / "cs.bin")),
+            )
+            alloc2.start()
+            await wait_until(lambda: alloc2.get_prefix() is not None)
+            assert alloc2.get_prefix() == first
+            alloc2.stop()
+            client2.stop()
+
+        run(body())

@@ -1,0 +1,86 @@
+"""PersistentStore tests (openr/config-store/tests/PersistentStoreTest.cpp
+equivalents): store/load/erase roundtrip, restart durability, obj helpers,
+corrupt-file tolerance."""
+
+import asyncio
+import os
+
+from openr_tpu.configstore import PersistentStore
+from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+
+
+def test_store_load_erase(tmp_path):
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    assert store.load("missing") is None
+    store.store("key1", b"value1")
+    store.store("key2", b"value2")
+    assert store.load("key1") == b"value1"
+    assert store.erase("key1") is True
+    assert store.erase("key1") is False
+    assert store.load("key1") is None
+    assert store.load("key2") == b"value2"
+
+
+def test_survives_restart(tmp_path):
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    store.store("drain-state", b"DRAINED")
+    store.store("metric", b"42")
+    store.erase("metric")
+    store.flush()
+    assert store.num_writes_to_disk >= 1
+
+    reopened = PersistentStore(path)
+    assert reopened.load("drain-state") == b"DRAINED"
+    assert reopened.load("metric") is None
+
+
+def test_obj_helpers_roundtrip(tmp_path):
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    entry = PrefixEntry(prefix=IpPrefix("10.0.0.0/24"), type=PrefixType.BGP)
+    store.store_obj("obj", {"entries": [entry], "index": 7})
+    store.flush()
+
+    reopened = PersistentStore(path)
+    loaded = reopened.load_obj("obj")
+    assert loaded["index"] == 7
+    assert loaded["entries"][0] == entry
+
+
+def test_corrupt_file_tolerated(tmp_path):
+    path = str(tmp_path / "store.bin")
+    with open(path, "wb") as f:
+        f.write(b"garbage not a store")
+    store = PersistentStore(path)
+    assert store.data == {}
+    store.store("k", b"v")
+    store.flush()
+    assert PersistentStore(path).load("k") == b"v"
+
+
+def test_write_behind_on_event_loop(tmp_path):
+    path = str(tmp_path / "store.bin")
+
+    async def body():
+        store = PersistentStore(path)
+        for i in range(20):
+            store.store(f"k{i}", str(i).encode())
+        # write-behind: not yet flushed (backoff pending)
+        await asyncio.sleep(0.3)
+        assert store.num_writes_to_disk >= 1
+        # debounce batched all 20 writes into few disk writes
+        assert store.num_writes_to_disk <= 3
+        store.stop()
+
+    asyncio.new_event_loop().run_until_complete(body())
+    assert PersistentStore(path).load("k19") == b"19"
+
+
+def test_dryrun_writes_nothing(tmp_path):
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path, dryrun=True)
+    store.store("k", b"v")
+    store.flush()
+    assert not os.path.exists(path)

@@ -1,0 +1,318 @@
+"""PrefixManager tests, mirroring
+openr/prefix-manager/tests/PrefixManagerTest.cpp core scenarios: advertise/
+withdraw/sync per type, type preference, per-prefix keys in KvStore,
+tombstone on withdraw, persistence across restart, update-request queue,
+cross-area redistribution."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.configstore import PersistentStore
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreClient,
+)
+from openr_tpu.messaging import RWQueue
+from openr_tpu.prefixmanager import (
+    PrefixEventCommand,
+    PrefixManager,
+    PrefixManagerConfig,
+    PrefixUpdateRequest,
+)
+from openr_tpu.solver.routes import RibUnicastEntry
+from openr_tpu.types import (
+    IpPrefix,
+    NextHop,
+    PrefixEntry,
+    PrefixType,
+    prefix_key,
+)
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=15.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+async def wait_until(predicate, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_event_loop().time() < deadline, "timed out"
+        await asyncio.sleep(0.01)
+
+
+def entry(prefix, ptype=PrefixType.LOOPBACK):
+    return PrefixEntry(prefix=IpPrefix(prefix), type=ptype)
+
+
+def make_pm(areas=("0",), config_store=None, with_queues=False):
+    store = KvStore("n1", list(areas), InProcessTransport())
+    client = KvStoreClient(store)
+    prefix_q = RWQueue() if with_queues else None
+    route_q = RWQueue() if with_queues else None
+    pm = PrefixManager(
+        PrefixManagerConfig(
+            node_name="n1", areas=list(areas), sync_throttle=0.001
+        ),
+        client,
+        config_store=config_store,
+        prefix_updates=prefix_q,
+        route_updates=route_q,
+    )
+    return pm, store, client, prefix_q, route_q
+
+
+def kv_prefix_db(store, key, area="0"):
+    value = store.get_key(key, area=area)
+    if value is None or value.value is None:
+        return None
+    return serializer.loads(value.value)
+
+
+class TestAdvertiseWithdraw:
+    def test_advertise_creates_per_prefix_key(self):
+        async def body():
+            pm, store, client, _, _ = make_pm()
+            pm.start()
+            assert pm.advertise_prefixes([entry("10.0.0.0/24")])
+            await asyncio.sleep(0.05)
+            key = prefix_key("n1", IpPrefix("10.0.0.0/24"), "0")
+            db = kv_prefix_db(store, key)
+            assert db is not None and not db.delete_prefix
+            assert db.prefix_entries[0].prefix == IpPrefix("10.0.0.0/24")
+            assert pm.get_prefixes() == [entry("10.0.0.0/24")]
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+    def test_withdraw_emits_tombstone(self):
+        async def body():
+            pm, store, client, _, _ = make_pm()
+            pm.start()
+            pm.advertise_prefixes([entry("10.0.0.0/24")])
+            await asyncio.sleep(0.05)
+            assert pm.withdraw_prefixes([entry("10.0.0.0/24")])
+            await asyncio.sleep(0.05)
+            key = prefix_key("n1", IpPrefix("10.0.0.0/24"), "0")
+            db = kv_prefix_db(store, key)
+            assert db is not None and db.delete_prefix
+            assert pm.get_prefixes() == []
+            # withdrawing again is a no-op
+            assert not pm.withdraw_prefixes([entry("10.0.0.0/24")])
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+    def test_withdraw_and_sync_by_type(self):
+        async def body():
+            pm, store, client, _, _ = make_pm()
+            pm.start()
+            pm.advertise_prefixes(
+                [
+                    entry("10.0.0.0/24", PrefixType.BGP),
+                    entry("10.0.1.0/24", PrefixType.BGP),
+                    entry("10.0.2.0/24", PrefixType.LOOPBACK),
+                ]
+            )
+            assert len(pm.get_prefixes_by_type(PrefixType.BGP)) == 2
+            assert pm.sync_prefixes_by_type(
+                PrefixType.BGP, [entry("10.0.9.0/24", PrefixType.BGP)]
+            )
+            assert pm.get_prefixes_by_type(PrefixType.BGP) == [
+                entry("10.0.9.0/24", PrefixType.BGP)
+            ]
+            assert pm.withdraw_prefixes_by_type(PrefixType.BGP)
+            assert pm.get_prefixes_by_type(PrefixType.BGP) == []
+            # LOOPBACK untouched
+            assert len(pm.get_prefixes_by_type(PrefixType.LOOPBACK)) == 1
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+    def test_lowest_type_wins_for_same_prefix(self):
+        async def body():
+            pm, store, client, _, _ = make_pm()
+            pm.start()
+            pm.advertise_prefixes([entry("10.0.0.0/24", PrefixType.BGP)])
+            pm.advertise_prefixes(
+                [entry("10.0.0.0/24", PrefixType.LOOPBACK)]
+            )
+            await asyncio.sleep(0.05)
+            key = prefix_key("n1", IpPrefix("10.0.0.0/24"), "0")
+            db = kv_prefix_db(store, key)
+            # LOOPBACK precedes BGP in PrefixType order
+            assert db.prefix_entries[0].type == PrefixType.LOOPBACK
+            # withdrawing the winning type falls back to the other
+            pm.withdraw_prefixes([entry("10.0.0.0/24", PrefixType.LOOPBACK)])
+            await asyncio.sleep(0.05)
+            db = kv_prefix_db(store, key)
+            assert db.prefix_entries[0].type == PrefixType.BGP
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+
+class TestQueueAndPersistence:
+    def test_update_request_queue(self):
+        async def body():
+            pm, store, client, prefix_q, _ = make_pm(with_queues=True)
+            pm.start()
+            prefix_q.push(
+                PrefixUpdateRequest(
+                    cmd=PrefixEventCommand.ADD_PREFIXES,
+                    prefixes=[entry("10.1.0.0/24")],
+                )
+            )
+            await wait_until(lambda: pm.get_prefixes())
+            prefix_q.push(
+                PrefixUpdateRequest(
+                    cmd=PrefixEventCommand.WITHDRAW_PREFIXES_BY_TYPE,
+                    type=PrefixType.LOOPBACK,
+                )
+            )
+            await wait_until(lambda: not pm.get_prefixes())
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+    def test_prefixes_survive_restart(self, tmp_path):
+        async def body():
+            cs = PersistentStore(str(tmp_path / "cs.bin"))
+            pm, store, client, _, _ = make_pm(config_store=cs)
+            pm.start()
+            pm.advertise_prefixes([entry("10.2.0.0/24", PrefixType.CONFIG)])
+            await asyncio.sleep(0.05)
+            pm.stop()
+            client.stop()
+            cs.flush()
+
+            pm2, store2, client2, _, _ = make_pm(
+                config_store=PersistentStore(str(tmp_path / "cs.bin"))
+            )
+            pm2.start()
+            await asyncio.sleep(0.05)
+            assert pm2.get_prefixes() == [
+                entry("10.2.0.0/24", PrefixType.CONFIG)
+            ]
+            # re-advertised into the fresh kvstore
+            key = prefix_key("n1", IpPrefix("10.2.0.0/24"), "0")
+            assert kv_prefix_db(store2, key) is not None
+            pm2.stop()
+            client2.stop()
+
+        run(body())
+
+    def test_stale_keys_from_previous_incarnation_cleared(self):
+        async def body():
+            # a prior incarnation's key sits in the store
+            store = KvStore("n1", ["0"], InProcessTransport())
+            from openr_tpu.types import PrefixDatabase, Value
+
+            stale_key = prefix_key("n1", IpPrefix("10.9.0.0/24"), "0")
+            stale_db = PrefixDatabase(
+                this_node_name="n1",
+                prefix_entries=[entry("10.9.0.0/24")],
+                area="0",
+            )
+            store.set_key(
+                stale_key,
+                Value(1, "n1", serializer.dumps(stale_db), ttl=60000),
+            )
+            client = KvStoreClient(store)
+            pm = PrefixManager(
+                PrefixManagerConfig(
+                    node_name="n1", areas=["0"], sync_throttle=0.001
+                ),
+                client,
+            )
+            pm.start()
+            pm.advertise_prefixes([entry("10.8.0.0/24")])
+            await asyncio.sleep(0.05)
+            db = kv_prefix_db(store, stale_key)
+            assert db is not None and db.delete_prefix  # tombstoned
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+
+class TestRedistribution:
+    def test_cross_area_route_redistribution(self):
+        async def body():
+            pm, store, client, _, route_q = make_pm(
+                areas=("area1", "area2"), with_queues=True
+            )
+            pm.start()
+            # a route learned from area1 gets re-originated into area2
+            route_q.push(
+                type(
+                    "U",
+                    (),
+                    {
+                        "unicast_routes_to_update": [
+                            RibUnicastEntry(
+                                prefix=IpPrefix("10.3.0.0/24"),
+                                nexthops={
+                                    NextHop("fe80::1", area="area1")
+                                },
+                                best_prefix_entry=entry("10.3.0.0/24"),
+                                best_area="area1",
+                            )
+                        ],
+                        "unicast_routes_to_delete": [],
+                    },
+                )()
+            )
+            await wait_until(
+                lambda: pm.get_prefixes_by_type(PrefixType.RIB)
+            )
+            rib = pm.get_prefixes_by_type(PrefixType.RIB)[0]
+            assert rib.area_stack == ("area1",)
+            await asyncio.sleep(0.05)
+            key2 = prefix_key("n1", IpPrefix("10.3.0.0/24"), "area2")
+            assert kv_prefix_db(store, key2, area="area2") is not None
+            # NOT advertised back into area1
+            key1 = prefix_key("n1", IpPrefix("10.3.0.0/24"), "area1")
+            assert kv_prefix_db(store, key1, area="area1") is None
+            pm.stop()
+            client.stop()
+
+        run(body())
+
+    def test_single_area_no_redistribution(self):
+        async def body():
+            pm, store, client, _, route_q = make_pm(with_queues=True)
+            pm.start()
+            route_q.push(
+                type(
+                    "U",
+                    (),
+                    {
+                        "unicast_routes_to_update": [
+                            RibUnicastEntry(
+                                prefix=IpPrefix("10.3.0.0/24"),
+                                nexthops={NextHop("fe80::1", area="0")},
+                                best_prefix_entry=entry("10.3.0.0/24"),
+                                best_area="0",
+                            )
+                        ],
+                        "unicast_routes_to_delete": [],
+                    },
+                )()
+            )
+            await asyncio.sleep(0.1)
+            assert pm.get_prefixes_by_type(PrefixType.RIB) == []
+            pm.stop()
+            client.stop()
+
+        run(body())
