@@ -1,0 +1,1 @@
+"""Operator CLI (breeze equivalent, openr/py/openr/cli/)."""

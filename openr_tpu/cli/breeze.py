@@ -1,0 +1,368 @@
+"""breeze — operator CLI for the openr-tpu daemon.
+
+Equivalent of openr/py/openr/cli/breeze.py (the click CLI root) and the
+command impls under openr/py/openr/cli/commands/: per-module command groups
+talking to the ctrl server (kvstore / decision / fib / lm / prefixmgr /
+monitor / openr). argparse instead of click (no extra deps in this image);
+same command vocabulary:
+
+  breeze kvstore keys|keyvals|peers|areas
+  breeze decision adj|prefixes|routes|rib-policy
+  breeze fib routes|unicast-routes|mpls-routes|counters
+  breeze lm links|set-node-overload|unset-node-overload|
+            set-link-overload|unset-link-overload|
+            set-link-metric|unset-link-metric
+  breeze prefixmgr view|advertise|withdraw|sync
+  breeze monitor counters|logs
+  breeze openr version|config
+
+Run as: python -m openr_tpu.cli.breeze --host H --port P <module> <cmd> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, List
+
+from openr_tpu.ctrl.client import (
+    BlockingCtrlClient,
+    decode_obj,
+    encode_obj,
+)
+
+VERSION = "openr-tpu 1.0 (Open/R protocol compatible rebuild)"
+
+
+def _print_json(data: Any) -> None:
+    print(json.dumps(data, indent=2, sort_keys=True, default=str))
+
+
+def _print_table(headers: List[str], rows: List[List[Any]]) -> None:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    line = "  ".join(str(h).ljust(w) for h, w in zip(headers, widths))
+    print(line)
+    print("-" * len(line))
+    for row in rows:
+        print("  ".join(str(c).ljust(w) for c, w in zip(row, widths)))
+
+
+def _fmt_nexthops(route) -> str:
+    return ", ".join(
+        f"{nh.address}%{nh.iface or '*'} (m={nh.metric}, w={nh.weight})"
+        for nh in route.nexthops
+    )
+
+
+# ---------------------------------------------------------------------------
+# command handlers
+# ---------------------------------------------------------------------------
+
+
+def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "keys":
+        pub = client.call(
+            "getKvStoreKeyValsFiltered",
+            area=args.area,
+            prefixes=[args.prefix] if args.prefix else [],
+        )
+        rows = [
+            [k, v["originator_id"], v["version"], v["ttl"], v["ttl_version"]]
+            for k, v in sorted(pub["key_vals"].items())
+        ]
+        _print_table(
+            ["Key", "Originator", "Version", "TTL(ms)", "TTL-Version"], rows
+        )
+    elif args.cmd == "keyvals":
+        pub = client.call(
+            "getKvStoreKeyVals", area=args.area, keys=args.keys
+        )
+        for key, v in sorted(pub["key_vals"].items()):
+            print(f"> {key}")
+            obj = decode_obj(v["value"])
+            _print_json(
+                obj if not hasattr(obj, "__dict__") else vars(obj)
+            )
+    elif args.cmd == "peers":
+        peers = client.call("getKvStorePeers", area=args.area)
+        _print_table(
+            ["Peer", "Address"],
+            [[name, spec["peer_addr"]] for name, spec in sorted(peers.items())],
+        )
+    elif args.cmd == "areas":
+        _print_json(client.call("getAreasConfig"))
+
+
+def cmd_decision(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "adj":
+        dbs = client.call("getDecisionAdjacencyDbs")
+        rows = []
+        for node, blob in sorted(dbs.items()):
+            db = decode_obj(blob)
+            for adj in db.adjacencies:
+                rows.append(
+                    [
+                        node,
+                        adj.other_node_name,
+                        adj.if_name,
+                        adj.metric,
+                        "overloaded" if adj.is_overloaded else "",
+                    ]
+                )
+        _print_table(["Node", "Neighbor", "Iface", "Metric", "Flags"], rows)
+    elif args.cmd == "prefixes":
+        dbs = client.call("getDecisionPrefixDbs")
+        rows = []
+        for node_area, blob in sorted(dbs.items()):
+            db = decode_obj(blob)
+            for entry in db.prefix_entries:
+                rows.append(
+                    [node_area, str(entry.prefix), entry.type.value]
+                )
+        _print_table(["Node:Area", "Prefix", "Type"], rows)
+    elif args.cmd == "routes":
+        db = client.call("getRouteDbComputed", node=args.node)
+        rows = []
+        for blob in db["unicast_routes"]:
+            route = decode_obj(blob)
+            rows.append([str(route.dest), _fmt_nexthops(route)])
+        _print_table(["Prefix", "Nexthops"], rows)
+        if db["mpls_routes"]:
+            rows = []
+            for blob in db["mpls_routes"]:
+                route = decode_obj(blob)
+                rows.append([route.top_label, _fmt_nexthops(route)])
+            _print_table(["Label", "Nexthops"], rows)
+    elif args.cmd == "rib-policy":
+        _print_json(client.call("getRibPolicy"))
+
+
+def cmd_fib(client: BlockingCtrlClient, args) -> None:
+    if args.cmd in ("routes", "unicast-routes"):
+        routes = client.call(
+            "getUnicastRoutesFiltered", prefixes=args.prefixes or []
+        )
+        rows = []
+        for blob in routes:
+            route = decode_obj(blob)
+            rows.append([str(route.dest), _fmt_nexthops(route)])
+        _print_table(["Prefix", "Nexthops"], rows)
+    elif args.cmd == "mpls-routes":
+        routes = client.call("getMplsRoutesFiltered", labels=[])
+        rows = []
+        for blob in routes:
+            route = decode_obj(blob)
+            rows.append([route.top_label, _fmt_nexthops(route)])
+        _print_table(["Label", "Nexthops"], rows)
+    elif args.cmd == "counters":
+        counters = client.call("getCounters")
+        fib_counters = {
+            k: v for k, v in sorted(counters.items()) if k.startswith("fib.")
+        }
+        _print_json(fib_counters)
+
+
+def cmd_lm(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "links":
+        ifaces = client.call("getInterfaces")
+        rows = [
+            [
+                name,
+                "UP" if info["is_up"] else "DOWN",
+                "active" if info["is_active"] else "dampened",
+                ",".join(info["addresses"]) or "-",
+            ]
+            for name, info in sorted(ifaces.items())
+        ]
+        _print_table(["Interface", "Status", "Dampening", "Addresses"], rows)
+    elif args.cmd == "set-node-overload":
+        client.call("setNodeOverload")
+        print("node overload: SET")
+    elif args.cmd == "unset-node-overload":
+        client.call("unsetNodeOverload")
+        print("node overload: UNSET")
+    elif args.cmd == "set-link-overload":
+        client.call("setInterfaceOverload", interface=args.interface)
+        print(f"link overload SET on {args.interface}")
+    elif args.cmd == "unset-link-overload":
+        client.call("unsetInterfaceOverload", interface=args.interface)
+        print(f"link overload UNSET on {args.interface}")
+    elif args.cmd == "set-link-metric":
+        client.call(
+            "setInterfaceMetric",
+            interface=args.interface,
+            metric=args.metric,
+        )
+        print(f"metric {args.metric} SET on {args.interface}")
+    elif args.cmd == "unset-link-metric":
+        client.call("unsetInterfaceMetric", interface=args.interface)
+        print(f"metric override UNSET on {args.interface}")
+
+
+def cmd_prefixmgr(client: BlockingCtrlClient, args) -> None:
+    from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+
+    if args.cmd == "view":
+        entries = [decode_obj(b) for b in client.call("getPrefixes")]
+        _print_table(
+            ["Prefix", "Type", "Forwarding"],
+            [
+                [str(e.prefix), e.type.value, e.forwarding_type.name]
+                for e in entries
+            ],
+        )
+    elif args.cmd == "advertise":
+        entries = [
+            PrefixEntry(
+                prefix=IpPrefix(p), type=PrefixType(args.prefix_type)
+            )
+            for p in args.prefixes
+        ]
+        client.call(
+            "advertisePrefixes",
+            prefixes=[encode_obj(e) for e in entries],
+        )
+        print(f"advertised {len(entries)} prefixes")
+    elif args.cmd == "withdraw":
+        entries = [
+            PrefixEntry(
+                prefix=IpPrefix(p), type=PrefixType(args.prefix_type)
+            )
+            for p in args.prefixes
+        ]
+        client.call(
+            "withdrawPrefixes",
+            prefixes=[encode_obj(e) for e in entries],
+        )
+        print(f"withdrew {len(entries)} prefixes")
+    elif args.cmd == "sync":
+        entries = [
+            PrefixEntry(
+                prefix=IpPrefix(p), type=PrefixType(args.prefix_type)
+            )
+            for p in args.prefixes
+        ]
+        client.call(
+            "syncPrefixesByType",
+            type=args.prefix_type,
+            prefixes=[encode_obj(e) for e in entries],
+        )
+        print(f"synced {len(entries)} prefixes of type {args.prefix_type}")
+
+
+def cmd_monitor(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "counters":
+        _print_json(client.call("getCounters"))
+    elif args.cmd == "logs":
+        for log_json in client.call("getEventLogs"):
+            print(log_json)
+
+
+def cmd_openr(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "version":
+        print(VERSION)
+        print("node:", client.call("getMyNodeName"))
+    elif args.cmd == "config":
+        _print_json(client.call("getRunningConfig"))
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="breeze", description="openr-tpu operator CLI"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=2018)
+    sub = parser.add_subparsers(dest="module", required=True)
+
+    kv = sub.add_parser("kvstore").add_subparsers(dest="cmd", required=True)
+    p = kv.add_parser("keys")
+    p.add_argument("--prefix", default="")
+    p.add_argument("--area", default="0")
+    p = kv.add_parser("keyvals")
+    p.add_argument("keys", nargs="+")
+    p.add_argument("--area", default="0")
+    p = kv.add_parser("peers")
+    p.add_argument("--area", default="0")
+    kv.add_parser("areas")
+
+    dec = sub.add_parser("decision").add_subparsers(dest="cmd", required=True)
+    dec.add_parser("adj")
+    dec.add_parser("prefixes")
+    p = dec.add_parser("routes")
+    p.add_argument("--node", default=None)
+    dec.add_parser("rib-policy")
+
+    fib = sub.add_parser("fib").add_subparsers(dest="cmd", required=True)
+    p = fib.add_parser("routes")
+    p.add_argument("prefixes", nargs="*")
+    p = fib.add_parser("unicast-routes")
+    p.add_argument("prefixes", nargs="*")
+    fib.add_parser("mpls-routes")
+    fib.add_parser("counters")
+
+    lm = sub.add_parser("lm").add_subparsers(dest="cmd", required=True)
+    lm.add_parser("links")
+    lm.add_parser("set-node-overload")
+    lm.add_parser("unset-node-overload")
+    for name in ("set-link-overload", "unset-link-overload",
+                 "unset-link-metric"):
+        p = lm.add_parser(name)
+        p.add_argument("interface")
+    p = lm.add_parser("set-link-metric")
+    p.add_argument("interface")
+    p.add_argument("metric", type=int)
+
+    pm = sub.add_parser("prefixmgr").add_subparsers(dest="cmd", required=True)
+    pm.add_parser("view")
+    for name in ("advertise", "withdraw", "sync"):
+        p = pm.add_parser(name)
+        p.add_argument("prefixes", nargs="+")
+        p.add_argument("--prefix-type", default="BREEZE")
+
+    mon = sub.add_parser("monitor").add_subparsers(dest="cmd", required=True)
+    mon.add_parser("counters")
+    mon.add_parser("logs")
+
+    op = sub.add_parser("openr").add_subparsers(dest="cmd", required=True)
+    op.add_parser("version")
+    op.add_parser("config")
+
+    return parser
+
+
+_HANDLERS = {
+    "kvstore": cmd_kvstore,
+    "decision": cmd_decision,
+    "fib": cmd_fib,
+    "lm": cmd_lm,
+    "prefixmgr": cmd_prefixmgr,
+    "monitor": cmd_monitor,
+    "openr": cmd_openr,
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        with BlockingCtrlClient(args.host, args.port) as client:
+            _HANDLERS[args.module](client, args)
+        return 0
+    except ConnectionRefusedError:
+        print(
+            f"cannot connect to openr-tpu at {args.host}:{args.port}",
+            file=sys.stderr,
+        )
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

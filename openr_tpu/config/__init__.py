@@ -1,0 +1,27 @@
+"""Typed configuration (openr/config/ equivalent)."""
+
+from openr_tpu.config.config import (
+    AreaConfig,
+    Config,
+    KvstoreConfig,
+    LinkMonitorConfig,
+    MonitorConfig,
+    OpenrConfig,
+    PrefixAllocationConfig,
+    SparkConfig,
+    StepDetectorConfig,
+    WatchdogConfig,
+)
+
+__all__ = [
+    "AreaConfig",
+    "Config",
+    "KvstoreConfig",
+    "LinkMonitorConfig",
+    "MonitorConfig",
+    "OpenrConfig",
+    "PrefixAllocationConfig",
+    "SparkConfig",
+    "StepDetectorConfig",
+    "WatchdogConfig",
+]

@@ -1,0 +1,291 @@
+"""Typed daemon configuration.
+
+Behavioral port of openr/if/OpenrConfig.thrift:180-244 (the OpenrConfig
+struct with per-module sub-structs and defaults) and openr/config/Config.h
+(the accessor class deriving per-area regex sets and feature predicates).
+Loaded from a JSON file exactly like the reference loads thrift-JSON
+(Main.cpp:199-207); unknown fields are rejected so typos fail loudly
+(Config::Config runs a parse-validate pass).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.types import PrefixForwardingAlgorithm, PrefixForwardingType
+
+
+@dataclass
+class KvstoreFloodRate:
+    flood_msg_per_sec: int = 0
+    flood_msg_burst_size: int = 0
+
+
+@dataclass
+class KvstoreConfig:
+    """OpenrConfig.thrift KvstoreConfig:19."""
+
+    key_ttl_ms: int = 300_000
+    sync_interval_s: int = 60
+    ttl_decrement_ms: int = 1
+    flood_rate: Optional[KvstoreFloodRate] = None
+    set_leaf_node: bool = False
+    key_prefix_filters: List[str] = field(default_factory=list)
+    key_originator_id_filters: List[str] = field(default_factory=list)
+    enable_flood_optimization: bool = False
+    is_flood_root: bool = False
+
+
+@dataclass
+class LinkMonitorConfig:
+    """OpenrConfig.thrift LinkMonitorConfig:35."""
+
+    linkflap_initial_backoff_ms: int = 60_000
+    linkflap_max_backoff_ms: int = 300_000
+    use_rtt_metric: bool = True
+    include_interface_regexes: List[str] = field(default_factory=list)
+    exclude_interface_regexes: List[str] = field(default_factory=list)
+    redistribute_interface_regexes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class StepDetectorConfig:
+    """OpenrConfig.thrift StepDetectorConfig:44."""
+
+    fast_window_size: int = 10
+    slow_window_size: int = 60
+    lower_threshold: int = 2
+    upper_threshold: int = 5
+    ads_threshold: int = 500
+
+
+@dataclass
+class SparkConfig:
+    """OpenrConfig.thrift SparkConfig:52."""
+
+    neighbor_discovery_port: int = 6666
+    hello_time_s: float = 20.0
+    fastinit_hello_time_ms: float = 500.0
+    keepalive_time_s: float = 2.0
+    hold_time_s: float = 10.0
+    graceful_restart_time_s: float = 30.0
+    step_detector_conf: StepDetectorConfig = field(
+        default_factory=StepDetectorConfig
+    )
+
+
+@dataclass
+class WatchdogConfig:
+    """OpenrConfig.thrift WatchdogConfig:65."""
+
+    interval_s: int = 20
+    thread_timeout_s: int = 300
+    max_memory_mb: int = 800
+
+
+@dataclass
+class MonitorConfig:
+    """OpenrConfig.thrift MonitorConfig:71."""
+
+    max_event_log: int = 100
+
+
+@dataclass
+class PrefixAllocationConfig:
+    """OpenrConfig.thrift PrefixAllocationConfig:98."""
+
+    loopback_interface: str = "lo"
+    set_loopback_addr: bool = False
+    override_loopback_addr: bool = False
+    prefix_allocation_mode: str = "DYNAMIC_LEAF_NODE"
+    seed_prefix: Optional[str] = None
+    allocate_prefix_len: Optional[int] = None
+
+
+@dataclass
+class AreaConfig:
+    """OpenrConfig.thrift AreaConfig:135 — area id + interface/neighbor
+    regex membership."""
+
+    area_id: str
+    interface_regexes: List[str] = field(default_factory=list)
+    neighbor_regexes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DecisionConfigSection:
+    """Decision knobs (Flags + OpenrConfig eor/debounce semantics) +
+    the rebuild's solver backend selector (BASELINE.json north star)."""
+
+    debounce_min_ms: float = 10.0
+    debounce_max_ms: float = 250.0
+    compute_lfa_paths: bool = False
+    solver_backend: str = "cpu"  # 'cpu' | 'tpu'
+
+
+@dataclass
+class OpenrConfig:
+    """OpenrConfig.thrift OpenrConfig:180."""
+
+    node_name: str = ""
+    domain: str = "openr"
+    areas: List[AreaConfig] = field(default_factory=list)
+    listen_addr: str = "::"
+    openr_ctrl_port: int = 2018
+    dryrun: bool = False
+    enable_v4: bool = True
+    enable_netlink_fib_handler: bool = False
+    eor_time_s: Optional[int] = None
+    prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
+        PrefixForwardingAlgorithm.SP_ECMP
+    )
+    enable_segment_routing: bool = False
+    prefix_min_nexthop: Optional[int] = None
+    kvstore_config: KvstoreConfig = field(default_factory=KvstoreConfig)
+    link_monitor_config: LinkMonitorConfig = field(
+        default_factory=LinkMonitorConfig
+    )
+    spark_config: SparkConfig = field(default_factory=SparkConfig)
+    decision_config: DecisionConfigSection = field(
+        default_factory=DecisionConfigSection
+    )
+    enable_watchdog: bool = False
+    watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
+    enable_prefix_allocation: bool = False
+    prefix_allocation_config: PrefixAllocationConfig = field(
+        default_factory=PrefixAllocationConfig
+    )
+    enable_ordered_fib_programming: bool = False
+    fib_port: int = 60100
+    enable_rib_policy: bool = False
+    monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    enable_bgp_peering: bool = False
+    bgp_use_igp_metric: bool = False
+
+
+_ENUM_FIELDS = {
+    "prefix_forwarding_type": PrefixForwardingType,
+    "prefix_forwarding_algorithm": PrefixForwardingAlgorithm,
+}
+
+
+def _from_dict(cls, data: Dict[str, Any]):
+    """Recursive dataclass hydration; unknown keys raise (validate pass)."""
+    field_map = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        if key not in field_map:
+            raise ValueError(f"unknown config field {cls.__name__}.{key}")
+        f = field_map[key]
+        if key in _ENUM_FIELDS and isinstance(value, str):
+            value = _ENUM_FIELDS[key][value]
+        elif (
+            f.default_factory is not dataclasses.MISSING  # type: ignore
+            and dataclasses.is_dataclass(f.default_factory)
+            and isinstance(value, dict)
+        ):
+            value = _from_dict(f.default_factory, value)
+        elif key == "areas" and isinstance(value, list):
+            value = [_from_dict(AreaConfig, v) for v in value]
+        elif key == "flood_rate" and isinstance(value, dict):
+            value = _from_dict(KvstoreFloodRate, value)
+        elif key == "step_detector_conf" and isinstance(value, dict):
+            value = _from_dict(StepDetectorConfig, value)
+        kwargs[key] = value
+    return cls(**kwargs)
+
+
+class AreaConfiguration:
+    """Compiled area membership matcher (Config.h:21, derived regex sets)."""
+
+    def __init__(self, area: AreaConfig) -> None:
+        self.area_id = area.area_id
+        self._iface_res = [re.compile(r) for r in area.interface_regexes]
+        self._neighbor_res = [re.compile(r) for r in area.neighbor_regexes]
+
+    def matches_interface(self, if_name: str) -> bool:
+        return any(r.fullmatch(if_name) for r in self._iface_res)
+
+    def matches_neighbor(self, node_name: str) -> bool:
+        return any(r.fullmatch(node_name) for r in self._neighbor_res)
+
+
+class Config:
+    """Accessor wrapper (openr/config/Config.h:34): feature predicates +
+    derived per-area regex matchers."""
+
+    DEFAULT_AREA = "0"
+
+    def __init__(self, config: OpenrConfig) -> None:
+        if not config.node_name:
+            raise ValueError("node_name is required")
+        self.config = config
+        self.area_configurations = [
+            AreaConfiguration(a) for a in config.areas
+        ]
+
+    @staticmethod
+    def load_file(path: str) -> "Config":
+        """Load thrift-JSON-style config file (Main.cpp:199-207)."""
+        with open(path) as f:
+            data = json.load(f)
+        return Config(_from_dict(OpenrConfig, data))
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "Config":
+        return Config(_from_dict(OpenrConfig, data))
+
+    # -- derived -----------------------------------------------------------
+
+    @property
+    def node_name(self) -> str:
+        return self.config.node_name
+
+    def get_area_ids(self) -> List[str]:
+        if not self.config.areas:
+            return [self.DEFAULT_AREA]
+        return [a.area_id for a in self.config.areas]
+
+    def get_area_for(
+        self, if_name: str = "", neighbor_name: str = ""
+    ) -> Optional[str]:
+        """First area whose regexes match (Spark area negotiation seam)."""
+        if not self.area_configurations:
+            return self.DEFAULT_AREA
+        for area in self.area_configurations:
+            if if_name and area.matches_interface(if_name):
+                return area.area_id
+            if neighbor_name and area.matches_neighbor(neighbor_name):
+                return area.area_id
+        return None
+
+    # -- feature predicates (Config.h:60-123) ------------------------------
+
+    def is_v4_enabled(self) -> bool:
+        return self.config.enable_v4
+
+    def is_segment_routing_enabled(self) -> bool:
+        return self.config.enable_segment_routing
+
+    def is_ordered_fib_programming_enabled(self) -> bool:
+        return self.config.enable_ordered_fib_programming
+
+    def is_netlink_fib_handler_enabled(self) -> bool:
+        return self.config.enable_netlink_fib_handler
+
+    def is_prefix_allocation_enabled(self) -> bool:
+        return self.config.enable_prefix_allocation
+
+    def is_rib_policy_enabled(self) -> bool:
+        return self.config.enable_rib_policy
+
+    def is_watchdog_enabled(self) -> bool:
+        return self.config.enable_watchdog
+
+    def is_dryrun(self) -> bool:
+        return self.config.dryrun

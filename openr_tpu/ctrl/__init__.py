@@ -1,0 +1,6 @@
+"""Control-plane API server + client (OpenrCtrl equivalent)."""
+
+from openr_tpu.ctrl.server import CtrlServer
+from openr_tpu.ctrl.client import CtrlClient
+
+__all__ = ["CtrlServer", "CtrlClient"]

@@ -1,0 +1,143 @@
+"""Control-plane client.
+
+Equivalent of openr/py/openr/clients/openr_client.py:25-47 (the thrift
+client factory breeze uses): a thin request/response + streaming client for
+the CtrlServer's newline-JSON protocol. Both async (tests, tooling) and
+blocking (CLI) call styles are provided.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import socket
+from typing import Any, Dict, Iterator, Optional
+
+from openr_tpu.utils import serializer
+
+
+def decode_obj(blob: Optional[str]):
+    """Decode a b64 serializer blob returned by the server."""
+    if blob is None:
+        return None
+    return serializer.loads(base64.b64decode(blob))
+
+
+def encode_obj(obj) -> str:
+    return base64.b64encode(serializer.dumps(obj)).decode()
+
+
+class CtrlError(RuntimeError):
+    pass
+
+
+class CtrlClient:
+    """Async client: one connection, sequential request/response."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 2018) -> None:
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._next_id = 0
+
+    async def connect(self) -> "CtrlClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        return self
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    async def __aenter__(self) -> "CtrlClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def call(self, method: str, **params) -> Any:
+        assert self._writer is not None, "not connected"
+        self._next_id += 1
+        req = {"id": self._next_id, "method": method, "params": params}
+        self._writer.write(json.dumps(req).encode() + b"\n")
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise CtrlError("connection closed")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise CtrlError(resp["error"])
+        return resp.get("result")
+
+    async def subscribe(self, method: str, **params):
+        """Async iterator over stream frames (subscribeKvStoreFilter)."""
+        assert self._writer is not None, "not connected"
+        self._next_id += 1
+        req = {"id": self._next_id, "method": method, "params": params}
+        self._writer.write(json.dumps(req).encode() + b"\n")
+        await self._writer.drain()
+        while True:
+            line = await self._reader.readline()
+            if not line:
+                return
+            frame = json.loads(line)
+            if "error" in frame:
+                raise CtrlError(frame["error"])
+            if frame.get("done"):
+                return
+            yield frame["stream"]
+
+
+class BlockingCtrlClient:
+    """Synchronous client for CLI usage."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 2018, timeout: float = 30.0
+    ) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._file = self._sock.makefile("rwb")
+        self._next_id = 0
+
+    def close(self) -> None:
+        self._file.close()
+        self._sock.close()
+
+    def __enter__(self) -> "BlockingCtrlClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def call(self, method: str, **params) -> Any:
+        self._next_id += 1
+        req = {"id": self._next_id, "method": method, "params": params}
+        self._file.write(json.dumps(req).encode() + b"\n")
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise CtrlError("connection closed")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise CtrlError(resp["error"])
+        return resp.get("result")
+
+    def subscribe(self, method: str, **params) -> Iterator[Dict]:
+        self._next_id += 1
+        req = {"id": self._next_id, "method": method, "params": params}
+        self._file.write(json.dumps(req).encode() + b"\n")
+        self._file.flush()
+        while True:
+            line = self._file.readline()
+            if not line:
+                return
+            frame = json.loads(line)
+            if "error" in frame:
+                raise CtrlError(frame["error"])
+            if frame.get("done"):
+                return
+            yield frame["stream"]

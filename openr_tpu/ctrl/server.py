@@ -1,0 +1,599 @@
+"""Control-plane API server.
+
+Behavioral port of openr/ctrl-server/OpenrCtrlHandler.{h,cpp}: one server
+holding references to every module, exposing the OpenrCtrl surface
+(openr/if/OpenrCtrl.thrift:128-507) — route/adjacency/prefix reads, KvStore
+get/set/dump, drain + metric-override controls, RibPolicy, config-store
+keys, event logs, counters — plus the server-streaming KvStore subscription
+(subscribeKvStoreFilter, OpenrCtrlHandler.h:207-211) and the adjacency
+long-poll (longPollKvStoreAdj, OpenrCtrlLongPollTest.cpp semantics).
+
+Transport is length-free newline-delimited JSON over TCP (the fbthrift
+Rocket transport is Meta-stack-specific; a framed-JSON protocol keeps the
+same request/response + streaming semantics with zero extra dependencies):
+  request:   {"id": N, "method": "...", "params": {...}}
+  response:  {"id": N, "result": ...} | {"id": N, "error": "..."}
+  streaming: {"id": N, "stream": ...}* then {"id": N, "done": true}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+from openr_tpu.messaging import QueueClosedError
+from openr_tpu.types import (
+    ADJ_DB_MARKER,
+    IpPrefix,
+    KeyVals,
+    Publication,
+    Value,
+)
+from openr_tpu.utils import serializer
+
+log = logging.getLogger(__name__)
+
+
+def _b64(data: Optional[bytes]) -> Optional[str]:
+    return None if data is None else base64.b64encode(data).decode()
+
+
+def _unb64(text: Optional[str]) -> Optional[bytes]:
+    return None if text is None else base64.b64decode(text)
+
+
+def _value_to_json(v: Value) -> Dict[str, Any]:
+    return {
+        "version": v.version,
+        "originator_id": v.originator_id,
+        "value": _b64(v.value),
+        "ttl": v.ttl,
+        "ttl_version": v.ttl_version,
+        "hash": v.hash,
+    }
+
+
+def _value_from_json(d: Dict[str, Any]) -> Value:
+    return Value(
+        version=d["version"],
+        originator_id=d["originator_id"],
+        value=_unb64(d.get("value")),
+        ttl=d.get("ttl", -(2**31)),
+        ttl_version=d.get("ttl_version", 0),
+        hash=d.get("hash"),
+    )
+
+
+def _publication_to_json(pub: Publication) -> Dict[str, Any]:
+    return {
+        "area": pub.area,
+        "key_vals": {
+            k: _value_to_json(v) for k, v in pub.key_vals.items()
+        },
+        "expired_keys": list(pub.expired_keys),
+    }
+
+
+def _obj_to_json(obj: Any) -> Any:
+    """Wire dataclasses ride the deterministic serializer as b64 blobs."""
+    return _b64(serializer.dumps(obj))
+
+
+class CtrlServer:
+    def __init__(
+        self,
+        node_name: str,
+        host: str = "127.0.0.1",
+        port: int = 2018,
+        *,
+        kvstore=None,
+        decision=None,
+        fib=None,
+        link_monitor=None,
+        prefix_manager=None,
+        monitor=None,
+        config_store=None,
+        config=None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.host = host
+        self.port = port
+        self.kvstore = kvstore
+        self.decision = decision
+        self.fib = fib
+        self.link_monitor = link_monitor
+        self.prefix_manager = prefix_manager
+        self.monitor = monitor
+        self.config_store = config_store
+        self.config = config
+        self._loop = loop
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
+        self._methods: Dict[str, Callable] = {
+            name[len("m_"):]: getattr(self, name)
+            for name in dir(self)
+            if name.startswith("m_")
+        }
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # cancel in-flight handlers (streaming subscriptions block on
+            # the kvstore updates reader and never see the socket close)
+            for task in list(self._conn_tasks):
+                task.cancel()
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+            self._conn_tasks.clear()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    method = self._methods.get(req.get("method", ""))
+                    if method is None:
+                        resp = {
+                            "id": req.get("id"),
+                            "error": f"unknown method {req.get('method')}",
+                        }
+                    else:
+                        result = method(req.get("params") or {})
+                        if asyncio.iscoroutine(result):
+                            result = await result
+                        if result is _STREAMING:
+                            # streaming method wrote frames itself
+                            continue
+                        resp = {"id": req.get("id"), "result": result}
+                except _Streaming as stream:
+                    await stream.run(req.get("id"), writer)
+                    continue
+                except Exception as exc:  # per-request isolation
+                    log.exception("ctrl method failed")
+                    resp = {"id": req.get("id"), "error": str(exc)}
+                writer.write(json.dumps(resp).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+    # ------------------------------------------------------------------
+    # identity / config
+    # ------------------------------------------------------------------
+
+    def m_getMyNodeName(self, params) -> str:
+        return self.node_name
+
+    def m_getRunningConfig(self, params) -> Optional[dict]:
+        if self.config is None:
+            return None
+        import dataclasses
+
+        def enc(obj):
+            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+                return {
+                    f.name: enc(getattr(obj, f.name))
+                    for f in dataclasses.fields(obj)
+                }
+            if isinstance(obj, (list, tuple)):
+                return [enc(x) for x in obj]
+            if hasattr(obj, "name") and hasattr(obj, "value"):
+                return obj.name  # enum
+            return obj
+
+        return enc(self.config.config)
+
+    def m_getCounters(self, params) -> Dict[str, int]:
+        if self.monitor is not None:
+            return self.monitor.get_counters()
+        counters: Dict[str, int] = {}
+        for module in (self.decision, self.fib, self.link_monitor):
+            if module is not None and hasattr(module, "counters"):
+                counters.update(module.counters)
+        return counters
+
+    def m_getEventLogs(self, params) -> List[str]:
+        if self.monitor is None:
+            return []
+        return [s.to_json() for s in self.monitor.get_event_logs()]
+
+    # ------------------------------------------------------------------
+    # route APIs
+    # ------------------------------------------------------------------
+
+    def m_getRouteDb(self, params) -> Dict[str, Any]:
+        assert self.fib is not None, "fib module not attached"
+        db = self.fib.get_route_db()
+        return {
+            "this_node_name": db["this_node_name"],
+            "unicast_routes": [_obj_to_json(r) for r in db["unicast_routes"]],
+            "mpls_routes": [_obj_to_json(r) for r in db["mpls_routes"]],
+        }
+
+    def m_getRouteDbComputed(self, params) -> Dict[str, Any]:
+        assert self.decision is not None, "decision module not attached"
+        node = params.get("node") or None
+        db = self.decision.get_decision_route_db(node)
+        unicast = []
+        mpls = []
+        if db is not None:
+            unicast = [
+                _obj_to_json(e.to_unicast_route())
+                for e in db.unicast_entries.values()
+            ]
+            mpls = [
+                _obj_to_json(e.to_mpls_route())
+                for e in db.mpls_entries.values()
+            ]
+        return {
+            "this_node_name": node or self.node_name,
+            "unicast_routes": unicast,
+            "mpls_routes": mpls,
+        }
+
+    def m_getUnicastRoutesFiltered(self, params) -> List[Any]:
+        assert self.fib is not None
+        routes = self.fib.get_unicast_routes(params.get("prefixes"))
+        return [_obj_to_json(r) for r in routes]
+
+    def m_getUnicastRoutes(self, params) -> List[Any]:
+        return self.m_getUnicastRoutesFiltered({})
+
+    def m_getMplsRoutesFiltered(self, params) -> List[Any]:
+        assert self.fib is not None
+        routes = self.fib.get_mpls_routes(params.get("labels"))
+        return [_obj_to_json(r) for r in routes]
+
+    def m_getMplsRoutes(self, params) -> List[Any]:
+        return self.m_getMplsRoutesFiltered({})
+
+    def m_getPerfDb(self, params) -> List[Any]:
+        assert self.fib is not None
+        return [_obj_to_json(p) for p in self.fib.get_perf_db()]
+
+    # ------------------------------------------------------------------
+    # decision APIs
+    # ------------------------------------------------------------------
+
+    def m_getDecisionAdjacencyDbs(self, params) -> Dict[str, Any]:
+        assert self.decision is not None
+        return {
+            node: _obj_to_json(db)
+            for node, db in self.decision.get_adjacency_databases().items()
+        }
+
+    def m_getDecisionPrefixDbs(self, params) -> Dict[str, Any]:
+        assert self.decision is not None
+        return {
+            f"{node}:{area}": _obj_to_json(db)
+            for (
+                node,
+                area,
+            ), db in self.decision.get_prefix_databases().items()
+        }
+
+    def m_setRibPolicy(self, params) -> None:
+        assert self.decision is not None
+        from openr_tpu.solver.rib_policy import RibPolicy
+
+        policy = RibPolicy.from_dict(params["policy"])
+        self.decision.set_rib_policy(policy)
+
+    def m_getRibPolicy(self, params) -> Optional[dict]:
+        assert self.decision is not None
+        policy = self.decision.get_rib_policy()
+        return None if policy is None else policy.to_dict()
+
+    # ------------------------------------------------------------------
+    # prefix manager APIs
+    # ------------------------------------------------------------------
+
+    def _parse_prefix_entries(self, blobs: List[str]):
+        return [serializer.loads(_unb64(b)) for b in blobs]
+
+    def m_advertisePrefixes(self, params) -> bool:
+        assert self.prefix_manager is not None
+        return self.prefix_manager.advertise_prefixes(
+            self._parse_prefix_entries(params["prefixes"])
+        )
+
+    def m_withdrawPrefixes(self, params) -> bool:
+        assert self.prefix_manager is not None
+        return self.prefix_manager.withdraw_prefixes(
+            self._parse_prefix_entries(params["prefixes"])
+        )
+
+    def m_withdrawPrefixesByType(self, params) -> bool:
+        assert self.prefix_manager is not None
+        from openr_tpu.types import PrefixType
+
+        return self.prefix_manager.withdraw_prefixes_by_type(
+            PrefixType(params["type"])
+        )
+
+    def m_syncPrefixesByType(self, params) -> bool:
+        assert self.prefix_manager is not None
+        from openr_tpu.types import PrefixType
+
+        return self.prefix_manager.sync_prefixes_by_type(
+            PrefixType(params["type"]),
+            self._parse_prefix_entries(params["prefixes"]),
+        )
+
+    def m_getPrefixes(self, params) -> List[Any]:
+        assert self.prefix_manager is not None
+        return [_obj_to_json(e) for e in self.prefix_manager.get_prefixes()]
+
+    def m_getPrefixesByType(self, params) -> List[Any]:
+        assert self.prefix_manager is not None
+        from openr_tpu.types import PrefixType
+
+        return [
+            _obj_to_json(e)
+            for e in self.prefix_manager.get_prefixes_by_type(
+                PrefixType(params["type"])
+            )
+        ]
+
+    # ------------------------------------------------------------------
+    # kvstore APIs
+    # ------------------------------------------------------------------
+
+    def m_getKvStoreKeyVals(self, params) -> Dict[str, Any]:
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        keys = params.get("keys", [])
+        pub = self.kvstore.db(area).get_key_vals(keys)
+        return _publication_to_json(pub)
+
+    def m_getKvStoreKeyValsFiltered(self, params) -> Dict[str, Any]:
+        assert self.kvstore is not None
+        from openr_tpu.kvstore import KvStoreFilters
+
+        area = params.get("area", "0")
+        filters = KvStoreFilters(
+            key_prefixes=params.get("prefixes") or [],
+            originator_ids=set(params.get("originators") or []),
+        )
+        pub = self.kvstore.dump_all(area=area, filters=filters)
+        return _publication_to_json(pub)
+
+    def m_getKvStoreHashFiltered(self, params) -> Dict[str, Any]:
+        assert self.kvstore is not None
+        from openr_tpu.kvstore import KvStoreFilters
+
+        area = params.get("area", "0")
+        filters = KvStoreFilters(
+            key_prefixes=params.get("prefixes") or []
+        )
+        pub = self.kvstore.db(area).dump_hashes(filters)
+        return _publication_to_json(pub)
+
+    def m_setKvStoreKeyVals(self, params) -> None:
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        key_vals: KeyVals = {
+            k: _value_from_json(v)
+            for k, v in params.get("key_vals", {}).items()
+        }
+        self.kvstore.db(area).set_key_vals(key_vals)
+
+    def m_getKvStorePeers(self, params) -> Dict[str, Any]:
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        return {
+            name: {"peer_addr": spec.peer_addr}
+            for name, spec in self.kvstore.db(area).get_peers().items()
+        }
+
+    def m_getAreasConfig(self, params) -> Dict[str, Any]:
+        assert self.kvstore is not None
+        return {"areas": sorted(self.kvstore.dbs.keys())}
+
+    def m_longPollKvStoreAdj(self, params):
+        """Block until any adj: key differs from the client's snapshot
+        (OpenrCtrl.thrift:353, OpenrCtrlLongPollTest)."""
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        snapshot: Dict[str, int] = params.get("snapshot", {})
+        timeout = float(params.get("timeout_s", 20.0))
+
+        def adj_changed() -> bool:
+            pub = self.kvstore.dump_all(area=area)
+            current = {
+                k: v.version
+                for k, v in pub.key_vals.items()
+                if k.startswith(ADJ_DB_MARKER)
+            }
+            for key, version in current.items():
+                if snapshot.get(key, -1) < version:
+                    return True
+            return any(k not in current for k in snapshot)
+
+        async def wait() -> bool:
+            if adj_changed():
+                return True
+            reader = self.kvstore.updates_queue.get_reader()
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + timeout
+            try:
+                while loop.time() < deadline:
+                    try:
+                        pub = await asyncio.wait_for(
+                            reader.get(), deadline - loop.time()
+                        )
+                    except (asyncio.TimeoutError, QueueClosedError):
+                        return False
+                    if pub.area != area:
+                        continue
+                    if any(
+                        k.startswith(ADJ_DB_MARKER)
+                        for k in list(pub.key_vals) + pub.expired_keys
+                    ):
+                        return True
+                return False
+            finally:
+                reader.close()
+
+        return wait()
+
+    def m_subscribeKvStoreFilter(self, params):
+        """Server-streaming KvStore subscription
+        (OpenrCtrlHandler.h:207-211): initial full dump frame, then every
+        matching publication as a stream frame."""
+        assert self.kvstore is not None
+        raise _Streaming(self._kvstore_stream, params)
+
+    async def _kvstore_stream(self, req_id, writer, params) -> None:
+        from openr_tpu.kvstore import KvStoreFilters
+
+        area = params.get("area", "0")
+        prefixes = params.get("prefixes") or []
+        filters = (
+            KvStoreFilters(key_prefixes=prefixes) if prefixes else None
+        )
+        snapshot = self.kvstore.dump_all(area=area, filters=filters)
+        frame = {
+            "id": req_id,
+            "stream": _publication_to_json(snapshot),
+        }
+        writer.write(json.dumps(frame).encode() + b"\n")
+        await writer.drain()
+        reader = self.kvstore.updates_queue.get_reader()
+        try:
+            while True:
+                pub = await reader.get()
+                if pub.area != area:
+                    continue
+                if prefixes:
+                    key_vals = {
+                        k: v
+                        for k, v in pub.key_vals.items()
+                        if any(k.startswith(p) for p in prefixes)
+                    }
+                    expired = [
+                        k
+                        for k in pub.expired_keys
+                        if any(k.startswith(p) for p in prefixes)
+                    ]
+                    if not key_vals and not expired:
+                        continue
+                    pub = Publication(
+                        key_vals=key_vals, expired_keys=expired, area=area
+                    )
+                frame = {"id": req_id, "stream": _publication_to_json(pub)}
+                writer.write(json.dumps(frame).encode() + b"\n")
+                await writer.drain()
+        except (
+            QueueClosedError,
+            ConnectionResetError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            reader.close()
+
+    # ------------------------------------------------------------------
+    # link monitor APIs (drain / metric overrides)
+    # ------------------------------------------------------------------
+
+    def m_setNodeOverload(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_node_overload(True)
+
+    def m_unsetNodeOverload(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_node_overload(False)
+
+    def m_setInterfaceOverload(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_link_overload(params["interface"], True)
+
+    def m_unsetInterfaceOverload(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_link_overload(params["interface"], False)
+
+    def m_setInterfaceMetric(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_link_metric(
+            params["interface"], int(params["metric"])
+        )
+
+    def m_unsetInterfaceMetric(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_link_metric(params["interface"], None)
+
+    def m_getInterfaces(self, params) -> Dict[str, Any]:
+        assert self.link_monitor is not None
+        return {
+            name: {
+                "is_up": e.is_up,
+                "is_active": e.is_active(),
+                "addresses": list(e.addresses),
+            }
+            for name, e in self.link_monitor.get_interfaces().items()
+        }
+
+    def m_getLinkMonitorAdjacencies(self, params) -> List[Any]:
+        assert self.link_monitor is not None
+        return [
+            _obj_to_json(adj)
+            for adj in self.link_monitor.get_adjacencies().values()
+        ]
+
+    # ------------------------------------------------------------------
+    # config-store APIs
+    # ------------------------------------------------------------------
+
+    def m_setConfigKey(self, params) -> None:
+        assert self.config_store is not None
+        self.config_store.store(params["key"], _unb64(params["value"]))
+
+    def m_eraseConfigKey(self, params) -> bool:
+        assert self.config_store is not None
+        return self.config_store.erase(params["key"])
+
+    def m_getConfigKey(self, params) -> Optional[str]:
+        assert self.config_store is not None
+        return _b64(self.config_store.load(params["key"]))
+
+
+class _Streaming(Exception):
+    """Raised by streaming methods; _handle_conn runs the stream."""
+
+    def __init__(self, fn, params) -> None:
+        super().__init__("streaming")
+        self.fn = fn
+        self.params = params
+
+    async def run(self, req_id, writer) -> None:
+        await self.fn(req_id, writer, self.params)
+
+
+_STREAMING = object()

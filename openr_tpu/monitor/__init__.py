@@ -1,0 +1,9 @@
+"""Observability: structured event logs, counters aggregation, watchdog.
+
+Equivalents of openr/monitor/ (MonitorBase, LogSample) and openr/watchdog/.
+"""
+
+from openr_tpu.monitor.monitor import LogSample, Monitor
+from openr_tpu.monitor.watchdog import Watchdog, WatchdogConfig
+
+__all__ = ["LogSample", "Monitor", "Watchdog", "WatchdogConfig"]

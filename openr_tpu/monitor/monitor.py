@@ -1,0 +1,123 @@
+"""Structured event logging + counter aggregation.
+
+Behavioral port of openr/monitor/: LogSample (monitor/LogSample.h) is a
+typed key→value event record; Monitor (monitor/MonitorBase.h:26-62) drains
+the log-sample queue into a bounded ring (monitor_config.max_event_log) and
+aggregates fb303-style counters from every registered module (the
+reference's fbData singleton is replaced by each module's CountersMixin
+dict, pulled on demand)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.messaging import QueueClosedError, RQueue
+
+EVENT_LOG_CATEGORY = "openr.event_logs"  # Constants::kEventLogCategory
+
+
+class LogSample:
+    """monitor/LogSample.h: typed structured event."""
+
+    def __init__(self, timestamp: Optional[float] = None) -> None:
+        self.timestamp = timestamp if timestamp is not None else time.time()
+        self._values: Dict[str, Any] = {}
+
+    def add_string(self, key: str, value: str) -> "LogSample":
+        self._values[key] = value
+        return self
+
+    def add_int(self, key: str, value: int) -> "LogSample":
+        self._values[key] = int(value)
+        return self
+
+    def add_double(self, key: str, value: float) -> "LogSample":
+        self._values[key] = float(value)
+        return self
+
+    def add_string_vector(self, key: str, values: List[str]) -> "LogSample":
+        self._values[key] = list(values)
+        return self
+
+    def get(self, key: str) -> Any:
+        return self._values.get(key)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"time": int(self.timestamp), **self._values}, sort_keys=True
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "LogSample":
+        data = json.loads(text)
+        sample = LogSample(timestamp=data.pop("time", 0))
+        sample._values = data
+        return sample
+
+
+class Monitor:
+    """Counter aggregation + event-log ring (MonitorBase equivalent)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        log_sample_queue: Optional[RQueue] = None,
+        max_event_log: int = 100,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.log_sample_queue = log_sample_queue
+        self.max_event_log = max_event_log
+        self._loop = loop
+        self.event_logs: List[LogSample] = []
+        # name -> module exposing .counters dict (CountersMixin)
+        self._modules: Dict[str, object] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.process_start = time.time()
+
+    def register_module(self, name: str, module: object) -> None:
+        """Modules register so their counters appear in getCounters."""
+        self._modules[name] = module
+
+    def start(self) -> None:
+        if self.log_sample_queue is not None:
+            loop = self._loop or asyncio.get_event_loop()
+            self._task = loop.create_task(self._drain())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _drain(self) -> None:
+        while True:
+            try:
+                sample = await self.log_sample_queue.get()
+            except (QueueClosedError, asyncio.CancelledError):
+                return
+            self.add_event_log(sample)
+
+    def add_event_log(self, sample: LogSample) -> None:
+        if sample.get("node_name") is None:
+            sample.add_string("node_name", self.node_name)
+        self.event_logs.append(sample)
+        while len(self.event_logs) > self.max_event_log:
+            self.event_logs.pop(0)
+
+    def get_event_logs(self) -> List[LogSample]:
+        return list(self.event_logs)
+
+    def get_counters(self) -> Dict[str, int]:
+        """Merged counters of every registered module + process stats
+        (the getCounters thrift API surface)."""
+        merged: Dict[str, int] = {
+            "process.uptime.seconds": int(time.time() - self.process_start),
+        }
+        for module in self._modules.values():
+            counters = getattr(module, "counters", None)
+            if isinstance(counters, dict):
+                merged.update(counters)
+        return merged

@@ -77,3 +77,34 @@ class RibPolicy:
             if s.apply_action(route):
                 return True
         return False
+
+    # -- ctrl-plane (de)serialization (OpenrCtrl.thrift RibPolicy:84-123) --
+
+    def to_dict(self) -> dict:
+        return {
+            "ttl_secs": max(0.0, self.get_ttl_duration()),
+            "statements": [
+                {
+                    "name": s.name,
+                    "prefixes": sorted(str(p) for p in s.prefixes),
+                    "default_weight": s.action.default_weight,
+                    "area_to_weight": dict(s.action.area_to_weight),
+                }
+                for s in self.statements
+            ],
+        }
+
+    @staticmethod
+    def from_dict(data: dict) -> "RibPolicy":
+        statements = [
+            RibPolicyStatement(
+                name=s["name"],
+                prefixes={IpPrefix(p) for p in s["prefixes"]},
+                action=SetWeightAction(
+                    default_weight=s.get("default_weight", 0),
+                    area_to_weight=dict(s.get("area_to_weight", {})),
+                ),
+            )
+            for s in data["statements"]
+        ]
+        return RibPolicy(statements, float(data["ttl_secs"]))

@@ -1,0 +1,225 @@
+"""Ctrl server/client tests, mirroring
+openr/ctrl-server/tests/OpenrCtrlHandlerTest.cpp and
+OpenrCtrlLongPollTest.cpp: per-module APIs over the wire, KvStore
+get/set/dump, streaming subscription, long-poll, drain controls."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.ctrl import CtrlClient, CtrlServer
+from openr_tpu.ctrl.client import CtrlError, decode_obj, encode_obj
+from openr_tpu.fib import Fib, FibConfig
+from openr_tpu.kvstore import InProcessTransport, KvStore
+from openr_tpu.messaging import RWQueue
+from openr_tpu.monitor import LogSample, Monitor
+from openr_tpu.platform import MockFibHandler
+from openr_tpu.solver import DecisionRouteUpdate
+from openr_tpu.solver.routes import RibUnicastEntry
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    IpPrefix,
+    NextHop,
+    PrefixEntry,
+    PrefixType,
+    Value,
+    adj_key,
+)
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=15.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+async def make_server(**modules):
+    server = CtrlServer("test-node", port=0, **modules)
+    port = await server.start()
+    client = await CtrlClient("127.0.0.1", port).connect()
+    return server, client
+
+
+class TestBasics:
+    def test_get_my_node_name(self):
+        async def body():
+            server, client = await make_server()
+            assert await client.call("getMyNodeName") == "test-node"
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_unknown_method_errors(self):
+        async def body():
+            server, client = await make_server()
+            with pytest.raises(CtrlError, match="unknown method"):
+                await client.call("noSuchMethod")
+            # connection still usable after an error
+            assert await client.call("getMyNodeName") == "test-node"
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_counters_and_event_logs(self):
+        async def body():
+            monitor = Monitor("test-node")
+
+            class Fake:
+                counters = {"kvstore.sent_publications": 5}
+
+            monitor.register_module("kvstore", Fake())
+            monitor.add_event_log(LogSample().add_string("event", "NB_UP"))
+            server, client = await make_server(monitor=monitor)
+            counters = await client.call("getCounters")
+            assert counters["kvstore.sent_publications"] == 5
+            logs = await client.call("getEventLogs")
+            assert len(logs) == 1 and "NB_UP" in logs[0]
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+
+class TestKvStoreApis:
+    def test_set_get_dump(self):
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            server, client = await make_server(kvstore=store)
+            await client.call(
+                "setKvStoreKeyVals",
+                key_vals={
+                    "k1": {
+                        "version": 1,
+                        "originator_id": "n1",
+                        "value": encode_obj("payload"),
+                    }
+                },
+            )
+            result = await client.call("getKvStoreKeyVals", keys=["k1"])
+            assert "k1" in result["key_vals"]
+            assert (
+                decode_obj(result["key_vals"]["k1"]["value"]) == "payload"
+            )
+            # filtered dump
+            result = await client.call(
+                "getKvStoreKeyValsFiltered", prefixes=["k"]
+            )
+            assert list(result["key_vals"]) == ["k1"]
+            result = await client.call(
+                "getKvStoreKeyValsFiltered", prefixes=["zzz"]
+            )
+            assert result["key_vals"] == {}
+            # hash dump carries no values
+            result = await client.call("getKvStoreHashFiltered")
+            assert result["key_vals"]["k1"]["value"] is None
+            assert result["key_vals"]["k1"]["hash"] is not None
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_streaming_subscription(self):
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            store.set_key("adj:n1", Value(1, "n1", b"initial"))
+            server, client = await make_server(kvstore=store)
+
+            frames = []
+
+            async def consume():
+                async for frame in client.subscribe(
+                    "subscribeKvStoreFilter", prefixes=["adj:"]
+                ):
+                    frames.append(frame)
+                    if len(frames) >= 2:
+                        return
+
+            task = asyncio.get_event_loop().create_task(consume())
+            await asyncio.sleep(0.1)
+            # initial snapshot frame arrived
+            assert len(frames) == 1
+            assert "adj:n1" in frames[0]["key_vals"]
+            # a matching update streams through; non-matching filtered out
+            store.set_key("prefix:n2", Value(1, "n2", b"x"))
+            store.set_key("adj:n2", Value(1, "n2", b"adj"))
+            await asyncio.wait_for(task, 5)
+            assert "adj:n2" in frames[1]["key_vals"]
+            assert "prefix:n2" not in frames[1]["key_vals"]
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_long_poll_adj(self):
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            server, client = await make_server(kvstore=store)
+
+            async def poll():
+                return await client.call(
+                    "longPollKvStoreAdj", snapshot={}, timeout_s=5.0
+                )
+
+            task = asyncio.get_event_loop().create_task(poll())
+            await asyncio.sleep(0.05)
+            assert not task.done()  # blocked: no adj keys yet
+            store.set_key("adj:n9", Value(1, "n9", b"db"))
+            assert await asyncio.wait_for(task, 5) is True
+            # snapshot already current -> times out quickly with False
+            pub = store.dump_all()
+            snapshot = {
+                k: v.version
+                for k, v in pub.key_vals.items()
+                if k.startswith("adj:")
+            }
+            result = await client.call(
+                "longPollKvStoreAdj", snapshot=snapshot, timeout_s=0.2
+            )
+            assert result is False
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+
+class TestRouteApis:
+    def test_fib_route_apis(self):
+        async def body():
+            handler = MockFibHandler()
+            route_q = RWQueue()
+            fib = Fib(
+                FibConfig(my_node_name="test-node", dryrun=True),
+                handler,
+                route_q,
+            )
+            await fib.process_route_updates(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        RibUnicastEntry(
+                            prefix=IpPrefix("10.0.0.0/24"),
+                            nexthops={NextHop("fe80::1", iface="eth0")},
+                        )
+                    ]
+                )
+            )
+            server, client = await make_server(fib=fib)
+            db = await client.call("getRouteDb")
+            assert db["this_node_name"] == "test-node"
+            routes = [decode_obj(r) for r in db["unicast_routes"]]
+            assert str(routes[0].dest) == "10.0.0.0/24"
+            filtered = await client.call(
+                "getUnicastRoutesFiltered", prefixes=["10.0.0.5"]
+            )
+            assert len(filtered) == 1
+            filtered = await client.call(
+                "getUnicastRoutesFiltered", prefixes=["99.0.0.1"]
+            )
+            assert filtered == []
+            await client.close()
+            await server.stop()
+
+        run(body())

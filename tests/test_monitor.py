@@ -1,0 +1,97 @@
+"""Monitor + Watchdog tests (openr/monitor, openr/watchdog equivalents)."""
+
+import asyncio
+
+from openr_tpu.messaging import RWQueue
+from openr_tpu.monitor import LogSample, Monitor, Watchdog, WatchdogConfig
+
+
+def run(coro, timeout=10.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+class TestLogSample:
+    def test_roundtrip(self):
+        sample = LogSample(timestamp=1000)
+        sample.add_string("event", "NB_UP").add_int("count", 3)
+        sample.add_string_vector("perf_events", ["a", "b"])
+        decoded = LogSample.from_json(sample.to_json())
+        assert decoded.get("event") == "NB_UP"
+        assert decoded.get("count") == 3
+        assert decoded.get("perf_events") == ["a", "b"]
+
+
+class TestMonitor:
+    def test_event_log_ring_bounded(self):
+        async def body():
+            q = RWQueue()
+            mon = Monitor("n1", q, max_event_log=5)
+            mon.start()
+            for i in range(10):
+                q.push(LogSample().add_int("i", i))
+            await asyncio.sleep(0.05)
+            logs = mon.get_event_logs()
+            assert len(logs) == 5
+            assert logs[-1].get("i") == 9
+            assert logs[0].get("i") == 5
+            # node name auto-filled
+            assert logs[0].get("node_name") == "n1"
+            mon.stop()
+
+        run(body())
+
+    def test_counter_aggregation(self):
+        class FakeModule:
+            counters = {"decision.spf_runs": 12}
+
+        mon = Monitor("n1")
+        mon.register_module("decision", FakeModule())
+        counters = mon.get_counters()
+        assert counters["decision.spf_runs"] == 12
+        assert "process.uptime.seconds" in counters
+
+
+class TestWatchdog:
+    def test_stall_fires(self):
+        async def body():
+            fired = []
+            wd = Watchdog(
+                WatchdogConfig(interval_s=0.05, thread_timeout_s=0.2),
+                fire=fired.append,
+            )
+            wd.add_module("decision")
+            # stall: cancel the heartbeat task to simulate a stuck module
+            wd._tasks["decision"].cancel()
+            wd.start()
+            await asyncio.sleep(0.5)
+            assert fired and "decision" in fired[0]
+            wd.stop()
+
+        run(body())
+
+    def test_healthy_module_does_not_fire(self):
+        async def body():
+            fired = []
+            wd = Watchdog(
+                WatchdogConfig(interval_s=0.05, thread_timeout_s=0.3),
+                fire=fired.append,
+            )
+            wd.add_module("kvstore")
+            wd.start()
+            await asyncio.sleep(0.4)
+            assert not fired
+            wd.stop()
+
+        run(body())
+
+    def test_memory_limit_fires(self):
+        fired = []
+        wd = Watchdog(
+            WatchdogConfig(thread_timeout_s=1000, max_memory_mb=1),
+            fire=fired.append,
+        )
+        wd.check_once()
+        assert fired and "RSS" in fired[0]
