@@ -1,0 +1,320 @@
+"""Daemon composition root.
+
+Behavioral port of openr/Main.cpp: builds the inter-module queues
+(Main.cpp:244-250), constructs every module against its seams, starts them
+in dependency order ConfigStore → Monitor → KvStore → PrefixManager →
+PrefixAllocator → Spark → LinkMonitor → Decision → Fib → CtrlServer
+(Main.cpp:355-586) and stops in reverse with queue closing
+(Main.cpp:597-654). One asyncio loop replaces the per-module EventBase
+threads; each module is an independent task set on that loop, watched by
+the Watchdog.
+
+Seams (all injectable, mirroring the reference's test wrappers):
+  - io_provider:  Spark's packet transport (UDP or MockIoNetwork endpoint)
+  - kv_transport: KvStore's peer transport (TCP or InProcessTransport)
+  - fib_service:  route programming agent (NetlinkFibHandler or mock)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from openr_tpu.config import Config
+from openr_tpu.configstore import PersistentStore
+from openr_tpu.ctrl import CtrlServer
+from openr_tpu.decision import Decision, DecisionConfig
+from openr_tpu.fib import Fib, FibConfig
+from openr_tpu.kvstore import KvStore, KvStoreClient, KvStoreParams
+from openr_tpu.linkmonitor.link_monitor import LinkMonitor, LinkMonitorConfig
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor import Monitor, Watchdog, WatchdogConfig
+from openr_tpu.platform import MockFibHandler
+from openr_tpu.prefixmanager import PrefixManager, PrefixManagerConfig
+from openr_tpu.spark.spark import Spark, SparkConfig as SparkModuleConfig
+
+log = logging.getLogger(__name__)
+
+
+class OpenrDaemon:
+    """All modules of one Open/R node on one asyncio loop."""
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        io_provider,
+        kv_transport,
+        fib_service=None,
+        config_store_path: Optional[str] = None,
+        ctrl_port: Optional[int] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.config = config
+        self._loop = loop
+        c = config.config
+        node = c.node_name
+        areas = config.get_area_ids()
+
+        # --- queues (Main.cpp:244-250) --------------------------------
+        self.route_updates_queue = ReplicateQueue()
+        self.interface_updates_queue = ReplicateQueue()
+        self.neighbor_updates_queue = ReplicateQueue()
+        self.prefix_updates_queue = ReplicateQueue()
+        self.log_sample_queue = ReplicateQueue()
+
+        # --- config store ---------------------------------------------
+        self.config_store = PersistentStore(
+            config_store_path or f"/tmp/openr_tpu_{node}.bin",
+            dryrun=config_store_path is None,
+            loop=loop,
+        )
+
+        # --- monitor + watchdog ---------------------------------------
+        self.monitor = Monitor(
+            node,
+            self.log_sample_queue.get_reader(),
+            max_event_log=c.monitor_config.max_event_log,
+            loop=loop,
+        )
+        self.watchdog: Optional[Watchdog] = None
+        if c.enable_watchdog:
+            self.watchdog = Watchdog(
+                WatchdogConfig(
+                    interval_s=c.watchdog_config.interval_s,
+                    thread_timeout_s=c.watchdog_config.thread_timeout_s,
+                    max_memory_mb=c.watchdog_config.max_memory_mb,
+                ),
+                loop=loop,
+            )
+
+        # --- kvstore ---------------------------------------------------
+        self.kvstore = KvStore(
+            node,
+            areas,
+            kv_transport,
+            KvStoreParams(
+                node_id=node,
+                ttl_decrement_ms=c.kvstore_config.ttl_decrement_ms,
+                flood_rate=(
+                    float(c.kvstore_config.flood_rate.flood_msg_per_sec)
+                    if c.kvstore_config.flood_rate is not None
+                    else None
+                ),
+            ),
+            loop=loop,
+        )
+        self.kvstore_client = KvStoreClient(self.kvstore, node, loop)
+
+        # --- prefix manager -------------------------------------------
+        self.prefix_manager = PrefixManager(
+            PrefixManagerConfig(node_name=node, areas=areas),
+            self.kvstore_client,
+            config_store=self.config_store,
+            prefix_updates=self.prefix_updates_queue.get_reader(),
+            route_updates=self.route_updates_queue.get_reader(),
+            loop=loop,
+        )
+
+        # --- prefix allocator (optional) -------------------------------
+        self.prefix_allocator = None
+        if config.is_prefix_allocation_enabled():
+            from openr_tpu.allocators import (
+                PrefixAllocationMode,
+                PrefixAllocationParams,
+                PrefixAllocator,
+                PrefixAllocatorConfig,
+            )
+            from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+
+            pac = c.prefix_allocation_config
+            params = None
+            if pac.seed_prefix and pac.allocate_prefix_len:
+                params = PrefixAllocationParams(
+                    IpPrefix(pac.seed_prefix), pac.allocate_prefix_len
+                )
+            self.prefix_allocator = PrefixAllocator(
+                PrefixAllocatorConfig(
+                    node_name=node,
+                    mode=PrefixAllocationMode(pac.prefix_allocation_mode),
+                    params=params,
+                    set_loopback_addr=pac.set_loopback_addr,
+                    loopback_iface=pac.loopback_interface,
+                ),
+                self.kvstore_client,
+                config_store=self.config_store,
+                on_advertise=lambda entry: (
+                    self.prefix_manager.advertise_prefixes([entry])
+                ),
+                on_withdraw=lambda prefix: (
+                    self.prefix_manager.withdraw_prefixes(
+                        [
+                            PrefixEntry(
+                                prefix=prefix,
+                                type=PrefixType.PREFIX_ALLOCATOR,
+                            )
+                        ]
+                    )
+                ),
+                loop=loop,
+            )
+
+        # --- spark -----------------------------------------------------
+        sc = c.spark_config
+        self.spark = Spark(
+            SparkModuleConfig(
+                node_name=node,
+                domain=c.domain,
+                area_configs=[
+                    (a.area_id, r)
+                    for a in c.areas
+                    for r in (a.neighbor_regexes or [".*"])
+                ]
+                or [("0", ".*")],
+                hello_time=sc.hello_time_s,
+                fastinit_hello_time=sc.fastinit_hello_time_ms / 1000.0,
+                keepalive_time=sc.keepalive_time_s,
+                hold_time=sc.hold_time_s,
+                graceful_restart_time=sc.graceful_restart_time_s,
+            ),
+            io_provider,
+            self.neighbor_updates_queue,
+            loop=loop,
+        )
+
+        # --- link monitor ---------------------------------------------
+        lmc = c.link_monitor_config
+        self.link_monitor = LinkMonitor(
+            LinkMonitorConfig(
+                node_name=node,
+                enable_rtt_metric=lmc.use_rtt_metric,
+                flap_initial_backoff=lmc.linkflap_initial_backoff_ms / 1000,
+                flap_max_backoff=lmc.linkflap_max_backoff_ms / 1000,
+                areas=areas,
+            ),
+            self.neighbor_updates_queue.get_reader(),
+            self.kvstore,
+            self.spark,
+            config_store=self.config_store,
+            interface_updates_queue=self.interface_updates_queue,
+            loop=loop,
+        )
+
+        # --- decision --------------------------------------------------
+        dc = c.decision_config
+        self.decision = Decision(
+            DecisionConfig(
+                my_node_name=node,
+                areas=areas,
+                solver_backend=dc.solver_backend,
+                enable_v4=c.enable_v4,
+                compute_lfa_paths=dc.compute_lfa_paths,
+                enable_ordered_fib=c.enable_ordered_fib_programming,
+                bgp_use_igp_metric=c.bgp_use_igp_metric,
+                debounce_min=dc.debounce_min_ms / 1000.0,
+                debounce_max=dc.debounce_max_ms / 1000.0,
+                eor_time_s=float(c.eor_time_s or 0),
+            ),
+            self.kvstore.updates_queue.get_reader(),
+            self.route_updates_queue,
+            loop=loop,
+        )
+
+        # --- fib -------------------------------------------------------
+        if fib_service is None:
+            if config.is_netlink_fib_handler_enabled():
+                from openr_tpu.platform import NetlinkFibHandler
+
+                fib_service = NetlinkFibHandler(loop=loop)
+            else:
+                fib_service = MockFibHandler()
+        self.fib_service = fib_service
+        self.fib = Fib(
+            FibConfig(
+                my_node_name=node,
+                dryrun=c.dryrun,
+                enable_segment_routing=c.enable_segment_routing,
+                enable_ordered_fib=c.enable_ordered_fib_programming,
+                has_eor_time=c.eor_time_s is not None,
+            ),
+            fib_service,
+            self.route_updates_queue.get_reader(),
+            self.interface_updates_queue.get_reader(),
+            kvstore_client=self.kvstore_client,
+            loop=loop,
+        )
+
+        # --- ctrl server ----------------------------------------------
+        self.ctrl_server = CtrlServer(
+            node,
+            host="127.0.0.1",
+            port=ctrl_port if ctrl_port is not None else c.openr_ctrl_port,
+            kvstore=self.kvstore,
+            decision=self.decision,
+            fib=self.fib,
+            link_monitor=self.link_monitor,
+            prefix_manager=self.prefix_manager,
+            monitor=self.monitor,
+            config_store=self.config_store,
+            config=config,
+            loop=loop,
+        )
+
+        for name, module in (
+            ("kvstore", self.kvstore),
+            ("decision", self.decision),
+            ("fib", self.fib),
+            ("link_monitor", self.link_monitor),
+            ("spark", self.spark),
+            ("prefix_manager", self.prefix_manager),
+        ):
+            self.monitor.register_module(name, module)
+
+    # ------------------------------------------------------------------
+
+    async def start(self) -> int:
+        """Start modules in dependency order; returns the ctrl port."""
+        self.monitor.start()
+        if self.watchdog is not None:
+            for name in ("kvstore", "decision", "fib", "link_monitor"):
+                self.watchdog.add_module(name)
+            self.watchdog.start()
+        self.prefix_manager.start()
+        if self.prefix_allocator is not None:
+            self.prefix_allocator.start()
+        self.link_monitor.start()
+        self.decision.start()
+        self.fib.start()
+        port = await self.ctrl_server.start()
+        log.info(
+            "openr-tpu daemon %s up, ctrl on :%d",
+            self.config.node_name,
+            port,
+        )
+        return port
+
+    async def stop(self) -> None:
+        """Reverse-order shutdown with queue closing (Main.cpp:597-654)."""
+        await self.ctrl_server.stop()
+        self.fib.stop()
+        self.decision.stop()
+        self.link_monitor.stop()
+        self.spark.stop()
+        if self.prefix_allocator is not None:
+            self.prefix_allocator.stop()
+        self.prefix_manager.stop()
+        self.kvstore_client.stop()
+        self.kvstore.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.monitor.stop()
+        self.config_store.stop()
+        for q in (
+            self.route_updates_queue,
+            self.interface_updates_queue,
+            self.neighbor_updates_queue,
+            self.prefix_updates_queue,
+            self.log_sample_queue,
+        ):
+            q.close()

@@ -1,0 +1,14 @@
+"""Test/emulation harness: whole-stack virtual nodes in one process.
+
+Equivalent of openr/tests/OpenrWrapper.{h,cpp}:36-90 — boots ALL modules of
+one virtual Open/R node (monitor → kvstore → spark → link-monitor →
+decision → fib) against mock seams, so multi-node topologies run in a
+single process: Spark discovery over MockIoNetwork mailboxes, KvStore
+flooding over the in-process transport, route programming into
+MockFibHandler. This is the no-cluster multi-node trick the reference's
+OpenrSystemTest builds ring topologies with (tests/OpenrSystemTest.cpp).
+"""
+
+from openr_tpu.testing.wrapper import OpenrWrapper, VirtualNetwork
+
+__all__ = ["OpenrWrapper", "VirtualNetwork"]

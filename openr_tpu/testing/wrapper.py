@@ -1,0 +1,169 @@
+"""OpenrWrapper: one whole-stack virtual node; VirtualNetwork: the shared
+mock fabric connecting many of them (tests/OpenrWrapper.h:36-90 +
+tests/mocks/MockIoProvider + in-process KvStore transport)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.config import Config
+from openr_tpu.kvstore.transport import InProcessTransport
+from openr_tpu.openr import OpenrDaemon
+from openr_tpu.platform import MockFibHandler
+from openr_tpu.spark.io_provider import MockIoNetwork
+from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+
+
+class VirtualNetwork:
+    """Shared fabric: Spark packet network + KvStore transport."""
+
+    def __init__(self) -> None:
+        self.io_network = MockIoNetwork()
+        self.kv_transport = InProcessTransport()
+        self.wrappers: Dict[str, "OpenrWrapper"] = {}
+
+    def add_node(self, name: str, **kw) -> "OpenrWrapper":
+        wrapper = OpenrWrapper(name, self, **kw)
+        self.wrappers[name] = wrapper
+        return wrapper
+
+    def connect(
+        self,
+        a: str,
+        a_iface: str,
+        b: str,
+        b_iface: str,
+        latency_ms: float = 1.0,
+    ) -> None:
+        """Create a virtual link; both ends see their interface come up."""
+        self.io_network.connect((a, a_iface), (b, b_iface), latency_ms)
+        self.wrappers[a].set_interface(a_iface, True)
+        self.wrappers[b].set_interface(b_iface, True)
+
+    def fail_link(self, a: str, a_iface: str, b: str, b_iface: str) -> None:
+        self.io_network.disconnect((a, a_iface), (b, b_iface))
+        self.wrappers[a].set_interface(a_iface, False)
+        self.wrappers[b].set_interface(b_iface, False)
+
+    def restore_link(self, a: str, a_iface: str, b: str, b_iface: str) -> None:
+        self.io_network.reconnect((a, a_iface), (b, b_iface))
+        self.wrappers[a].set_interface(a_iface, True)
+        self.wrappers[b].set_interface(b_iface, True)
+
+    async def start_all(self) -> None:
+        for wrapper in self.wrappers.values():
+            await wrapper.start()
+
+    async def stop_all(self) -> None:
+        for wrapper in reversed(list(self.wrappers.values())):
+            await wrapper.stop()
+
+
+# tightened timers for in-process convergence (OpenrSystemTest.cpp:23-35)
+_FAST_TIMERS = {
+    "spark_config": {
+        "hello_time_s": 2.0,
+        "fastinit_hello_time_ms": 50.0,
+        "keepalive_time_s": 0.2,
+        "hold_time_s": 1.0,
+        "graceful_restart_time_s": 3.0,
+    },
+    "link_monitor_config": {
+        "linkflap_initial_backoff_ms": 8,
+        "linkflap_max_backoff_ms": 64,
+    },
+    "decision_config": {
+        "debounce_min_ms": 5.0,
+        "debounce_max_ms": 20.0,
+    },
+}
+
+
+class OpenrWrapper:
+    def __init__(
+        self,
+        name: str,
+        network: VirtualNetwork,
+        config_overrides: Optional[dict] = None,
+        loopback_prefix: Optional[str] = None,
+    ) -> None:
+        self.name = name
+        self.network = network
+        cfg = {"node_name": name, "dryrun": False, **_FAST_TIMERS}
+        if config_overrides:
+            for key, value in config_overrides.items():
+                if isinstance(value, dict) and isinstance(
+                    cfg.get(key), dict
+                ):
+                    cfg[key] = {**cfg[key], **value}
+                else:
+                    cfg[key] = value
+        self.fib_handler = MockFibHandler()
+        self.daemon = OpenrDaemon(
+            Config.from_dict(cfg),
+            io_provider=network.io_network.provider(name),
+            kv_transport=network.kv_transport,
+            fib_service=self.fib_handler,
+            ctrl_port=0,
+        )
+        self.loopback_prefix = loopback_prefix
+        self.ctrl_port: Optional[int] = None
+
+    async def start(self) -> None:
+        self.ctrl_port = await self.daemon.start()
+        if self.loopback_prefix is not None:
+            self.daemon.prefix_manager.advertise_prefixes(
+                [
+                    PrefixEntry(
+                        prefix=IpPrefix(self.loopback_prefix),
+                        type=PrefixType.LOOPBACK,
+                    )
+                ]
+            )
+
+    async def stop(self) -> None:
+        await self.daemon.stop()
+
+    # -- convenience views ------------------------------------------------
+
+    def set_interface(self, if_name: str, is_up: bool) -> None:
+        self.daemon.link_monitor.update_interface(if_name, is_up)
+
+    def programmed_prefixes(self) -> List[str]:
+        from openr_tpu.platform import FIB_CLIENT_OPENR
+
+        return sorted(
+            str(dest)
+            for dest in self.fib_handler.unicast_routes.get(
+                FIB_CLIENT_OPENR, {}
+            )
+        )
+
+    def programmed_route(self, prefix: str):
+        from openr_tpu.platform import FIB_CLIENT_OPENR
+
+        return self.fib_handler.unicast_routes.get(FIB_CLIENT_OPENR, {}).get(
+            IpPrefix(prefix)
+        )
+
+    def adjacent_nodes(self) -> List[str]:
+        return sorted(
+            {
+                node
+                for node, _ in self.daemon.link_monitor.get_adjacencies()
+            }
+        )
+
+    def kvstore_keys(self) -> List[str]:
+        return sorted(self.daemon.kvstore.dump_all().key_vals)
+
+
+async def wait_until(predicate, timeout: float = 20.0, interval=0.02):
+    """Await a condition with deadline — the test convergence helper."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while not predicate():
+        if loop.time() >= deadline:
+            raise AssertionError("condition did not converge in time")
+        await asyncio.sleep(interval)

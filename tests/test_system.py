@@ -1,0 +1,186 @@
+"""Whole-stack multi-node system tests.
+
+Equivalent of openr/tests/OpenrSystemTest.cpp:247-535: ring topologies of
+OpenrWrapper nodes over the mock fabric, asserting end-to-end route
+convergence (discovery → adjacency → KvStore flood → SPF → FIB
+programming), failure reaction, and drain behavior."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.testing import OpenrWrapper, VirtualNetwork
+from openr_tpu.testing.wrapper import wait_until
+
+
+def run(coro, timeout=60.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def build_ring(net, n):
+    """n-node ring: node-i connects to node-(i+1) via iface ring-<i>."""
+    for i in range(n):
+        net.add_node(f"node-{i}", loopback_prefix=f"10.{i}.0.0/24")
+    for i in range(n):
+        j = (i + 1) % n
+        net.connect(
+            f"node-{i}", f"if-{i}-{j}", f"node-{j}", f"if-{j}-{i}"
+        )
+
+
+class TestTwoNodes:
+    def test_adjacency_and_routes(self):
+        async def body():
+            net = VirtualNetwork()
+            a = net.add_node("node-a", loopback_prefix="10.1.0.0/24")
+            b = net.add_node("node-b", loopback_prefix="10.2.0.0/24")
+            await net.start_all()
+            net.connect("node-a", "eth0", "node-b", "eth0")
+
+            # discovery → adjacency on both sides
+            await wait_until(lambda: a.adjacent_nodes() == ["node-b"])
+            await wait_until(lambda: b.adjacent_nodes() == ["node-a"])
+            # each programs a route to the other's loopback
+            await wait_until(
+                lambda: "10.2.0.0/24" in a.programmed_prefixes()
+            )
+            await wait_until(
+                lambda: "10.1.0.0/24" in b.programmed_prefixes()
+            )
+            # no route to self
+            assert "10.1.0.0/24" not in a.programmed_prefixes()
+            await net.stop_all()
+
+        run(body())
+
+    def test_link_failure_withdraws_routes(self):
+        async def body():
+            net = VirtualNetwork()
+            a = net.add_node("node-a", loopback_prefix="10.1.0.0/24")
+            b = net.add_node("node-b", loopback_prefix="10.2.0.0/24")
+            await net.start_all()
+            net.connect("node-a", "eth0", "node-b", "eth0")
+            await wait_until(
+                lambda: "10.2.0.0/24" in a.programmed_prefixes()
+            )
+
+            net.fail_link("node-a", "eth0", "node-b", "eth0")
+            # hold timer expiry → neighbor down → route withdrawn
+            await wait_until(
+                lambda: "10.2.0.0/24" not in a.programmed_prefixes(),
+                timeout=30,
+            )
+            await net.stop_all()
+
+        run(body())
+
+
+class TestRing:
+    def test_three_node_ring_full_convergence(self):
+        async def body():
+            net = VirtualNetwork()
+            build_ring(net, 3)
+            await net.start_all()
+            for i in range(3):
+                wrapper = net.wrappers[f"node-{i}"]
+                others = {
+                    f"10.{j}.0.0/24" for j in range(3) if j != i
+                }
+                await wait_until(
+                    lambda w=wrapper, o=others: o.issubset(
+                        set(w.programmed_prefixes())
+                    ),
+                    timeout=30,
+                )
+                # ring: every node has exactly 2 neighbors
+                assert len(wrapper.adjacent_nodes()) == 2
+            await net.stop_all()
+
+        run(body())
+
+    def test_ring_reroutes_around_failed_link(self):
+        async def body():
+            net = VirtualNetwork()
+            build_ring(net, 3)
+            await net.start_all()
+            a = net.wrappers["node-0"]
+            await wait_until(
+                lambda: {"10.1.0.0/24", "10.2.0.0/24"}.issubset(
+                    set(a.programmed_prefixes())
+                ),
+                timeout=30,
+            )
+            # direct link 0-1 dies; node-0 must reroute to node-1 via node-2
+            route_before = a.programmed_route("10.1.0.0/24")
+            assert route_before is not None
+            net.fail_link("node-0", "if-0-1", "node-1", "if-1-0")
+
+            async def rerouted():
+                route = a.programmed_route("10.1.0.0/24")
+                return (
+                    route is not None
+                    and all(
+                        nh.iface == "if-0-2" for nh in route.nexthops
+                    )
+                    and len(route.nexthops) > 0
+                )
+
+            await wait_until(
+                lambda: a.programmed_route("10.1.0.0/24") is not None
+                and all(
+                    nh.iface == "if-0-2"
+                    for nh in a.programmed_route("10.1.0.0/24").nexthops
+                ),
+                timeout=30,
+            )
+            await net.stop_all()
+
+        run(body())
+
+
+class TestDrain:
+    def test_node_overload_diverts_transit_traffic(self):
+        async def body():
+            # line topology a - b - c plus direct a - c: overloading b
+            # must keep a→c traffic off b
+            net = VirtualNetwork()
+            for name, prefix in (
+                ("node-a", "10.1.0.0/24"),
+                ("node-b", "10.2.0.0/24"),
+                ("node-c", "10.3.0.0/24"),
+            ):
+                net.add_node(name, loopback_prefix=prefix)
+            await net.start_all()
+            net.connect("node-a", "ab", "node-b", "ba")
+            net.connect("node-b", "bc", "node-c", "cb")
+            net.connect("node-a", "ac", "node-c", "ca", latency_ms=1.0)
+            a = net.wrappers["node-a"]
+            await wait_until(
+                lambda: {"10.2.0.0/24", "10.3.0.0/24"}.issubset(
+                    set(a.programmed_prefixes())
+                ),
+                timeout=30,
+            )
+            # drain node-b
+            net.wrappers["node-b"].daemon.link_monitor.set_node_overload(
+                True
+            )
+            # a's route to c must avoid b (iface 'ac' only); metric-equal
+            # paths would otherwise ECMP through b
+            await wait_until(
+                lambda: a.programmed_route("10.3.0.0/24") is not None
+                and all(
+                    nh.iface == "ac"
+                    for nh in a.programmed_route("10.3.0.0/24").nexthops
+                ),
+                timeout=30,
+            )
+            # b's loopback still reachable (overloaded nodes accept
+            # terminating traffic, LinkState.cpp overload semantics)
+            assert "10.2.0.0/24" in a.programmed_prefixes()
+            await net.stop_all()
+
+        run(body())
