@@ -416,6 +416,23 @@ class CtrlServer:
         assert self.kvstore is not None
         return {"areas": sorted(self.kvstore.dbs.keys())}
 
+    def m_getSpanningTreeInfos(self, params) -> Dict[str, Any]:
+        """OpenrCtrl.thrift getSpanningTreeInfos:375 — DUAL SPT state."""
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        return self.kvstore.db(area).get_spt_infos()
+
+    def m_updateFloodTopologyChild(self, params) -> None:
+        """OpenrCtrl.thrift updateFloodTopologyChild:367."""
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        self.kvstore.db(area).handle_flood_topo_set(
+            params["root_id"],
+            params["src_id"],
+            bool(params["set_child"]),
+            bool(params.get("all_roots", False)),
+        )
+
     def m_longPollKvStoreAdj(self, params):
         """Block until any adj: key differs from the client's snapshot
         (OpenrCtrl.thrift:353, OpenrCtrlLongPollTest)."""

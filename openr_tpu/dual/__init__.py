@@ -1,0 +1,25 @@
+"""DUAL — Diffusing Update Algorithm (EIGRP-style loop-free SPT).
+
+Equivalent of openr/dual/: powers the KvStore flood-topology optimization
+(flood only on a spanning tree instead of the full peer mesh).
+"""
+
+from openr_tpu.dual.dual import (
+    Dual,
+    DualMessage,
+    DualMessages,
+    DualMessageType,
+    DualNode,
+    DualState,
+    INF_DISTANCE,
+)
+
+__all__ = [
+    "Dual",
+    "DualMessage",
+    "DualMessages",
+    "DualMessageType",
+    "DualNode",
+    "DualState",
+    "INF_DISTANCE",
+]

@@ -1,0 +1,612 @@
+"""DUAL: Diffusing Update Algorithm computing a loop-free SPT per root.
+
+Behavioral port of openr/dual/Dual.{h,cpp} (the EIGRP/DUAL algorithm of
+Garcia-Luna-Aceves; reference cites cs.cornell.edu/people/egs/615/lunes93):
+  - DualStateMachine PASSIVE / ACTIVE0-3 transition matrix (Dual.cpp:12-60).
+  - Per-root `Dual` instance: route info (distance, reportDistance,
+    feasibleDistance, nexthop), neighbor infos (reportDistance,
+    expectReply, needToReply), and the `cornet` stack of pending queries.
+  - Feasible condition per SNC: a neighbor with reportDistance <
+    feasibleDistance whose (localDistance + reportDistance) equals the
+    minimum (Dual.cpp:148-169).
+  - Local computation when FC holds (Dual.cpp:191-212); diffusing
+    computation (queries to all up neighbors, expectReply tracking) when it
+    does not (Dual.cpp:213-246).
+  - peerUp/peerDown/peerCostChange and UPDATE/QUERY/REPLY processing with
+    the exact active-state distance bookkeeping (Dual.cpp:400-712).
+  - `DualNode`: multi-root container discovering roots on the fly; SPT
+    peers = nexthop + children; smallest root id with a valid route wins
+    (Dual.cpp:716-967). I/O is a seam: subclasses implement
+    send_dual_messages + process_nexthop_change (used by KvStore flood
+    optimization).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+log = logging.getLogger(__name__)
+
+INF_DISTANCE = 2**63 - 1  # int64 max sentinel, matches the reference
+
+
+class DualState(enum.Enum):
+    ACTIVE0 = "ACTIVE0"
+    ACTIVE1 = "ACTIVE1"
+    ACTIVE2 = "ACTIVE2"
+    ACTIVE3 = "ACTIVE3"
+    PASSIVE = "PASSIVE"
+
+
+class DualEvent(enum.Enum):
+    QUERY_FROM_SUCCESSOR = "QUERY_FROM_SUCCESSOR"
+    LAST_REPLY = "LAST_REPLY"
+    INCREASE_D = "INCREASE_D"
+    OTHERS = "OTHERS"
+
+
+class DualMessageType(enum.Enum):
+    UPDATE = 1
+    QUERY = 2
+    REPLY = 3
+
+
+@dataclass
+class DualMessage:
+    """openr/if/Dual.thrift DualMessage: dst root, report distance, type."""
+
+    dst_id: str
+    distance: int
+    type: DualMessageType
+
+
+@dataclass
+class DualMessages:
+    """openr/if/Dual.thrift DualMessages: sender + batch."""
+
+    src_id: str = ""
+    messages: List[DualMessage] = field(default_factory=list)
+
+
+class DualStateMachine:
+    """Transition matrix (Dual.cpp:12-60)."""
+
+    def __init__(self) -> None:
+        self.state = DualState.PASSIVE
+
+    def process_event(self, event: DualEvent, fc: bool = True) -> None:
+        s = self.state
+        if s == DualState.PASSIVE:
+            if fc:
+                return
+            self.state = (
+                DualState.ACTIVE3
+                if event == DualEvent.QUERY_FROM_SUCCESSOR
+                else DualState.ACTIVE1
+            )
+        elif s == DualState.ACTIVE0:
+            if event != DualEvent.LAST_REPLY:
+                return
+            self.state = DualState.PASSIVE if fc else DualState.ACTIVE2
+        elif s == DualState.ACTIVE1:
+            if event == DualEvent.INCREASE_D:
+                self.state = DualState.ACTIVE0
+            elif event == DualEvent.LAST_REPLY:
+                self.state = DualState.PASSIVE
+            elif event == DualEvent.QUERY_FROM_SUCCESSOR:
+                self.state = DualState.ACTIVE2
+        elif s == DualState.ACTIVE2:
+            if event != DualEvent.LAST_REPLY:
+                return
+            self.state = DualState.PASSIVE if fc else DualState.ACTIVE3
+        elif s == DualState.ACTIVE3:
+            if event == DualEvent.LAST_REPLY:
+                self.state = DualState.PASSIVE
+            elif event == DualEvent.INCREASE_D:
+                self.state = DualState.ACTIVE2
+
+
+@dataclass
+class NeighborInfo:
+    report_distance: int = INF_DISTANCE
+    expect_reply: bool = False
+    need_to_reply: bool = False
+
+
+def _add(d1: int, d2: int) -> int:
+    """Saturating distance addition (Dual.cpp addDistances)."""
+    if d1 == INF_DISTANCE or d2 == INF_DISTANCE:
+        return INF_DISTANCE
+    return d1 + d2
+
+
+MsgsToSend = Dict[str, DualMessages]
+
+
+class Dual:
+    """One root's diffusing computation at one node."""
+
+    def __init__(
+        self,
+        node_id: str,
+        root_id: str,
+        local_distances: Dict[str, int],
+        nexthop_cb,
+    ) -> None:
+        self.node_id = node_id
+        self.root_id = root_id
+        self.local_distances = dict(local_distances)
+        self.nexthop_cb = nexthop_cb
+        self.sm = DualStateMachine()
+        self.distance = INF_DISTANCE
+        self.report_distance = INF_DISTANCE
+        self.feasible_distance = INF_DISTANCE
+        self.nexthop: Optional[str] = None
+        self.neighbor_infos: Dict[str, NeighborInfo] = {}
+        self.cornet: List[str] = []  # stack of pending queriers
+        self._children: Set[str] = set()
+        self.counters: Dict[str, Dict[str, int]] = {}
+        if root_id == node_id:
+            self.distance = 0
+            self.report_distance = 0
+            self.feasible_distance = 0
+            self.nexthop = node_id
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _info(self, neighbor: str) -> NeighborInfo:
+        return self.neighbor_infos.setdefault(neighbor, NeighborInfo())
+
+    def _count(self, neighbor: str, counter: str) -> None:
+        c = self.counters.setdefault(neighbor, {})
+        c[counter] = c.get(counter, 0) + 1
+        total = "total_sent" if counter.endswith("_sent") else "total_recv"
+        c[total] = c.get(total, 0) + 1
+
+    def _neighbor_up(self, neighbor: str) -> bool:
+        return self.local_distances.get(neighbor, INF_DISTANCE) != INF_DISTANCE
+
+    # -- SPT children / peers -------------------------------------------
+
+    def add_child(self, child: str) -> None:
+        self._children.add(child)
+
+    def remove_child(self, child: str) -> None:
+        self._children.discard(child)
+
+    def children(self) -> Set[str]:
+        return set(self._children)
+
+    def has_valid_route(self) -> bool:
+        return (
+            self.sm.state == DualState.PASSIVE
+            and self.nexthop is not None
+            and self.distance != INF_DISTANCE
+        )
+
+    def spt_peers(self) -> Set[str]:
+        if not self.has_valid_route():
+            return set()
+        peers = set(self._children)
+        if self.nexthop is not None and self.nexthop != self.node_id:
+            peers.add(self.nexthop)
+        return peers
+
+    # -- core computations ----------------------------------------------
+
+    def _min_distance(self) -> int:
+        if self.node_id == self.root_id:
+            return 0
+        dmin = INF_DISTANCE
+        for neighbor, ld in self.local_distances.items():
+            rd = self._info(neighbor).report_distance
+            dmin = min(dmin, _add(ld, rd))
+        return dmin
+
+    def _route_affected(self) -> bool:
+        """Dual.cpp:99-146."""
+        if not self.local_distances:
+            return False
+        if self.nexthop == self.node_id:
+            return False
+        dmin = self._min_distance()
+        if self.distance != dmin:
+            return True
+        if dmin == INF_DISTANCE:
+            return False
+        nexthops = {
+            neighbor
+            for neighbor, ld in self.local_distances.items()
+            if _add(ld, self._info(neighbor).report_distance) == dmin
+        }
+        return self.nexthop not in nexthops
+
+    def _meet_feasible_condition(self):
+        """SNC feasibility (Dual.cpp:148-169) → (nexthop, distance) | None."""
+        dmin = self._min_distance()
+        for neighbor, ld in self.local_distances.items():
+            if ld == INF_DISTANCE:
+                continue
+            rd = self._info(neighbor).report_distance
+            if rd < self.feasible_distance and _add(ld, rd) == dmin:
+                return neighbor, dmin
+        return None
+
+    def _flood_updates(self, out: MsgsToSend) -> None:
+        for neighbor, ld in self.local_distances.items():
+            if ld == INF_DISTANCE:
+                continue
+            out.setdefault(neighbor, DualMessages()).messages.append(
+                DualMessage(
+                    self.root_id,
+                    self.report_distance,
+                    DualMessageType.UPDATE,
+                )
+            )
+            self._count(neighbor, "update_sent")
+
+    def _set_nexthop(self, new_nh: Optional[str]) -> None:
+        if self.nexthop != new_nh:
+            old = self.nexthop
+            self.nexthop = new_nh
+            if self.nexthop_cb is not None:
+                self.nexthop_cb(old, new_nh)
+
+    def _local_computation(
+        self, new_nexthop: str, new_distance: int, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:191-212."""
+        same_rd = new_distance == self.report_distance
+        self._set_nexthop(new_nexthop)
+        self.distance = new_distance
+        self.report_distance = new_distance
+        self.feasible_distance = new_distance
+        if not same_rd:
+            self._flood_updates(out)
+
+    def _diffusing_computation(self, out: MsgsToSend) -> bool:
+        """Dual.cpp:213-246."""
+        assert self.nexthop is not None
+        ld = self.local_distances[self.nexthop]
+        rd = self._info(self.nexthop).report_distance
+        new_distance = _add(ld, rd)
+        self.distance = new_distance
+        self.report_distance = new_distance
+        self.feasible_distance = new_distance
+
+        success = False
+        for neighbor, ldist in self.local_distances.items():
+            if ldist == INF_DISTANCE:
+                continue
+            out.setdefault(neighbor, DualMessages()).messages.append(
+                DualMessage(
+                    self.root_id,
+                    self.report_distance,
+                    DualMessageType.QUERY,
+                )
+            )
+            self._count(neighbor, "query_sent")
+            self._info(neighbor).expect_reply = True
+            success = True
+        return success
+
+    def _send_reply(self, out: MsgsToSend) -> None:
+        """Dual.cpp:566-595."""
+        assert self.cornet, "send reply on empty cornet"
+        dst = self.cornet.pop()
+        if not self._neighbor_up(dst):
+            self._info(dst).need_to_reply = True
+            return
+        out.setdefault(dst, DualMessages()).messages.append(
+            DualMessage(
+                self.root_id, self.report_distance, DualMessageType.REPLY
+            )
+        )
+        self._count(dst, "reply_sent")
+
+    def _try_local_or_diffusing(
+        self, event: DualEvent, need_reply: bool, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:248-294."""
+        if not self._route_affected():
+            if need_reply:
+                self._send_reply(out)
+            return
+        fc = self._meet_feasible_condition()
+        if self.nexthop is None:
+            assert fc is not None, "nexthop invalid, must meet FC"
+        if fc is not None:
+            new_nexthop, new_distance = fc
+            self._local_computation(new_nexthop, new_distance, out)
+            if need_reply:
+                self._send_reply(out)
+        else:
+            if need_reply and event != DualEvent.QUERY_FROM_SUCCESSOR:
+                self._send_reply(out)
+            if self._diffusing_computation(out):
+                self.sm.process_event(event, False)
+            if self.nexthop is not None and not self._neighbor_up(
+                self.nexthop
+            ):
+                self._set_nexthop(None)
+
+    # -- events ----------------------------------------------------------
+
+    def peer_up(self, neighbor: str, cost: int, out: MsgsToSend) -> None:
+        """Dual.cpp:400-464."""
+        if self.nexthop == neighbor:
+            # non-graceful restart of my parent: reset as-if peer-down
+            self._set_nexthop(None)
+            self.distance = INF_DISTANCE
+        self.local_distances[neighbor] = cost
+        self._info(neighbor)
+
+        if self.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.OTHERS, False, out)
+        elif self._info(neighbor).expect_reply:
+            # pending reply resolved by the neighbor coming back
+            self.process_reply(
+                neighbor,
+                DualMessage(
+                    self.root_id,
+                    self._info(neighbor).report_distance,
+                    DualMessageType.REPLY,
+                ),
+                out,
+            )
+
+        out.setdefault(neighbor, DualMessages()).messages.append(
+            DualMessage(
+                self.root_id, self.report_distance, DualMessageType.UPDATE
+            )
+        )
+        self._count(neighbor, "update_sent")
+
+        if self._info(neighbor).need_to_reply:
+            self._info(neighbor).need_to_reply = False
+            out.setdefault(neighbor, DualMessages()).messages.append(
+                DualMessage(
+                    self.root_id,
+                    self.report_distance,
+                    DualMessageType.REPLY,
+                )
+            )
+            self._count(neighbor, "reply_sent")
+
+    def peer_down(self, neighbor: str, out: MsgsToSend) -> None:
+        """Dual.cpp:466-501."""
+        self.counters.pop(neighbor, None)
+        self.remove_child(neighbor)
+        self.local_distances[neighbor] = INF_DISTANCE
+        self._info(neighbor).report_distance = INF_DISTANCE
+        if self.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.INCREASE_D, False, out)
+        else:
+            self.sm.process_event(DualEvent.INCREASE_D)
+            if self._info(neighbor).expect_reply:
+                # as-if the dead neighbor replied with infinite distance
+                self.process_reply(
+                    neighbor,
+                    DualMessage(
+                        self.root_id, INF_DISTANCE, DualMessageType.REPLY
+                    ),
+                    out,
+                )
+
+    def peer_cost_change(
+        self, neighbor: str, cost: int, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:503-527."""
+        event = (
+            DualEvent.INCREASE_D
+            if cost > self.local_distances.get(neighbor, INF_DISTANCE)
+            else DualEvent.OTHERS
+        )
+        self.local_distances[neighbor] = cost
+        if self.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(event, False, out)
+        else:
+            if self.nexthop == neighbor:
+                self.distance = _add(
+                    cost, self._info(neighbor).report_distance
+                )
+            self.sm.process_event(event)
+
+    def process_update(
+        self, neighbor: str, update: DualMessage, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:529-563."""
+        assert update.type == DualMessageType.UPDATE
+        assert update.dst_id == self.root_id
+        self._count(neighbor, "update_recv")
+        self._info(neighbor).report_distance = update.distance
+        if neighbor not in self.local_distances:
+            return  # UPDATE before LINK-UP
+        if self.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.OTHERS, False, out)
+        else:
+            if self.nexthop == neighbor:
+                self.distance = _add(
+                    self.local_distances[neighbor], update.distance
+                )
+            self.sm.process_event(DualEvent.OTHERS)
+
+    def process_query(
+        self, neighbor: str, query: DualMessage, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:597-633."""
+        assert query.type == DualMessageType.QUERY
+        assert query.dst_id == self.root_id
+        self._count(neighbor, "query_recv")
+        self._info(neighbor).report_distance = query.distance
+        self.cornet.append(neighbor)
+        event = (
+            DualEvent.QUERY_FROM_SUCCESSOR
+            if self.nexthop == neighbor
+            else DualEvent.OTHERS
+        )
+        if self.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(event, True, out)
+        else:
+            if self.nexthop == neighbor:
+                self.distance = _add(
+                    self.local_distances[self.nexthop],
+                    self._info(self.nexthop).report_distance,
+                )
+            self.sm.process_event(event)
+            self._send_reply(out)
+
+    def process_reply(
+        self, neighbor: str, reply: DualMessage, out: MsgsToSend
+    ) -> None:
+        """Dual.cpp:635-712."""
+        assert reply.type == DualMessageType.REPLY
+        assert reply.dst_id == self.root_id
+        self._count(neighbor, "reply_recv")
+        info = self._info(neighbor)
+        if not info.expect_reply:
+            return  # stale reply after link-down: ignore
+        info.report_distance = reply.distance
+        info.expect_reply = False
+        if any(i.expect_reply for i in self.neighbor_infos.values()):
+            return  # not the last reply yet
+
+        # all dependents converged: free to pick the optimal successor
+        self.sm.process_event(DualEvent.LAST_REPLY, True)
+        dmin = INF_DISTANCE
+        new_nh: Optional[str] = None
+        for nb, ld in self.local_distances.items():
+            d = _add(ld, self._info(nb).report_distance)
+            if d < dmin:
+                dmin = d
+                new_nh = nb
+        same_rd = dmin == self.report_distance
+        self.distance = dmin
+        self.report_distance = dmin
+        self.feasible_distance = dmin
+        self._set_nexthop(new_nh)
+        if not same_rd:
+            self._flood_updates(out)
+        if self.cornet:
+            assert len(self.cornet) == 1, "one diffusion per destination"
+            self._send_reply(out)
+
+
+class DualNode:
+    """Multi-root DUAL container; subclass provides I/O (Dual.cpp:716+)."""
+
+    def __init__(self, node_id: str, is_root: bool = False) -> None:
+        self.node_id = node_id
+        self.is_root = is_root
+        self.duals: Dict[str, Dual] = {}
+        self.local_distances: Dict[str, int] = {}
+        self.pkt_counters: Dict[str, Dict[str, int]] = {}
+        if is_root:
+            self._add_dual(node_id)
+
+    # -- I/O seam --------------------------------------------------------
+
+    def send_dual_messages(
+        self, neighbor: str, msgs: DualMessages
+    ) -> bool:
+        raise NotImplementedError
+
+    def process_nexthop_change(
+        self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
+    ) -> None:
+        raise NotImplementedError
+
+    # -- events ----------------------------------------------------------
+
+    def peer_up(self, neighbor: str, cost: int) -> None:
+        self.local_distances[neighbor] = cost
+        out: MsgsToSend = {}
+        for dual in self.duals.values():
+            dual.peer_up(neighbor, cost, out)
+        self._send_all(out)
+
+    def peer_down(self, neighbor: str) -> None:
+        self.local_distances[neighbor] = INF_DISTANCE
+        self.pkt_counters.pop(neighbor, None)
+        out: MsgsToSend = {}
+        for dual in self.duals.values():
+            dual.peer_down(neighbor, out)
+        self._send_all(out)
+
+    def peer_cost_change(self, neighbor: str, cost: int) -> None:
+        self.local_distances[neighbor] = cost
+        out: MsgsToSend = {}
+        for dual in self.duals.values():
+            dual.peer_cost_change(neighbor, cost, out)
+        self._send_all(out)
+
+    def process_dual_messages(self, messages: DualMessages) -> None:
+        neighbor = messages.src_id
+        c = self.pkt_counters.setdefault(neighbor, {})
+        c["pkt_recv"] = c.get("pkt_recv", 0) + 1
+        c["msg_recv"] = c.get("msg_recv", 0) + len(messages.messages)
+        out: MsgsToSend = {}
+        for msg in messages.messages:
+            self._add_dual(msg.dst_id)
+            dual = self.duals[msg.dst_id]
+            if msg.type == DualMessageType.UPDATE:
+                dual.process_update(neighbor, msg, out)
+            elif msg.type == DualMessageType.QUERY:
+                dual.process_query(neighbor, msg, out)
+            elif msg.type == DualMessageType.REPLY:
+                dual.process_reply(neighbor, msg, out)
+        self._send_all(out)
+
+    # -- getters ---------------------------------------------------------
+
+    def has_dual(self, root_id: str) -> bool:
+        return root_id in self.duals
+
+    def get_dual(self, root_id: str) -> Dual:
+        return self.duals[root_id]
+
+    def get_spt_root_id(self) -> Optional[str]:
+        """Smallest root id with a valid route (Dual.cpp:786-800)."""
+        for root_id in sorted(self.duals):
+            if self.duals[root_id].has_valid_route():
+                return root_id
+        return None
+
+    def get_spt_peers(self, root_id: Optional[str]) -> Set[str]:
+        if root_id is None or root_id not in self.duals:
+            return set()
+        return self.duals[root_id].spt_peers()
+
+    def neighbor_is_up(self, neighbor: str) -> bool:
+        return self.local_distances.get(neighbor, INF_DISTANCE) != (
+            INF_DISTANCE
+        )
+
+    # -- internals -------------------------------------------------------
+
+    def _add_dual(self, root_id: str) -> None:
+        if root_id in self.duals:
+            return
+        self.duals[root_id] = Dual(
+            self.node_id,
+            root_id,
+            self.local_distances,
+            lambda old, new, r=root_id: self.process_nexthop_change(
+                r, old, new
+            ),
+        )
+
+    def _send_all(self, out: MsgsToSend) -> None:
+        for neighbor, msgs in out.items():
+            if not msgs.messages:
+                continue
+            msgs.src_id = self.node_id
+            if not self.send_dual_messages(neighbor, msgs):
+                log.error("failed to send dual messages to %s", neighbor)
+                continue
+            c = self.pkt_counters.setdefault(neighbor, {})
+            c["pkt_sent"] = c.get("pkt_sent", 0) + 1
+            c["msg_sent"] = c.get("msg_sent", 0) + len(msgs.messages)

@@ -256,6 +256,10 @@ class KvStoreParams:
     flood_buffer_delay: float = 0.1  # kFloodPendingPublication (100ms)
     sync_max_backoff: float = 8.0
     filters: Optional[KvStoreFilters] = None
+    # DUAL flood-topology optimization: flood on a spanning tree instead of
+    # the full peer mesh (KvstoreConfig.enable_flood_optimization)
+    enable_flood_optimization: bool = False
+    is_flood_root: bool = False
 
 
 class KvStoreDb(CountersMixin):
@@ -292,6 +296,11 @@ class KvStoreDb(CountersMixin):
         self._retry_pending: Set[str] = set()
         self._sync_tasks: Set[asyncio.Task] = set()
         self.counters: Dict[str, int] = {}
+        # DUAL flood-topology optimization (KvStore.h:193 inherits DualNode;
+        # composed here): SPT per flood-root, flood only to SPT peers
+        self.dual: Optional["_KvDualNode"] = None
+        if params.enable_flood_optimization:
+            self.dual = _KvDualNode(self)
 
     # -- basic API ---------------------------------------------------------
 
@@ -439,7 +448,8 @@ class KvStoreDb(CountersMixin):
         if not publication.key_vals:
             return  # expiry-only publications stay local
 
-        for peer_name, peer in self.peers.items():
+        for peer_name in self.get_flood_peers():
+            peer = self.peers[peer_name]
             if sender_id is not None and sender_id == peer_name:
                 continue  # never flood back to the sender
             if peer.state == PeerState.IDLE:
@@ -451,6 +461,17 @@ class KvStoreDb(CountersMixin):
                     list(publication.node_ids),
                 )
             )
+
+    def get_flood_peers(self) -> List[str]:
+        """SPT peers when flood optimization has a ready tree, else all
+        peers (KvStore.cpp:2819-2839)."""
+        if self.dual is not None:
+            root_id = self.dual.get_spt_root_id()
+            spt_peers = self.dual.get_spt_peers(root_id)
+            if spt_peers:
+                self._bump("kvstore.flood_via_spt")
+                return [p for p in spt_peers if p in self.peers]
+        return list(self.peers)
 
     def _buffer_publication(self, publication: Publication) -> None:
         self._bump("kvstore.rate_limit_suppress")
@@ -501,11 +522,16 @@ class KvStoreDb(CountersMixin):
                 ),
             )
             self._peer_event(name, PeerEvent.PEER_ADD)
+            if self.dual is not None:
+                self.dual.peer_up(name, 1)  # KvStore peers at unit metric
             self._spawn(self._full_sync(name))
 
     def del_peers(self, names: List[str]) -> None:
         for name in names:
-            self.peers.pop(name, None)
+            if self.peers.pop(name, None) is not None and (
+                self.dual is not None
+            ):
+                self.dual.peer_down(name)
 
     def get_peers(self) -> Dict[str, PeerSpec]:
         return {name: p.spec for name, p in self.peers.items()}
@@ -675,6 +701,120 @@ class KvStoreDb(CountersMixin):
         for task in list(self._sync_tasks):
             task.cancel()
 
+    # -- DUAL flood-topology integration -----------------------------------
+
+    def handle_dual_messages(self, msgs) -> None:
+        """Peer-delivered DUAL messages (KvStore.cpp:892)."""
+        if self.dual is not None:
+            self.dual.process_dual_messages(msgs)
+
+    def handle_flood_topo_set(
+        self, root_id: str, src_id: str, set_child: bool, all_roots: bool
+    ) -> None:
+        """processFloodTopoSet (KvStore.cpp:2238-2267)."""
+        if self.dual is None:
+            return
+        if all_roots and not set_child:
+            for dual in self.dual.duals.values():
+                dual.remove_child(src_id)
+            return
+        if not self.dual.has_dual(root_id):
+            return
+        dual = self.dual.get_dual(root_id)
+        if set_child:
+            dual.add_child(src_id)
+        else:
+            dual.remove_child(src_id)
+
+    def get_spt_infos(self) -> Dict:
+        """processFloodTopoGet (KvStore.cpp:2202-2234): SPT state dump."""
+        out: Dict = {"spt_infos": {}, "flood_root_id": None, "flood_peers": []}
+        if self.dual is None:
+            out["flood_peers"] = list(self.peers)
+            return out
+        for root_id, dual in self.dual.duals.items():
+            out["spt_infos"][root_id] = {
+                "passive": dual.sm.state.name == "PASSIVE",
+                "cost": dual.distance,
+                "parent": dual.nexthop,
+                "children": sorted(dual.children()),
+            }
+        out["flood_root_id"] = self.dual.get_spt_root_id()
+        out["flood_peers"] = self.get_flood_peers()
+        return out
+
+
+class _KvDualNode:
+    """DualNode subclass-equivalent bound to one KvStoreDb (the reference
+    makes KvStoreDb inherit DualNode, KvStore.h:193; composition here).
+
+    Nexthop changes drive the flood topology: unset-child on the old
+    parent, set-child + full-sync on the new one (KvStore.cpp:2315-2360).
+    """
+
+    def __init__(self, db: KvStoreDb) -> None:
+        from openr_tpu.dual import DualNode
+
+        outer = self
+
+        class _Node(DualNode):
+            def send_dual_messages(self, neighbor, msgs) -> bool:
+                return outer._send(neighbor, msgs)
+
+            def process_nexthop_change(self, root_id, old_nh, new_nh):
+                outer._nexthop_change(root_id, old_nh, new_nh)
+
+        self.db = db
+        self._node = _Node(
+            db.params.node_id, is_root=db.params.is_flood_root
+        )
+
+    # -- DualNode facade -------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._node, name)
+
+    @property
+    def duals(self):
+        return self._node.duals
+
+    # -- wiring ----------------------------------------------------------
+
+    def _send(self, neighbor: str, msgs) -> bool:
+        if neighbor not in self.db.peers:
+            return False
+        self.db._spawn(
+            self.db.transport.dual_messages(
+                self.db.peers[neighbor].spec.peer_addr, self.db.area, msgs
+            )
+        )
+        return True
+
+    def _nexthop_change(self, root_id, old_nh, new_nh) -> None:
+        if new_nh is not None and new_nh in self.db.peers:
+            self.db._spawn(
+                self.db.transport.flood_topo_set(
+                    self.db.peers[new_nh].spec.peer_addr,
+                    self.db.area,
+                    root_id,
+                    self.db.params.node_id,
+                    True,
+                )
+            )
+            # full sync with the new parent so the SPT edge carries a
+            # consistent store (KvStore.cpp:2342-2349)
+            self.db._spawn(self.db._full_sync(new_nh))
+        if old_nh is not None and old_nh in self.db.peers:
+            self.db._spawn(
+                self.db.transport.flood_topo_set(
+                    self.db.peers[old_nh].spec.peer_addr,
+                    self.db.area,
+                    root_id,
+                    self.db.params.node_id,
+                    False,
+                )
+            )
+
 
 # ---------------------------------------------------------------------------
 # KvStore — multi-area container
@@ -747,6 +887,23 @@ class KvStore:
         db = self.dbs.get(area)
         if db is not None:
             db.handle_set_key_vals(key_vals, node_ids)
+
+    def handle_dual_messages(self, area: str, msgs) -> None:
+        db = self.dbs.get(area)
+        if db is not None:
+            db.handle_dual_messages(msgs)
+
+    def handle_flood_topo_set(
+        self,
+        area: str,
+        root_id: str,
+        src_id: str,
+        set_child: bool,
+        all_roots: bool,
+    ) -> None:
+        db = self.dbs.get(area)
+        if db is not None:
+            db.handle_flood_topo_set(root_id, src_id, set_child, all_roots)
 
     def handle_dump(
         self, area: str, key_val_hashes: Optional[KeyVals]

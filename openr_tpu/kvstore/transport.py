@@ -45,6 +45,23 @@ class KvStoreTransport:
         only differing keys plus tobe_updated_keys (3-way sync)."""
         raise NotImplementedError
 
+    async def dual_messages(self, peer_addr: str, area: str, msgs) -> None:
+        """DUAL_CMD: deliver DUAL messages (KvStore.cpp:892)."""
+        raise NotImplementedError
+
+    async def flood_topo_set(
+        self,
+        peer_addr: str,
+        area: str,
+        root_id: str,
+        src_id: str,
+        set_child: bool,
+        all_roots: bool = False,
+    ) -> None:
+        """FLOOD_TOPO_SET: (un)register src as an SPT child
+        (KvStore.cpp:2270-2282)."""
+        raise NotImplementedError
+
 
 class InProcessTransport(KvStoreTransport):
     """Directly wired stores with optional latency/partitions."""
@@ -104,6 +121,31 @@ class InProcessTransport(KvStoreTransport):
         target = self._target(caller, peer_addr)
         return target.handle_dump(area, key_val_hashes)
 
+    async def call_dual(
+        self, caller: str, peer_addr: str, area: str, msgs
+    ) -> None:
+        if self._delay:
+            await asyncio.sleep(self._delay)
+        target = self._target(caller, peer_addr)
+        target.handle_dual_messages(area, msgs)
+
+    async def call_flood_topo_set(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        root_id: str,
+        src_id: str,
+        set_child: bool,
+        all_roots: bool,
+    ) -> None:
+        if self._delay:
+            await asyncio.sleep(self._delay)
+        target = self._target(caller, peer_addr)
+        target.handle_flood_topo_set(
+            area, root_id, src_id, set_child, all_roots
+        )
+
 
 class BoundTransport(KvStoreTransport):
     """A transport handle bound to one caller's node id."""
@@ -131,4 +173,26 @@ class BoundTransport(KvStoreTransport):
     ) -> Publication:
         return await self._inner.call_dump(
             self._node_id, peer_addr, area, key_val_hashes
+        )
+
+    async def dual_messages(self, peer_addr: str, area: str, msgs) -> None:
+        await self._inner.call_dual(self._node_id, peer_addr, area, msgs)
+
+    async def flood_topo_set(
+        self,
+        peer_addr: str,
+        area: str,
+        root_id: str,
+        src_id: str,
+        set_child: bool,
+        all_roots: bool = False,
+    ) -> None:
+        await self._inner.call_flood_topo_set(
+            self._node_id,
+            peer_addr,
+            area,
+            root_id,
+            src_id,
+            set_child,
+            all_roots,
         )

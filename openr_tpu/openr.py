@@ -102,6 +102,10 @@ class OpenrDaemon:
                     if c.kvstore_config.flood_rate is not None
                     else None
                 ),
+                enable_flood_optimization=(
+                    c.kvstore_config.enable_flood_optimization
+                ),
+                is_flood_root=c.kvstore_config.is_flood_root,
             ),
             loop=loop,
         )

@@ -1,0 +1,342 @@
+"""DUAL tests, mirroring openr/dual/tests/DualTest.cpp: state machine
+transitions (:123), ring/full-mesh/grid topologies with SPT validation,
+link failures and cost changes, multi-root, non-graceful peer restart."""
+
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+import pytest
+
+from openr_tpu.dual import (
+    Dual,
+    DualMessages,
+    DualNode,
+    DualState,
+    INF_DISTANCE,
+)
+from openr_tpu.dual.dual import DualEvent, DualStateMachine
+
+
+class TestStateMachine:
+    """Transition matrix (DualTest.cpp:123)."""
+
+    def test_passive_stays_on_fc(self):
+        sm = DualStateMachine()
+        sm.process_event(DualEvent.OTHERS, fc=True)
+        assert sm.state == DualState.PASSIVE
+
+    def test_passive_to_active1(self):
+        sm = DualStateMachine()
+        sm.process_event(DualEvent.OTHERS, fc=False)
+        assert sm.state == DualState.ACTIVE1
+
+    def test_passive_to_active3_on_successor_query(self):
+        sm = DualStateMachine()
+        sm.process_event(DualEvent.QUERY_FROM_SUCCESSOR, fc=False)
+        assert sm.state == DualState.ACTIVE3
+
+    def test_active1_transitions(self):
+        sm = DualStateMachine()
+        sm.state = DualState.ACTIVE1
+        sm.process_event(DualEvent.INCREASE_D)
+        assert sm.state == DualState.ACTIVE0
+        sm.state = DualState.ACTIVE1
+        sm.process_event(DualEvent.LAST_REPLY)
+        assert sm.state == DualState.PASSIVE
+        sm.state = DualState.ACTIVE1
+        sm.process_event(DualEvent.QUERY_FROM_SUCCESSOR)
+        assert sm.state == DualState.ACTIVE2
+
+    def test_active0_last_reply(self):
+        sm = DualStateMachine()
+        sm.state = DualState.ACTIVE0
+        sm.process_event(DualEvent.LAST_REPLY, fc=True)
+        assert sm.state == DualState.PASSIVE
+        sm.state = DualState.ACTIVE0
+        sm.process_event(DualEvent.LAST_REPLY, fc=False)
+        assert sm.state == DualState.ACTIVE2
+
+    def test_active2_and_3(self):
+        sm = DualStateMachine()
+        sm.state = DualState.ACTIVE2
+        sm.process_event(DualEvent.LAST_REPLY, fc=False)
+        assert sm.state == DualState.ACTIVE3
+        sm.process_event(DualEvent.LAST_REPLY)
+        assert sm.state == DualState.PASSIVE
+        sm.state = DualState.ACTIVE3
+        sm.process_event(DualEvent.INCREASE_D)
+        assert sm.state == DualState.ACTIVE2
+
+
+class _BusNode(DualNode):
+    """DualNode over a synchronous in-memory bus (DualTest TestNode equiv)."""
+
+    def __init__(self, bus: "Bus", node_id: str, is_root: bool) -> None:
+        super().__init__(node_id, is_root)
+        self.bus = bus
+        self.nexthop_changes: List[Tuple[str, Optional[str], Optional[str]]] = []
+
+    def send_dual_messages(self, neighbor: str, msgs: DualMessages) -> bool:
+        if not self.neighbor_is_up(neighbor):
+            return False
+        self.bus.enqueue(neighbor, msgs)
+        return True
+
+    def process_nexthop_change(self, root_id, old_nh, new_nh) -> None:
+        self.nexthop_changes.append((root_id, old_nh, new_nh))
+        # maintain parent's child-set (KvStore does this via flood-topo
+        # set/unset commands in the real wiring)
+        dual = self.duals[root_id]
+        if old_nh is not None and old_nh in self.bus.nodes:
+            self.bus.nodes[old_nh].duals.get(root_id) and self.bus.nodes[
+                old_nh
+            ].duals[root_id].remove_child(self.node_id)
+        if new_nh is not None and new_nh != self.node_id:
+            self.bus.defer_child_add(root_id, new_nh, self.node_id)
+
+
+class Bus:
+    """FIFO message fabric: delivers queued DualMessages until quiescent."""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, _BusNode] = {}
+        self.queue: List[Tuple[str, DualMessages]] = []
+        self.links: Set[frozenset] = set()
+        self._child_adds: List[Tuple[str, str, str]] = []
+
+    def add_node(self, name: str, is_root: bool = False) -> _BusNode:
+        node = _BusNode(self, name, is_root)
+        self.nodes[name] = node
+        return node
+
+    def enqueue(self, dst: str, msgs: DualMessages) -> None:
+        self.queue.append((dst, msgs))
+
+    def defer_child_add(self, root_id, parent, child) -> None:
+        self._child_adds.append((root_id, parent, child))
+
+    def connect(self, a: str, b: str, cost: int = 1) -> None:
+        self.links.add(frozenset((a, b)))
+        self.nodes[a].peer_up(b, cost)
+        self.nodes[b].peer_up(a, cost)
+        self.settle()
+
+    def disconnect(self, a: str, b: str) -> None:
+        self.links.discard(frozenset((a, b)))
+        self.nodes[a].peer_down(b)
+        self.nodes[b].peer_down(a)
+        self.settle()
+
+    def change_cost(self, a: str, b: str, cost: int) -> None:
+        self.nodes[a].peer_cost_change(b, cost)
+        self.nodes[b].peer_cost_change(a, cost)
+        self.settle()
+
+    def settle(self, max_steps: int = 100_000) -> None:
+        steps = 0
+        while self.queue:
+            steps += 1
+            assert steps < max_steps, "dual did not converge"
+            dst, msgs = self.queue.pop(0)
+            if frozenset((dst, msgs.src_id)) not in self.links:
+                continue  # dropped on a dead link
+            self.nodes[dst].process_dual_messages(msgs)
+            self._apply_child_adds()
+        self._apply_child_adds()
+
+    def _apply_child_adds(self) -> None:
+        while self._child_adds:
+            root_id, parent, child = self._child_adds.pop(0)
+            node = self.nodes.get(parent)
+            if node is not None and root_id in node.duals:
+                node.duals[root_id].add_child(child)
+
+    # -- validation (DualTest.cpp checkSpt semantics) -------------------
+
+    def check_spt(self, root_id: str, expect_distances: Dict[str, int]):
+        for name, node in self.nodes.items():
+            dual = node.duals.get(root_id)
+            expected = expect_distances.get(name)
+            if expected is None:
+                assert dual is None or not dual.has_valid_route()
+                continue
+            assert dual is not None, f"{name} has no dual for {root_id}"
+            assert dual.sm.state == DualState.PASSIVE, (
+                f"{name} not passive: {dual.sm.state}"
+            )
+            assert dual.distance == expected, (
+                f"{name}: d={dual.distance} expected {expected}"
+            )
+            if name != root_id:
+                # parent is one hop closer through a live link
+                parent = dual.nexthop
+                assert parent is not None, name
+                assert frozenset((name, parent)) in self.links
+                parent_d = self.nodes[parent].duals[root_id].distance
+                assert parent_d < dual.distance
+                # loop-free: following parents reaches the root
+                seen, cur = set(), name
+                while cur != root_id:
+                    assert cur not in seen, f"loop at {cur}"
+                    seen.add(cur)
+                    cur = self.nodes[cur].duals[root_id].nexthop
+
+
+class TestRing:
+    def test_three_ring_spt(self):
+        bus = Bus()
+        for name in ("r", "a", "b"):
+            bus.add_node(name, is_root=(name == "r"))
+        bus.connect("r", "a")
+        bus.connect("r", "b")
+        bus.connect("a", "b")
+        bus.check_spt("r", {"r": 0, "a": 1, "b": 1})
+        # spt peers of root include both children
+        assert bus.nodes["a"].get_spt_peers("r") == {"r"}
+
+    def test_link_failure_reroutes(self):
+        bus = Bus()
+        for name in ("r", "a", "b"):
+            bus.add_node(name, is_root=(name == "r"))
+        bus.connect("r", "a")
+        bus.connect("r", "b")
+        bus.connect("a", "b")
+        bus.disconnect("r", "a")
+        # a now reaches r via b
+        bus.check_spt("r", {"r": 0, "b": 1, "a": 2})
+        assert bus.nodes["a"].duals["r"].nexthop == "b"
+
+    def test_cost_change_moves_traffic(self):
+        bus = Bus()
+        for name in ("r", "a", "b"):
+            bus.add_node(name, is_root=(name == "r"))
+        bus.connect("r", "a", cost=10)
+        bus.connect("r", "b", cost=1)
+        bus.connect("a", "b", cost=1)
+        bus.check_spt("r", {"r": 0, "b": 1, "a": 2})
+        # direct r-a link becomes cheap: a switches to direct
+        bus.change_cost("r", "a", 1)
+        bus.check_spt("r", {"r": 0, "b": 1, "a": 1})
+        assert bus.nodes["a"].duals["r"].nexthop == "r"
+
+    def test_larger_ring(self):
+        n = 8
+        bus = Bus()
+        names = [f"n{i}" for i in range(n)]
+        for name in names:
+            bus.add_node(name, is_root=(name == "n0"))
+        for i in range(n):
+            bus.connect(names[i], names[(i + 1) % n])
+        expected = {
+            names[i]: min(i, n - i) for i in range(n)
+        }
+        bus.check_spt("n0", expected)
+
+
+class TestFullMeshAndGrid:
+    def test_full_mesh(self):
+        bus = Bus()
+        names = [f"m{i}" for i in range(5)]
+        for name in names:
+            bus.add_node(name, is_root=(name == "m0"))
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                bus.connect(a, b)
+        bus.check_spt("m0", {names[0]: 0, **{n: 1 for n in names[1:]}})
+
+    def test_grid(self):
+        side = 3
+        bus = Bus()
+        for i in range(side):
+            for j in range(side):
+                bus.add_node(f"g{i}_{j}", is_root=(i == 0 and j == 0))
+        for i in range(side):
+            for j in range(side):
+                if j + 1 < side:
+                    bus.connect(f"g{i}_{j}", f"g{i}_{j+1}")
+                if i + 1 < side:
+                    bus.connect(f"g{i}_{j}", f"g{i+1}_{j}")
+        expected = {
+            f"g{i}_{j}": i + j for i in range(side) for j in range(side)
+        }
+        bus.check_spt("g0_0", expected)
+
+    def test_random_failures_still_converge(self):
+        rng = random.Random(7)
+        side = 3
+        bus = Bus()
+        for i in range(side):
+            for j in range(side):
+                bus.add_node(f"g{i}_{j}", is_root=(i == 0 and j == 0))
+        edges = []
+        for i in range(side):
+            for j in range(side):
+                if j + 1 < side:
+                    edges.append((f"g{i}_{j}", f"g{i}_{j+1}"))
+                if i + 1 < side:
+                    edges.append((f"g{i}_{j}", f"g{i+1}_{j}"))
+        for a, b in edges:
+            bus.connect(a, b)
+        # fail a few non-partitioning links
+        for a, b in rng.sample(edges, 3):
+            remaining = [e for e in bus.links]
+            bus.disconnect(a, b)
+            if not _connected(bus):
+                bus.connect(a, b)
+        # recompute expected distances by BFS over live links
+        expected = _bfs_distances(bus, "g0_0")
+        bus.check_spt("g0_0", expected)
+
+
+class TestMultiRoot:
+    def test_smallest_valid_root_wins(self):
+        bus = Bus()
+        for name in ("a-root", "b-root", "x", "y"):
+            bus.add_node(name, is_root=name.endswith("root"))
+        bus.connect("a-root", "x")
+        bus.connect("x", "y")
+        bus.connect("y", "b-root")
+        for node in bus.nodes.values():
+            assert node.get_spt_root_id() == "a-root"
+        # a-root dies entirely: everyone falls back to b-root
+        bus.disconnect("a-root", "x")
+        assert bus.nodes["x"].get_spt_root_id() == "b-root"
+        assert bus.nodes["y"].get_spt_root_id() == "b-root"
+
+
+def _connected(bus: Bus) -> bool:
+    if not bus.nodes:
+        return True
+    adj: Dict[str, Set[str]] = {n: set() for n in bus.nodes}
+    for link in bus.links:
+        a, b = tuple(link)
+        adj[a].add(b)
+        adj[b].add(a)
+    seen: Set[str] = set()
+    stack = [next(iter(bus.nodes))]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(adj[cur] - seen)
+    return len(seen) == len(bus.nodes)
+
+
+def _bfs_distances(bus: Bus, root: str) -> Dict[str, int]:
+    adj: Dict[str, Set[str]] = {n: set() for n in bus.nodes}
+    for link in bus.links:
+        a, b = tuple(link)
+        adj[a].add(b)
+        adj[b].add(a)
+    dist = {root: 0}
+    frontier = [root]
+    while frontier:
+        nxt = []
+        for cur in frontier:
+            for other in adj[cur]:
+                if other not in dist:
+                    dist[other] = dist[cur] + 1
+                    nxt.append(other)
+        frontier = nxt
+    return dist

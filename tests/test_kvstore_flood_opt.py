@@ -1,0 +1,185 @@
+"""KvStore DUAL flood-topology optimization tests, mirroring the
+flood-optimization scenarios of openr/kvstore/tests/KvStoreTest.cpp: SPT
+formation across stores, SPT-restricted flooding still reaching everyone,
+fallback to full flooding when the tree is not ready."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreParams,
+    PeerSpec,
+)
+from openr_tpu.types import Value
+
+
+def run(coro, timeout=20.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+async def wait_until(predicate, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_event_loop().time() < deadline, "timed out"
+        await asyncio.sleep(0.02)
+
+
+def make_mesh(names, root=None, transport=None):
+    transport = transport or InProcessTransport()
+    stores = {}
+    for name in names:
+        stores[name] = KvStore(
+            name,
+            ["0"],
+            transport,
+            KvStoreParams(
+                node_id=name,
+                enable_flood_optimization=True,
+                is_flood_root=(name == root),
+            ),
+        )
+    return stores, transport
+
+
+def full_mesh_peering(stores):
+    for name, store in stores.items():
+        store.add_peers(
+            {other: PeerSpec(other) for other in stores if other != name}
+        )
+
+
+class TestSptFormation:
+    def test_spt_forms_around_root(self):
+        async def body():
+            stores, _ = make_mesh(["r", "a", "b"], root="r")
+            full_mesh_peering(stores)
+            # allow DUAL message exchange to quiesce
+            await wait_until(
+                lambda: all(
+                    s.db("0").dual.get_spt_root_id() == "r"
+                    for s in stores.values()
+                )
+            )
+            # non-root nodes have parent r (full mesh, unit metrics)
+            for name in ("a", "b"):
+                dual = stores[name].db("0").dual.get_dual("r")
+                assert dual.distance == 1
+                assert dual.nexthop == "r"
+            # root's children cover a and b
+            await wait_until(
+                lambda: stores["r"].db("0").dual.get_dual("r").children()
+                == {"a", "b"}
+            )
+            # flood peers of a: only its SPT parent
+            assert stores["a"].db("0").get_flood_peers() == ["r"]
+            infos = stores["r"].db("0").get_spt_infos()
+            assert infos["flood_root_id"] == "r"
+            assert infos["spt_infos"]["r"]["passive"]
+            await asyncio.sleep(0)
+
+        run(body())
+
+    def test_flood_via_spt_reaches_everyone(self):
+        async def body():
+            names = ["r", "a", "b", "c"]
+            stores, _ = make_mesh(names, root="r")
+            full_mesh_peering(stores)
+            await wait_until(
+                lambda: all(
+                    s.db("0").dual.get_spt_root_id() == "r"
+                    for s in stores.values()
+                )
+            )
+            await wait_until(
+                lambda: len(
+                    stores["r"].db("0").dual.get_dual("r").children()
+                )
+                == 3
+            )
+            stores["a"].set_key("k-flood", Value(1, "a", b"payload"))
+            # reaches every store through the tree
+            for store in stores.values():
+                await wait_until(
+                    lambda s=store: s.get_key("k-flood") is not None
+                )
+            # SPT flooding was actually used
+            assert (
+                stores["a"].db("0").counters.get("kvstore.flood_via_spt", 0)
+                > 0
+            )
+
+        run(body())
+
+    def test_no_root_falls_back_to_full_flood(self):
+        async def body():
+            stores, _ = make_mesh(["a", "b"], root=None)  # no root anywhere
+            full_mesh_peering(stores)
+            await asyncio.sleep(0.1)
+            assert stores["a"].db("0").dual.get_spt_root_id() is None
+            assert set(stores["a"].db("0").get_flood_peers()) == {"b"}
+            stores["a"].set_key("k1", Value(1, "a", b"x"))
+            await wait_until(lambda: stores["b"].get_key("k1") is not None)
+
+        run(body())
+
+    def test_root_failure_tree_reconverges(self):
+        async def body():
+            # line r - a - b plus backup root rb connected to b; when r
+            # dies the tree re-roots at rb
+            transport = InProcessTransport()
+            stores, _ = make_mesh(
+                ["r0", "a", "b", "r9"], root=None, transport=transport
+            )
+            # two roots: r0 (preferred, smaller id) and r9
+            stores["r0"] = KvStore(
+                "r0",
+                ["0"],
+                transport,
+                KvStoreParams(
+                    node_id="r0",
+                    enable_flood_optimization=True,
+                    is_flood_root=True,
+                ),
+            )
+            stores["r9"] = KvStore(
+                "r9",
+                ["0"],
+                transport,
+                KvStoreParams(
+                    node_id="r9",
+                    enable_flood_optimization=True,
+                    is_flood_root=True,
+                ),
+            )
+            # line topology: r0 - a - b - r9
+            def peer(x, y):
+                stores[x].add_peers({y: PeerSpec(y)})
+                stores[y].add_peers({x: PeerSpec(x)})
+
+            peer("r0", "a")
+            peer("a", "b")
+            peer("b", "r9")
+            await wait_until(
+                lambda: all(
+                    stores[n].db("0").dual.get_spt_root_id() == "r0"
+                    for n in ("a", "b")
+                )
+            )
+            # r0 dies: a loses its only path to r0
+            stores["a"].del_peers(["r0"])
+            for name in ("a", "b"):
+                await wait_until(
+                    lambda n=name: stores[n]
+                    .db("0")
+                    .dual.get_spt_root_id()
+                    == "r9",
+                    timeout=10,
+                )
+
+        run(body())
