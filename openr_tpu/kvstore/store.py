@@ -462,14 +462,16 @@ class KvStoreDb(CountersMixin):
                 )
             )
 
-    def get_flood_peers(self) -> List[str]:
+    def get_flood_peers(self, record: bool = True) -> List[str]:
         """SPT peers when flood optimization has a ready tree, else all
-        peers (KvStore.cpp:2819-2839)."""
+        peers (KvStore.cpp:2819-2839). `record=False` for introspection
+        reads (SPT dump) so they don't inflate the flood-ratio counter."""
         if self.dual is not None:
             root_id = self.dual.get_spt_root_id()
             spt_peers = self.dual.get_spt_peers(root_id)
             if spt_peers:
-                self._bump("kvstore.flood_via_spt")
+                if record:
+                    self._bump("kvstore.flood_via_spt")
                 return [p for p in spt_peers if p in self.peers]
         return list(self.peers)
 
@@ -740,7 +742,7 @@ class KvStoreDb(CountersMixin):
                 "children": sorted(dual.children()),
             }
         out["flood_root_id"] = self.dual.get_spt_root_id()
-        out["flood_peers"] = self.get_flood_peers()
+        out["flood_peers"] = self.get_flood_peers(record=False)
         return out
 
 
@@ -780,40 +782,55 @@ class _KvDualNode:
 
     # -- wiring ----------------------------------------------------------
 
+    async def _dual_rpc(self, peer_name: str, counter: str, coro) -> None:
+        """Await a DUAL/flood-topo transport call, surfacing failures as
+        counters + an API_ERROR peer event (the reference's thenError path,
+        KvStore.cpp:1161-1169) instead of dying unobserved in the task."""
+        try:
+            await coro
+            self.db._bump(f"kvstore.thrift.num_{counter}")
+        except Exception:
+            self.db._bump(f"kvstore.thrift.num_{counter}_failure")
+            self.db._peer_event(peer_name, PeerEvent.API_ERROR)
+
     def _send(self, neighbor: str, msgs) -> bool:
-        if neighbor not in self.db.peers:
+        peer = self.db.peers.get(neighbor)
+        if peer is None:
             return False
         self.db._spawn(
-            self.db.transport.dual_messages(
-                self.db.peers[neighbor].spec.peer_addr, self.db.area, msgs
+            self._dual_rpc(
+                neighbor,
+                "dual_msg",
+                self.db.transport.dual_messages(
+                    peer.spec.peer_addr, self.db.area, msgs
+                ),
             )
         )
         return True
 
-    def _nexthop_change(self, root_id, old_nh, new_nh) -> None:
-        if new_nh is not None and new_nh in self.db.peers:
-            self.db._spawn(
+    def _topo_set(self, peer_name: str, root_id: str, set_child: bool) -> None:
+        self.db._spawn(
+            self._dual_rpc(
+                peer_name,
+                "flood_topo_set",
                 self.db.transport.flood_topo_set(
-                    self.db.peers[new_nh].spec.peer_addr,
+                    self.db.peers[peer_name].spec.peer_addr,
                     self.db.area,
                     root_id,
                     self.db.params.node_id,
-                    True,
-                )
+                    set_child,
+                ),
             )
+        )
+
+    def _nexthop_change(self, root_id, old_nh, new_nh) -> None:
+        if new_nh is not None and new_nh in self.db.peers:
+            self._topo_set(new_nh, root_id, True)
             # full sync with the new parent so the SPT edge carries a
             # consistent store (KvStore.cpp:2342-2349)
             self.db._spawn(self.db._full_sync(new_nh))
         if old_nh is not None and old_nh in self.db.peers:
-            self.db._spawn(
-                self.db.transport.flood_topo_set(
-                    self.db.peers[old_nh].spec.peer_addr,
-                    self.db.area,
-                    root_id,
-                    self.db.params.node_id,
-                    False,
-                )
-            )
+            self._topo_set(old_nh, root_id, False)
 
 
 # ---------------------------------------------------------------------------

@@ -9,6 +9,13 @@ This replaces the reference's per-source serial Dijkstra
 """
 
 from openr_tpu.ops.graph import INF, CompiledGraph, compile_graph
-from openr_tpu.ops.spf import batched_spf, ecmp_dag
+from openr_tpu.ops.spf import batched_spf, batched_spf_vw, ecmp_dag
 
-__all__ = ["INF", "CompiledGraph", "compile_graph", "batched_spf", "ecmp_dag"]
+__all__ = [
+    "INF",
+    "CompiledGraph",
+    "compile_graph",
+    "batched_spf",
+    "batched_spf_vw",
+    "ecmp_dag",
+]

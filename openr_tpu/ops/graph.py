@@ -17,12 +17,13 @@ Reference semantics compiled in:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
 
 import numpy as np
 
 from openr_tpu.lsdb.link_state import LinkState
+from openr_tpu.lsdb.link_state import Link
 
 # int32-safe infinity: INF + max edge weight must not overflow int32
 INF = 1 << 29
@@ -49,6 +50,10 @@ class CompiledGraph:
     dst: np.ndarray  # int32 [e_pad], sorted ascending (real entries)
     w: np.ndarray  # int32 [e_pad]
     overloaded: np.ndarray  # bool [n_pad]
+    # Link object -> its two directed-edge positions in the padded arrays
+    # (forward = n1->n2, reverse = n2->n1); lets callers mask individual
+    # links out of a solve (KSP link-ignore semantics, LinkState.cpp:760-789)
+    link_edges: Dict[Link, Tuple[int, int]] = field(default_factory=dict)
 
 
 def compile_graph(link_state: LinkState) -> CompiledGraph:
@@ -62,9 +67,11 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
     srcs: List[int] = []
     dsts: List[int] = []
     ws: List[int] = []
+    up_links: List[Link] = []
     for link in sorted(link_state.all_links):
         if not link.is_up():
             continue
+        up_links.append(link)
         i1, i2 = node_index[link.n1], node_index[link.n2]
         srcs.append(i1)
         dsts.append(i2)
@@ -80,6 +87,7 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
     src = np.zeros(e_pad, dtype=np.int32)
     dst = np.zeros(e_pad, dtype=np.int32)
     w = np.full(e_pad, INF, dtype=np.int32)
+    link_edges: Dict[Link, Tuple[int, int]] = {}
     if e:
         order = np.argsort(np.asarray(dsts, dtype=np.int32), kind="stable")
         src[:e] = np.asarray(srcs, dtype=np.int32)[order]
@@ -88,6 +96,11 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
         # padded edges must not break sorted-segment assumptions: point them
         # at the last real destination
         dst[e:] = dst[e - 1]
+        # pre-sort edge index -> post-sort position
+        pos = np.empty(e, dtype=np.int64)
+        pos[order] = np.arange(e)
+        for i, link in enumerate(up_links):
+            link_edges[link] = (int(pos[2 * i]), int(pos[2 * i + 1]))
 
     overloaded = np.zeros(n_pad, dtype=bool)
     for i, name in enumerate(names):
@@ -104,4 +117,5 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
         dst=dst,
         w=w,
         overloaded=overloaded,
+        link_edges=link_edges,
     )

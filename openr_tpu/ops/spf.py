@@ -34,14 +34,19 @@ from openr_tpu.ops.graph import INF, CompiledGraph
 
 
 @jax.jit
-def _bf_fixpoint(
+def _bf_fixpoint_vw(
     sources: jnp.ndarray,  # int32 [S]
     src_e: jnp.ndarray,  # int32 [E]
     dst_e: jnp.ndarray,  # int32 [E]
-    w_e: jnp.ndarray,  # int32 [E]
+    w_rows: jnp.ndarray,  # int32 [S, E] or [1, E] (broadcast) edge weights
     overloaded: jnp.ndarray,  # bool [N]
 ) -> jnp.ndarray:
-    """Distance matrix D [S, N] for a batch of sources."""
+    """Distance matrix D [S, N]; each batch row may solve with its own
+    edge-weight vector. Per-row weights are the device form of the
+    reference's penalized re-solves: KSP's link-ignore runSpf
+    (LinkState.cpp:760-789, ignore set ≙ INF weights) and
+    multi-metric/multi-topology SPF become extra batch rows of one solve
+    instead of sequential Dijkstra runs."""
     n = overloaded.shape[0]
     s = sources.shape[0]
     node_ids = jnp.arange(n, dtype=jnp.int32)
@@ -56,7 +61,7 @@ def _bf_fixpoint(
     def body(state):
         d, _, it = state
         dt = jnp.where(allow, d, INF)
-        contrib = jnp.minimum(dt[:, src_e] + w_e[None, :], INF)  # [S, E]
+        contrib = jnp.minimum(dt[:, src_e] + w_rows, INF)  # [S, E]
         upd = jax.vmap(
             lambda row: jax.ops.segment_min(
                 row, dst_e, num_segments=n, indices_are_sorted=True
@@ -73,6 +78,18 @@ def _bf_fixpoint(
     return d
 
 
+@jax.jit
+def _bf_fixpoint(
+    sources: jnp.ndarray,  # int32 [S]
+    src_e: jnp.ndarray,  # int32 [E]
+    dst_e: jnp.ndarray,  # int32 [E]
+    w_e: jnp.ndarray,  # int32 [E]
+    overloaded: jnp.ndarray,  # bool [N]
+) -> jnp.ndarray:
+    """Shared-weights solve: one kernel, weights broadcast across the batch."""
+    return _bf_fixpoint_vw(sources, src_e, dst_e, w_e[None, :], overloaded)
+
+
 def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
     """Run the batched solve for the given source node indices."""
     return _bf_fixpoint(
@@ -80,6 +97,19 @@ def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
         jnp.asarray(graph.src),
         jnp.asarray(graph.dst),
         jnp.asarray(graph.w),
+        jnp.asarray(graph.overloaded),
+    )
+
+
+def batched_spf_vw(
+    graph: CompiledGraph, source_rows: np.ndarray, w_rows: np.ndarray
+) -> jnp.ndarray:
+    """Batched solve with per-row weight vectors (shape [S, e_pad])."""
+    return _bf_fixpoint_vw(
+        jnp.asarray(source_rows, dtype=jnp.int32),
+        jnp.asarray(graph.src),
+        jnp.asarray(graph.dst),
+        jnp.asarray(w_rows, dtype=jnp.int32),
         jnp.asarray(graph.overloaded),
     )
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from openr_tpu.lsdb.link_state import Link, LinkState, path_a_in_path_b
+from openr_tpu.lsdb.link_state import Link, LinkState, Path, path_a_in_path_b
 from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.lsdb.prefix_state import PrefixState
 from openr_tpu.solver.metric_vector import (
@@ -118,6 +118,18 @@ class SpfSolver(CountersMixin):
 
     def _dist(self, link_state: LinkState, a: str, b: str) -> Optional[Metric]:
         return link_state.get_metric_from_a_to_b(a, b)
+
+    def _kth_paths(
+        self, link_state: LinkState, src: str, dest: str, k: int
+    ) -> List[Path]:
+        """k-th edge-disjoint shortest path set (LinkState.cpp:760-789)."""
+        return link_state.get_kth_paths(src, dest, k)
+
+    def _prefetch_kth_paths(
+        self, link_state: LinkState, src: str, dests: List[str], k: int
+    ) -> None:
+        """Batching hook: the TPU backend solves all penalized re-runs for
+        `dests` in one device call before the per-dest loop reads them."""
 
     # ------------------------------------------------------------------
     # static routes (plugin seam)
@@ -612,20 +624,23 @@ class SpfSolver(CountersMixin):
         self_node_contained = False
         paths: List[List[Link]] = []
 
+        dests = sorted(n for n in best_path_result.nodes if n != my_node_name)
         for link_state in area_link_states.values():
+            self._prefetch_kth_paths(link_state, my_node_name, dests, 1)
             for node in sorted(best_path_result.nodes):
                 if node == my_node_name:
                     self_node_contained = True
                     continue
-                paths.extend(link_state.get_kth_paths(my_node_name, node, 1))
+                paths.extend(self._kth_paths(link_state, my_node_name, node, 1))
 
             if fwd_algo == PrefixForwardingAlgorithm.KSP2_ED_ECMP:
+                self._prefetch_kth_paths(link_state, my_node_name, dests, 2)
                 first_paths_len = len(paths)
                 for node in sorted(best_path_result.nodes):
                     if node == my_node_name:
                         continue
-                    for sec_path in link_state.get_kth_paths(
-                        my_node_name, node, 2
+                    for sec_path in self._kth_paths(
+                        link_state, my_node_name, node, 2
                     ):
                         # avoid double-spray: drop second paths containing a
                         # first path (anycast full-mesh case)

@@ -15,8 +15,15 @@ Nexthop sets are materialized lazily per queried destination via the triangle
 condition w(me,n) + D[n,t] == D[me,t], which reproduces Dijkstra's
 nexthop-union semantics (LinkState.cpp:855-871) without tracing paths.
 
-KSP2 path enumeration stays on the LinkState host path (get_kth_paths);
-fusing it on device is tracked for the ops layer.
+KSP (k-edge-disjoint shortest paths) is fused on device as well: the
+reference's per-destination penalized Dijkstra re-runs
+(LinkState::getKthPaths link-ignore re-solve, LinkState.cpp:760-789) become
+extra batch rows of one per-row-weights solve (ignored links ≙ INF weights),
+so one device call covers every destination's k-th solve; only the cheap
+greedy edge-disjoint back-trace (traceOnePath, LinkState.cpp:398-419) runs
+host-side, reconstructed from the distance rows with exactly Dijkstra's
+path-link ordering (settle order = (metric, name); links in per-node sorted
+order).
 """
 
 from __future__ import annotations
@@ -25,9 +32,9 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from openr_tpu.lsdb.link_state import LinkState
-from openr_tpu.ops.graph import INF, CompiledGraph, compile_graph
-from openr_tpu.ops.spf import batched_spf
+from openr_tpu.lsdb.link_state import Link, LinkState, Path
+from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket, compile_graph
+from openr_tpu.ops.spf import batched_spf, batched_spf_vw
 from openr_tpu.solver.cpu import Metric, SpfSolver
 
 
@@ -140,6 +147,141 @@ class _AreaSolve:
         self.row_map: Dict[str, int] = {
             name: i for i, name in enumerate(self.sources)
         }
+        # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
+        # lives with the snapshot, so topology changes invalidate it for free
+        self._ksp: Dict[Tuple[str, int], List[Path]] = {}
+        self.ksp_device_batches = 0
+
+    # -- KSP (k-edge-disjoint shortest paths), device-batched ------------
+
+    def kth_paths(self, dest: str, k: int) -> List[Path]:
+        cached = self._ksp.get((dest, k))
+        if cached is None:
+            self.prefetch_ksp([dest], k)
+            cached = self._ksp[(dest, k)]
+        return cached
+
+    def prefetch_ksp(self, dests: List[str], k: int) -> None:
+        """Solve + trace the k-th path set for every dest in one device call.
+
+        The reference runs one full penalized Dijkstra per destination
+        (LinkState.cpp:777-780); here every destination's penalized solve is
+        one batch row of a single per-row-weights fixpoint.
+        """
+        assert k >= 1
+        idx = self.graph.node_index
+        todo = [
+            d
+            for d in dests
+            if (d, k) not in self._ksp and d != self.me and d in idx
+        ]
+        for d in dests:
+            if (d, k) not in self._ksp and (d == self.me or d not in idx):
+                self._ksp[(d, k)] = []
+        if not todo:
+            return
+        if k == 1:
+            # base solve row 0 is me with the unpenalized weights
+            for dest in todo:
+                self._ksp[(dest, 1)] = _trace_paths(
+                    self.link_state, self.graph, self.d[0], self.me, dest, set()
+                )
+            return
+        self.prefetch_ksp(todo, k - 1)
+
+        # per-dest ignore set = links used by path sets 1..k-1
+        ignores: List[Set[Link]] = []
+        for dest in todo:
+            ig: Set[Link] = set()
+            for i in range(1, k):
+                for path in self._ksp[(dest, i)]:
+                    ig.update(path)
+            ignores.append(ig)
+
+        # pad the batch axis to a power-of-two bucket so every anycast group
+        # size in a bucket shares one jitted executable (same convention as
+        # n_pad/e_pad in compile_graph); filler rows re-solve unpenalized
+        s_pad = _next_bucket(len(todo), minimum=1)
+        w_rows = np.tile(self.graph.w, (s_pad, 1))
+        for row, ig in enumerate(ignores):
+            for link in ig:
+                fwd, rev = self.graph.link_edges[link]
+                w_rows[row, fwd] = INF
+                w_rows[row, rev] = INF
+        me_row = idx[self.me]
+        sources = np.full(s_pad, me_row, dtype=np.int32)
+        d_rows = np.asarray(batched_spf_vw(self.graph, sources, w_rows))
+        self.ksp_device_batches += 1
+
+        for row, (dest, ig) in enumerate(zip(todo, ignores)):
+            self._ksp[(dest, k)] = _trace_paths(
+                self.link_state, self.graph, d_rows[row], self.me, dest, ig
+            )
+
+
+def _trace_paths(
+    link_state: LinkState,
+    graph: CompiledGraph,
+    d_row: np.ndarray,
+    src: str,
+    dest: str,
+    ignore: Set[Link],
+) -> List[Path]:
+    """Greedy edge-disjoint path enumeration from a single-source distance
+    row, byte-for-byte equivalent to tracing the Dijkstra SPF DAG
+    (LinkState.cpp:398-419): path links into v are the up, non-ignored links
+    from nodes u with d(u) + w(u→v) == d(v) that offer transit, ordered by
+    u's settle order (= (d(u), u), valid since metrics ≥ 1) then by u's
+    sorted link order."""
+    idx = graph.node_index
+    dd = d_row.tolist()
+    dcol = idx.get(dest)
+    if dcol is None or dd[dcol] >= INF:
+        return []
+
+    path_links: Dict[str, List[Tuple[Link, str]]] = {}
+
+    def pl(v: str) -> List[Tuple[Link, str]]:
+        cached = path_links.get(v)
+        if cached is not None:
+            return cached
+        vi = idx[v]
+        out: List[Tuple[Link, str]] = []
+        for link in link_state.ordered_links_from_node(v):
+            if not link.is_up() or link in ignore:
+                continue
+            u = link.other_node_name(v)
+            ui = idx.get(u)
+            if ui is None or dd[ui] >= INF:
+                continue
+            if u != src and link_state.is_node_overloaded(u):
+                continue
+            if dd[ui] + link.metric_from_node(u) == dd[vi]:
+                out.append((link, u))
+        out.sort(key=lambda t: (dd[idx[t[1]]], t[1], t[0]))
+        path_links[v] = out
+        return out
+
+    visited: Set[Link] = set()
+
+    def trace_one(node: str) -> Optional[Path]:
+        if node == src:
+            return []
+        for link, prev in pl(node):
+            if link not in visited:
+                visited.add(link)
+                sub = trace_one(prev)
+                if sub is not None:
+                    sub.append(link)
+                    return sub
+        return None
+
+    paths: List[Path] = []
+    path = trace_one(dest)
+    while path:
+        paths.append(path)
+        path = trace_one(dest)
+    return paths
 
 
 class TpuSpfSolver(SpfSolver):
@@ -198,3 +340,18 @@ class TpuSpfSolver(SpfSolver):
                 metric = int(solve.d[row, col])
                 return metric if metric < INF else None
         return link_state.get_metric_from_a_to_b(a, b)
+
+    def _kth_paths(
+        self, link_state: LinkState, src: str, dest: str, k: int
+    ) -> List[Path]:
+        solve = self._area_solve(link_state, self.my_node_name)
+        if solve is None or src != self.my_node_name:
+            return link_state.get_kth_paths(src, dest, k)
+        return solve.kth_paths(dest, k)
+
+    def _prefetch_kth_paths(
+        self, link_state: LinkState, src: str, dests: List[str], k: int
+    ) -> None:
+        solve = self._area_solve(link_state, self.my_node_name)
+        if solve is not None and src == self.my_node_name:
+            solve.prefetch_ksp(dests, k)

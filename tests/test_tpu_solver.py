@@ -143,6 +143,78 @@ class TestBatchedSpf:
             all_pairs_distance_check(build_ls(ring_edges(n)))
 
 
+class TestDeviceKsp:
+    """Device-batched k-edge-disjoint shortest paths must reproduce the
+    oracle's getKthPaths exactly (same paths, same order)."""
+
+    def check_all_pairs_ksp(self, edges, me, overloaded=None, ks=(1, 2, 3)):
+        ls_oracle = build_ls(edges, overloaded_nodes=overloaded)
+        ls_dev = build_ls(edges, overloaded_nodes=overloaded)
+        solver = TpuSpfSolver(me)
+        solve = solver._area_solve(ls_dev, me)
+        assert solve is not None
+        dests = sorted(set(ls_oracle.node_names()) - {me})
+        for k in ks:
+            # prefetch path: one device batch for all dests at this k
+            solver._prefetch_kth_paths(ls_dev, me, dests, k)
+            for dest in dests:
+                got = solver._kth_paths(ls_dev, me, dest, k)
+                want = ls_oracle.get_kth_paths(me, dest, k)
+                assert got == want, (me, dest, k, got, want)
+        return solve
+
+    def test_square_ring(self):
+        solve = self.check_all_pairs_ksp(
+            [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("d", "a", 1)], "a"
+        )
+        assert solve.ksp_device_batches >= 1
+
+    def test_diamond_unequal(self):
+        self.check_all_pairs_ksp(
+            [("a", "b", 1), ("a", "c", 2), ("b", "d", 1), ("c", "d", 1)], "a"
+        )
+
+    def test_grid(self):
+        self.check_all_pairs_ksp(grid_edges(4), "g0_0", ks=(1, 2))
+
+    def test_overloaded_transit_node(self):
+        self.check_all_pairs_ksp(
+            [("a", "b", 1), ("b", "c", 1), ("a", "d", 1), ("d", "c", 1)],
+            "a",
+            overloaded={"b"},
+        )
+
+    def test_random_graphs(self):
+        rng = random.Random(99)
+        for trial in range(8):
+            n = rng.randint(4, 12)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = []
+            for i in range(1, n):
+                edges.append(
+                    (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 5))
+                )
+            for _ in range(rng.randint(1, n)):
+                a, b = rng.sample(nodes, 2)
+                if not any({a, b} == {x, y} for x, y, _ in edges):
+                    edges.append((a, b, rng.randint(1, 5)))
+            overloaded = {
+                nodes[i] for i in range(1, n) if rng.random() < 0.2
+            }
+            self.check_all_pairs_ksp(
+                edges, nodes[0], overloaded=overloaded, ks=(1, 2, 3)
+            )
+
+    def test_single_dest_on_demand(self):
+        # no prefetch: _kth_paths alone must still batch-solve lazily
+        ls_oracle = build_ls(grid_edges(3))
+        ls_dev = build_ls(grid_edges(3))
+        solver = TpuSpfSolver("g0_0")
+        got = solver._kth_paths(ls_dev, "g0_0", "g2_2", 2)
+        want = ls_oracle.get_kth_paths("g0_0", "g2_2", 2)
+        assert got == want
+
+
 PFXS = ["10.1.0.0/16", "10.2.0.0/16", "10.3.0.0/16"]
 
 
@@ -243,6 +315,22 @@ class TestRouteDbParity:
             [("a", "b", 1), ("a", "c", 1), ("c", "b", 1)],
             {"b": [PFXS[0]]},
             "a",
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+
+    def test_ksp2_anycast_grid_parity(self):
+        # anycast KSP2 over a grid: multiple dests per prefix exercises the
+        # one-device-call-per-k prefetch batching in _select_ksp2
+        run_parity(
+            grid_edges(4),
+            {
+                "g3_3": [PFXS[0]],
+                "g0_3": [PFXS[0], PFXS[1]],
+                "g2_1": [PFXS[1], PFXS[2]],
+                "g1_2": [PFXS[2]],
+            },
+            "g0_0",
             forwarding_type=PrefixForwardingType.SR_MPLS,
             forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
         )
