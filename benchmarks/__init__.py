@@ -1,0 +1,12 @@
+"""Benchmark suite mirroring the reference's harnesses on TPU.
+
+Ports of the reference benchmark binaries (CMakeLists.txt:782-865):
+  decision_bench  — DecisionBenchmark.cpp grid/fabric per-event harness
+  kvstore_bench   — KvStoreBenchmark.cpp mergeKeyValues/dumpAll harness
+  scale_bench     — BASELINE.md configs 2-5 (10k Clos incremental flap,
+                    100k WAN batched multi-source, 50k ECMP+KSP fused,
+                    multi-metric sharded over the device mesh)
+
+Each module is a script printing one JSON line per measured config to
+stdout (details to stderr), and exposes main(argv) for tests.
+"""

@@ -1,0 +1,112 @@
+"""KvStore benchmark: CRDT merge + full dump throughput.
+
+Port of the reference harness (openr/kvstore/tests/KvStoreBenchmark.cpp:
+289-300): mergeKeyValues over {store size} x {update size} grids, and
+dumpAll over store sizes. Values carry ~100-byte payloads like the
+reference's generated entries.
+
+Env: KVSTORE_MERGE_SIZES ("store:update,..."), KVSTORE_DUMP_SIZES.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List
+
+from benchmarks.common import emit, note
+
+from openr_tpu.kvstore.store import KvStoreFilters, merge_key_values
+from openr_tpu.types import Value
+
+
+def _make_store(n: int, originator: str = "node") -> dict:
+    return {
+        f"prefix:node{i}": Value(
+            version=1,
+            originator_id=f"{originator}{i}",
+            value=(b"v" * 100) + str(i).encode(),
+        )
+        for i in range(n)
+    }
+
+
+def bench_merge(store_size: int, update_size: int, rounds: int = 5) -> None:
+    base = _make_store(store_size)
+    # updates: higher versions over a slice of the keyspace
+    best = float("inf")
+    for r in range(rounds):
+        store = dict(base)
+        update = {
+            f"prefix:node{i}": Value(
+                version=2 + r,
+                originator_id=f"node{i}",
+                value=(b"u" * 100) + str(i).encode(),
+            )
+            for i in range(update_size)
+        }
+        t0 = time.time()
+        accepted = merge_key_values(store, update)
+        dt = time.time() - t0
+        assert len(accepted) == update_size
+        best = min(best, dt)
+    rate = update_size / best
+    note(
+        f"merge store={store_size} update={update_size}: "
+        f"{best*1e3:.2f}ms ({rate:,.0f} keys/s)"
+    )
+    emit(
+        {
+            "metric": f"kvstore_merge_keys_per_sec[{store_size}x{update_size}]",
+            "value": round(rate, 1),
+            "unit": "keys/s",
+            "vs_baseline": 1.0,
+        }
+    )
+
+
+def bench_dump(store_size: int, rounds: int = 5) -> None:
+    store = _make_store(store_size)
+    filters = KvStoreFilters()
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.time()
+        dumped = {
+            k: v for k, v in store.items() if filters.key_match(k, v)
+        }
+        dt = time.time() - t0
+        assert len(dumped) == store_size
+        best = min(best, dt)
+    rate = store_size / best
+    note(f"dumpAll n={store_size}: {best*1e3:.2f}ms ({rate:,.0f} keys/s)")
+    emit(
+        {
+            "metric": f"kvstore_dump_keys_per_sec[{store_size}]",
+            "value": round(rate, 1),
+            "unit": "keys/s",
+            "vs_baseline": 1.0,
+        }
+    )
+
+
+def main(argv: List[str] = ()) -> None:
+    merge_sizes = [
+        tuple(int(v) for v in pair.split(":"))
+        for pair in os.environ.get(
+            "KVSTORE_MERGE_SIZES", "100:10,1000:100,10000:1000"
+        ).split(",")
+        if pair
+    ]
+    dump_sizes = [
+        int(x)
+        for x in os.environ.get("KVSTORE_DUMP_SIZES", "100,1000").split(",")
+        if x
+    ]
+    for store_size, update_size in merge_sizes:
+        bench_merge(store_size, update_size)
+    for n in dump_sizes:
+        bench_dump(n)
+
+
+if __name__ == "__main__":
+    main()

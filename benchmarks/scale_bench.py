@@ -1,0 +1,355 @@
+"""Scale benchmarks: BASELINE.md measurement configs 2-5.
+
+  clos_flap   (config 2) — 3-tier Clos fabric, incremental SPF on a single
+              link-flap event: LinkState ingest -> changelog array patch ->
+              one batched device re-solve (vs CPU oracle event: ingest ->
+              memo invalidation -> Dijkstra re-runs).
+  wan_multi   (config 3) — synthetic WAN graph, batched multi-source SPF
+              throughput on device (vs host Dijkstra samples).
+  wan_ksp     (config 4) — ECMP first-hop mask + KSP penalized re-solves
+              fused on device: base row + K masked-weight rows in one call,
+              first-hop triangle mask computed on device.
+  multi_metric(config 5) — M metric variants (e.g. SR-TE vs IGP weight
+              sets) x sources solved as one sharded batch over the mesh.
+
+Defaults are sized for the BASELINE configs (10k Clos, 100k WAN, 50k KSP);
+env vars scale them down for smoke runs: SCALE_CLOS_PODS, SCALE_WAN_N,
+SCALE_KSP_N, SCALE_SOURCES, SCALE_METRICS.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import time
+from functools import partial
+from typing import List
+
+import numpy as np
+
+from benchmarks.common import compile_edges, emit, note, time_marginal
+
+from openr_tpu.ops.graph import INF
+
+
+# ---------------------------------------------------------------------------
+# config 2: Clos fabric, incremental single-link-flap event
+# ---------------------------------------------------------------------------
+
+
+def bench_clos_flap(pods: int, events: int = 8) -> None:
+    from openr_tpu.lsdb import LinkState
+    from openr_tpu.solver import TpuSpfSolver
+    from openr_tpu.topology import build_adj_dbs, fabric_edges
+
+    edges = fabric_edges(pods)
+    t0 = time.time()
+    ls = LinkState("0")
+    dbs = build_adj_dbs(edges)
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    n = len(dbs)
+    note(f"clos: {n} nodes, {len(edges)} links, built in {time.time()-t0:.1f}s")
+
+    me = "rsw0_0"
+    solver = TpuSpfSolver(me)
+    solve = solver._area_solve(ls, me)
+    assert solve is not None
+
+    # flap fsw0_1<->rsw0_1 metric between 1 and 5 via adj-db updates
+    variants = []
+    for metric in (5, 1):
+        ev = [
+            (a, b, metric if {a, b} == {"fsw0_1", "rsw0_1"} else w)
+            for a, b, w in edges
+        ]
+        variants.append(build_adj_dbs(ev)["fsw0_1"])
+    # warm both variants (jit both paths)
+    for v in variants:
+        ls.update_adjacency_database(v)
+        solver._area_solve(ls, me)
+
+    t0 = time.time()
+    for i in range(events):
+        ls.update_adjacency_database(variants[i % 2])
+        solver._area_solve(ls, me)  # incremental refresh + device solve
+    per_event = (time.time() - t0) / events
+
+    # CPU oracle event: same ingest + fresh Dijkstra from me
+    t0 = time.time()
+    for i in range(events):
+        ls.update_adjacency_database(variants[i % 2])
+        ls.get_spf_result(me)
+    cpu_event = (time.time() - t0) / events
+
+    note(
+        f"clos{n} flap event: tpu {per_event*1e3:.2f}ms "
+        f"cpu {cpu_event*1e3:.2f}ms"
+    )
+    emit(
+        {
+            "metric": f"clos{n}_flap_event_ms",
+            "value": round(per_event * 1e3, 3),
+            "unit": "ms/event (ingest+incremental SPF)",
+            "vs_baseline": round(cpu_event / per_event, 2),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 3: WAN batched multi-source throughput
+# ---------------------------------------------------------------------------
+
+
+def _host_dijkstra(src_i, dst_i, w_i, n, source) -> np.ndarray:
+    """Reference-architecture baseline: binary-heap Dijkstra on the host."""
+    adj: List[List] = [[] for _ in range(n)]
+    for s, d, w in zip(src_i, dst_i, w_i):
+        if w < INF:
+            adj[s].append((d, w))
+    dist = np.full(n, INF, dtype=np.int64)
+    dist[source] = 0
+    heap = [(0, source)]
+    while heap:
+        dm, u = heapq.heappop(heap)
+        if dm != dist[u]:
+            continue
+        for v, w in adj[u]:
+            nd = dm + w
+            if nd < dist[v]:
+                dist[v] = nd
+                heapq.heappush(heap, (nd, v))
+    return dist
+
+
+def bench_wan_multi(n: int, n_sources: int, cpu_samples: int = 4) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import _bf_fixpoint
+    from openr_tpu.topology import wan_edges
+
+    t0 = time.time()
+    edges = wan_edges(n, degree=4, seed=3)
+    src, dst, w, overloaded, node_index = compile_edges(edges)
+    note(
+        f"wan: n={n} e={2*len(edges)} built in {time.time()-t0:.1f}s "
+        f"(padded {len(overloaded)}/{len(src)})"
+    )
+
+    rng = np.random.default_rng(7)
+    sources = jnp.asarray(
+        rng.choice(n, size=n_sources, replace=False).astype(np.int32)
+    )
+    src_d = jnp.asarray(src)
+    dst_d = jnp.asarray(dst)
+    w_d = jnp.asarray(w)
+    ov_d = jnp.asarray(overloaded)
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def chained(reps):
+        def body(carry, k):
+            d = _bf_fixpoint(sources, src_d, dst_d, w_d + k, ov_d)
+            return carry ^ d[0, -1], None
+
+        acc, _ = jax.lax.scan(
+            body, jnp.int32(0), jnp.zeros(reps, dtype=jnp.int32)
+        )
+        return acc
+
+    marginal = time_marginal(lambda r: int(chained(r)), 1, 4)
+    rate = n_sources / marginal
+    note(
+        f"wan{n}: {n_sources}-source batch in {marginal*1e3:.1f}ms "
+        f"-> {rate:,.0f} SPF/s"
+    )
+
+    # correctness spot-check + host baseline
+    d = np.asarray(_bf_fixpoint(sources, src_d, dst_d, w_d, ov_d))
+    t0 = time.time()
+    for i in range(cpu_samples):
+        ref = _host_dijkstra(src, dst, w, len(overloaded), int(sources[i]))
+        np.testing.assert_array_equal(
+            np.minimum(d[i], INF), np.minimum(ref, INF)
+        )
+    cpu_rate = cpu_samples / (time.time() - t0)
+    note(f"wan{n}: host Dijkstra {cpu_rate:.1f} SPF/s")
+    emit(
+        {
+            "metric": f"wan{n}_spf_per_sec",
+            "value": round(rate, 1),
+            "unit": f"SPF/s ({n_sources}-source batches)",
+            "vs_baseline": round(rate / cpu_rate, 1),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 4: ECMP first-hop mask + KSP penalized re-solves fused on device
+# ---------------------------------------------------------------------------
+
+
+def bench_wan_ksp(n: int, k_dests: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import _bf_fixpoint_vw
+    from openr_tpu.topology import wan_edges
+
+    edges = wan_edges(n, degree=4, seed=5)
+    src, dst, w, overloaded, node_index = compile_edges(edges)
+    e_pad = len(src)
+    note(f"ksp wan: n={n} e_pad={e_pad}")
+
+    me = 0
+    rng = np.random.default_rng(11)
+    # my up-edges; their far ends are the neighbor rows for the first-hop mask
+    mine = np.nonzero((src == me) & (w < INF))[0]
+    neighbors = dst[mine]
+    deg = len(neighbors)
+
+    # batch = [me] + neighbors (base weights) + K penalized me rows, each
+    # masking a few edges (the links of a previously traced path set) to INF
+    s = 1 + deg + k_dests
+    sources = np.concatenate(
+        [
+            np.array([me], dtype=np.int32),
+            neighbors.astype(np.int32),
+            np.full(k_dests, me, dtype=np.int32),
+        ]
+    )
+    w_rows = np.tile(w, (s, 1))
+    for row in range(1 + deg, s):
+        masked = rng.choice(e_pad, size=8, replace=False)
+        w_rows[row, masked] = INF
+
+    my_w = jnp.asarray(w[mine])
+    sources_d = jnp.asarray(sources)
+    src_d = jnp.asarray(src)
+    dst_d = jnp.asarray(dst)
+    w_rows_d = jnp.asarray(w_rows)
+    ov_d = jnp.asarray(overloaded)
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def chained(reps):
+        def body(carry, k):
+            d = _bf_fixpoint_vw(sources_d, src_d, dst_d, w_rows_d + k, ov_d)
+            # ECMP first-hop mask fused: edge (me -> v) is a first hop for
+            # dest t iff w(me,v) + D[v, t] == D[me, t]
+            fh = (my_w[:, None] + d[1 : 1 + deg, :] == d[0][None, :]).sum()
+            return carry ^ d[0, -1] ^ fh.astype(jnp.int32), None
+
+        acc, _ = jax.lax.scan(
+            body, jnp.int32(0), jnp.zeros(reps, dtype=jnp.int32)
+        )
+        return acc
+
+    marginal = time_marginal(lambda r: int(chained(r)), 1, 4)
+    note(
+        f"ksp wan{n}: base + {k_dests} penalized solves + first-hop mask "
+        f"in {marginal*1e3:.1f}ms"
+    )
+    emit(
+        {
+            "metric": f"wan{n}_ksp_fused_ms",
+            "value": round(marginal * 1e3, 2),
+            "unit": f"ms/event ({k_dests} penalized re-solves fused)",
+            "vs_baseline": float(k_dests + 1),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# config 5: multi-metric/multi-topology solve sharded over the mesh
+# ---------------------------------------------------------------------------
+
+
+def bench_multi_metric(n: int, n_metrics: int, n_sources: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from openr_tpu.ops.spf import _bf_fixpoint_vw
+    from openr_tpu.parallel import make_mesh
+    from openr_tpu.topology import wan_edges
+
+    edges = wan_edges(n, degree=4, seed=9)
+    src, dst, w, overloaded, node_index = compile_edges(edges)
+
+    devices = jax.devices()
+    mesh = make_mesh(devices, shape=(len(devices), 1))
+    note(f"multi-metric: mesh {dict(mesh.shape)} on {devices[0].platform}")
+
+    rng = np.random.default_rng(13)
+    s = n_metrics * n_sources
+    # round the batch up to the mesh axis
+    batch = mesh.shape["batch"]
+    s_pad = ((s + batch - 1) // batch) * batch
+    sources = np.tile(
+        rng.choice(n, size=n_sources, replace=False).astype(np.int32),
+        n_metrics,
+    )
+    sources = np.concatenate(
+        [sources, np.zeros(s_pad - s, dtype=np.int32)]
+    )
+    # metric variants: scaled/perturbed copies of the base weights (distinct
+    # routing topologies, e.g. IGP vs latency-optimized SR-TE planes)
+    w_rows = np.empty((s_pad, len(w)), dtype=np.int32)
+    finite = w < INF
+    for mi in range(n_metrics):
+        variant = w.copy()
+        variant[finite] = w[finite] * (mi + 1) + mi
+        w_rows[mi * n_sources : (mi + 1) * n_sources] = variant
+    w_rows[s:] = w
+
+    row_sharded = NamedSharding(mesh, P("batch"))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(
+        _bf_fixpoint_vw,
+        # (sources, src_e, dst_e, w_rows, overloaded)
+        in_shardings=(row_sharded, repl, repl, row_sharded, repl),
+        out_shardings=NamedSharding(mesh, P("batch", None)),
+    )
+    args = (
+        jax.device_put(jnp.asarray(sources), row_sharded),
+        jax.device_put(jnp.asarray(src), repl),
+        jax.device_put(jnp.asarray(dst), repl),
+        jax.device_put(jnp.asarray(w_rows), row_sharded),
+        jax.device_put(jnp.asarray(overloaded), repl),
+    )
+
+    def run(reps):
+        for _ in range(reps):
+            fn(*args).block_until_ready()
+
+    marginal = time_marginal(run, 1, 3)
+    rate = s / marginal
+    note(
+        f"multi-metric wan{n}: {n_metrics} metrics x {n_sources} sources "
+        f"in {marginal*1e3:.1f}ms -> {rate:,.0f} solves/s"
+    )
+    emit(
+        {
+            "metric": f"wan{n}_multimetric_solves_per_sec",
+            "value": round(rate, 1),
+            "unit": f"SPF/s ({n_metrics} metric planes sharded)",
+            "vs_baseline": float(len(devices)),
+        }
+    )
+
+
+def main(argv: List[str] = ()) -> None:
+    clos_pods = int(os.environ.get("SCALE_CLOS_PODS", "170"))
+    wan_n = int(os.environ.get("SCALE_WAN_N", "100000"))
+    ksp_n = int(os.environ.get("SCALE_KSP_N", "50000"))
+    n_sources = int(os.environ.get("SCALE_SOURCES", "128"))
+    n_metrics = int(os.environ.get("SCALE_METRICS", "4"))
+
+    bench_clos_flap(clos_pods)
+    bench_wan_multi(wan_n, n_sources)
+    bench_wan_ksp(ksp_n, k_dests=15)
+    bench_multi_metric(min(wan_n, 8192), n_metrics, max(8, n_sources // 4))
+
+
+if __name__ == "__main__":
+    main()

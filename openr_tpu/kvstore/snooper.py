@@ -1,0 +1,102 @@
+"""KvStore snooper: live view of LSDB churn on a running node.
+
+Port of the reference tool (openr/kvstore/tools/KvStoreSnooper.cpp):
+connects to a node's ctrl server, subscribes to the filtered KvStore
+stream, and prints each delta — decoded adjacency / prefix databases for
+`adj:`/`prefix:` keys, raw version bumps for everything else.
+
+Usage:  python -m openr_tpu.kvstore.snooper [--host H] [--port P]
+                [--area A] [--prefix adj: --prefix prefix:]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, Optional
+
+from openr_tpu.ctrl.client import BlockingCtrlClient
+from openr_tpu.utils.serializer import loads as deserialize
+
+
+def _describe(key: str, value: Dict) -> str:
+    version = value.get("version")
+    originator = value.get("originator_id")
+    head = f"{key} v={version} from={originator} ttl={value.get('ttl')}"
+    blob = value.get("value")
+    if blob is None:
+        return head + " (ttl refresh)"
+    try:
+        import base64
+
+        obj = deserialize(base64.b64decode(blob))
+    except Exception:
+        return head + f" ({len(blob)}B opaque)"
+    if key.startswith("adj:"):
+        adjs = getattr(obj, "adjacencies", None)
+        if adjs is not None:
+            neighbors = ", ".join(
+                f"{a.other_node_name}/{a.if_name}:{a.metric}" for a in adjs
+            )
+            overloaded = " OVERLOADED" if obj.is_overloaded else ""
+            return f"{head}{overloaded} adjs=[{neighbors}]"
+    if key.startswith("prefix:"):
+        entries = getattr(obj, "prefix_entries", None)
+        if entries is not None:
+            pfx = ", ".join(str(e.prefix) for e in entries)
+            return f"{head} prefixes=[{pfx}]"
+    return head + f" ({type(obj).__name__})"
+
+
+def snoop(
+    host: str,
+    port: int,
+    area: str = "0",
+    prefixes: Optional[Iterable[str]] = None,
+    out=sys.stdout,
+    max_frames: Optional[int] = None,
+) -> int:
+    """Stream publications and print them; returns frames consumed."""
+    client = BlockingCtrlClient(host, port)
+    frames = 0
+    try:
+        for pub in client.subscribe(
+            "subscribeKvStoreFilter",
+            area=area,
+            prefixes=list(prefixes or []),
+        ):
+            tag = "SNAPSHOT" if frames == 0 else "DELTA"
+            for key, value in sorted(pub.get("key_vals", {}).items()):
+                print(f"[{tag}] {_describe(key, value)}", file=out)
+            for key in pub.get("expired_keys", []):
+                print(f"[{tag}] {key} EXPIRED", file=out)
+            out.flush()
+            frames += 1
+            if max_frames is not None and frames >= max_frames:
+                break
+    finally:
+        client.close()
+    return frames
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2018)
+    p.add_argument("--area", default="0")
+    p.add_argument(
+        "--prefix",
+        action="append",
+        dest="prefixes",
+        help="key prefix filter (repeatable), e.g. adj: or prefix:",
+    )
+    args = p.parse_args(argv)
+    try:
+        snoop(args.host, args.port, args.area, args.prefixes)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

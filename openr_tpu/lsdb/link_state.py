@@ -337,6 +337,13 @@ class LinkState:
         self._spf_results: Dict[Tuple[str, bool], SpfResult] = {}
         # memoization: (src, dest, k) -> [Path]
         self._kth_path_results: Dict[Tuple[str, str, int], List[Path]] = {}
+        # graph changelog for incremental compiled-graph refresh: entries are
+        # ("link", Link) weight/up-down change, ("node", name) node-overload
+        # change, ("structure", None) link/node add/remove. Consumers remember
+        # their read position (graph_log_pos); on overflow old entries are
+        # dropped and stale consumers rebuild from scratch
+        self._graph_log: List[Tuple[str, object]] = []
+        self._graph_log_base = 0
         # counters (fb303 equivalents)
         self.spf_runs = 0
         # monotonically bumped on every topology change; lets external
@@ -405,13 +412,18 @@ class LinkState:
 
         prior = self._adjacency_databases.get(node)
         self._adjacency_databases[node] = new_adj_db
+        if prior is None:
+            self._log_graph("structure")  # node-name set may change
 
         old_links = self.ordered_links_from_node(node)
         new_links = sorted(self._make_bidirectional_links(new_adj_db))
 
-        change.topology_changed |= self._update_node_overloaded(
+        overload_changed = self._update_node_overloaded(
             node, new_adj_db.is_overloaded, hold_up_ttl, hold_down_ttl
         )
+        if overload_changed:
+            self._log_graph("node", node)
+        change.topology_changed |= overload_changed
         change.node_label_changed = (
             prior is None and new_adj_db.node_label != 0
         ) or (prior is not None and prior.node_label != new_adj_db.node_label)
@@ -425,6 +437,7 @@ class LinkState:
                 link.set_hold_up_ttl(hold_up_ttl)
                 change.topology_changed |= link.is_up()
                 self._add_link(link)
+                self._log_graph("structure")
                 i += 1
                 continue
             if j < len(old_links) and (
@@ -433,6 +446,7 @@ class LinkState:
                 link = old_links[j]
                 change.topology_changed |= link.is_up()
                 self._remove_link(link)
+                self._log_graph("structure")
                 j += 1
                 continue
             # same link on both sides: diff attributes in place
@@ -440,21 +454,25 @@ class LinkState:
             if new_link.metric_from_node(node) != old_link.metric_from_node(
                 node
             ):
-                change.topology_changed |= old_link.set_metric_from_node(
+                if old_link.set_metric_from_node(
                     node,
                     new_link.metric_from_node(node),
                     hold_up_ttl,
                     hold_down_ttl,
-                )
+                ):
+                    change.topology_changed = True
+                    self._log_graph("link", old_link)
             if new_link.overload_from_node(node) != old_link.overload_from_node(
                 node
             ):
-                change.topology_changed |= old_link.set_overload_from_node(
+                if old_link.set_overload_from_node(
                     node,
                     new_link.overload_from_node(node),
                     hold_up_ttl,
                     hold_down_ttl,
-                )
+                ):
+                    change.topology_changed = True
+                    self._log_graph("link", old_link)
             if new_link.adj_label_from_node(node) != old_link.adj_label_from_node(
                 node
             ):
@@ -484,6 +502,7 @@ class LinkState:
         if node in self._adjacency_databases:
             self._remove_node(node)
             del self._adjacency_databases[node]
+            self._log_graph("structure")
             self._invalidate()
             change.topology_changed = True
         return change
@@ -491,9 +510,13 @@ class LinkState:
     def decrement_holds(self) -> LinkStateChange:
         change = LinkStateChange()
         for link in self._all_links:
-            change.topology_changed |= link.decrement_holds()
-        for hv in self._node_overloads.values():
-            change.topology_changed |= hv.decrement_ttl()
+            if link.decrement_holds():
+                change.topology_changed = True
+                self._log_graph("link", link)
+        for node, hv in self._node_overloads.items():
+            if hv.decrement_ttl():
+                change.topology_changed = True
+                self._log_graph("node", node)
         if change.topology_changed:
             self._invalidate()
         return change
@@ -630,6 +653,30 @@ class LinkState:
                     sub.append(link)
                     return sub
         return None
+
+    # -- graph changelog (incremental compiled-graph refresh) --------------
+
+    _GRAPH_LOG_CAP = 4096
+
+    @property
+    def graph_log_pos(self) -> int:
+        """Absolute position of the changelog tail; snapshot at compile."""
+        return self._graph_log_base + len(self._graph_log)
+
+    def graph_changes_since(
+        self, pos: int
+    ) -> Optional[List[Tuple[str, object]]]:
+        """Changelog entries since `pos`, or None when they were dropped
+        (consumer too stale: rebuild from scratch)."""
+        if pos < self._graph_log_base:
+            return None
+        return self._graph_log[pos - self._graph_log_base :]
+
+    def _log_graph(self, kind: str, obj: object = None) -> None:
+        if len(self._graph_log) >= self._GRAPH_LOG_CAP:
+            self._graph_log_base += len(self._graph_log)
+            self._graph_log = []
+        self._graph_log.append((kind, obj))
 
     # -- internals ---------------------------------------------------------
 

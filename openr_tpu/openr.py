@@ -62,6 +62,7 @@ class OpenrDaemon:
         self.interface_updates_queue = ReplicateQueue()
         self.neighbor_updates_queue = ReplicateQueue()
         self.prefix_updates_queue = ReplicateQueue()
+        self.static_routes_queue = ReplicateQueue()
         self.log_sample_queue = ReplicateQueue()
 
         # --- config store ---------------------------------------------
@@ -222,6 +223,7 @@ class OpenrDaemon:
             ),
             self.kvstore.updates_queue.get_reader(),
             self.route_updates_queue,
+            static_routes_updates=self.static_routes_queue.get_reader(),
             loop=loop,
         )
 
@@ -291,6 +293,24 @@ class OpenrDaemon:
         self.decision.start()
         self.fib.start()
         port = await self.ctrl_server.start()
+        if self.config.config.enable_bgp_peering:
+            # extension seam (Main.cpp:589-595, plugin/Plugin.h:24-34);
+            # only build PluginArgs (and register its queue reader) when a
+            # plugin is actually installed — an undrained reader would
+            # accumulate every route update forever
+            from openr_tpu.plugin import PluginArgs, has_plugin, plugin_start
+
+            if has_plugin():
+                plugin_start(
+                    PluginArgs(
+                        prefix_updates_queue=self.prefix_updates_queue,
+                        static_routes_queue=self.static_routes_queue,
+                        route_updates_reader=(
+                            self.route_updates_queue.get_reader()
+                        ),
+                        config=self.config,
+                    )
+                )
         log.info(
             "openr-tpu daemon %s up, ctrl on :%d",
             self.config.node_name,
@@ -300,6 +320,10 @@ class OpenrDaemon:
 
     async def stop(self) -> None:
         """Reverse-order shutdown with queue closing (Main.cpp:597-654)."""
+        if self.config.config.enable_bgp_peering:
+            from openr_tpu.plugin import plugin_stop
+
+            plugin_stop()
         await self.ctrl_server.stop()
         self.fib.stop()
         self.decision.stop()
@@ -319,6 +343,7 @@ class OpenrDaemon:
             self.interface_updates_queue,
             self.neighbor_updates_queue,
             self.prefix_updates_queue,
+            self.static_routes_queue,
             self.log_sample_queue,
         ):
             q.close()

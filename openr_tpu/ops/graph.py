@@ -38,22 +38,30 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
 
 @dataclass
 class CompiledGraph:
-    """Static-shaped arrays for one LinkState snapshot."""
+    """Static-shaped arrays for one LinkState snapshot.
+
+    Down links are present in the arrays with INF weight (they never relax),
+    so link flaps and metric changes are pure weight patches — the arrays
+    keep their shape and identity and the jitted solver never recompiles.
+    """
 
     names: List[str]  # index -> node name (real nodes only)
     node_index: Dict[str, int]
     n: int  # real node count
-    e: int  # real directed edge count
+    e: int  # real directed edge count (up and down links)
     n_pad: int
     e_pad: int
     src: np.ndarray  # int32 [e_pad], padded entries point at 0 with INF w
     dst: np.ndarray  # int32 [e_pad], sorted ascending (real entries)
-    w: np.ndarray  # int32 [e_pad]
+    w: np.ndarray  # int32 [e_pad]; INF for down links and padding
     overloaded: np.ndarray  # bool [n_pad]
     # Link object -> its two directed-edge positions in the padded arrays
     # (forward = n1->n2, reverse = n2->n1); lets callers mask individual
     # links out of a solve (KSP link-ignore semantics, LinkState.cpp:760-789)
     link_edges: Dict[Link, Tuple[int, int]] = field(default_factory=dict)
+    # snapshot markers for incremental refresh (refresh_graph)
+    version: int = -1  # LinkState.version at compile time
+    log_pos: int = 0  # LinkState.graph_log_pos at compile time
 
 
 def compile_graph(link_state: LinkState) -> CompiledGraph:
@@ -67,18 +75,20 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
     srcs: List[int] = []
     dsts: List[int] = []
     ws: List[int] = []
-    up_links: List[Link] = []
+    links: List[Link] = []
     for link in sorted(link_state.all_links):
-        if not link.is_up():
-            continue
-        up_links.append(link)
+        # down links stay in the arrays at INF weight (LinkState.cpp:844
+        # semantics — they never relax) so a flap is a weight patch, not a
+        # structural rebuild
+        up = link.is_up()
+        links.append(link)
         i1, i2 = node_index[link.n1], node_index[link.n2]
         srcs.append(i1)
         dsts.append(i2)
-        ws.append(link.metric_from_node(link.n1))
+        ws.append(link.metric_from_node(link.n1) if up else INF)
         srcs.append(i2)
         dsts.append(i1)
-        ws.append(link.metric_from_node(link.n2))
+        ws.append(link.metric_from_node(link.n2) if up else INF)
     e = len(srcs)
 
     n_pad = _next_bucket(max(n, 1))
@@ -99,7 +109,7 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
         # pre-sort edge index -> post-sort position
         pos = np.empty(e, dtype=np.int64)
         pos[order] = np.arange(e)
-        for i, link in enumerate(up_links):
+        for i, link in enumerate(links):
             link_edges[link] = (int(pos[2 * i]), int(pos[2 * i + 1]))
 
     overloaded = np.zeros(n_pad, dtype=bool)
@@ -118,4 +128,54 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
         w=w,
         overloaded=overloaded,
         link_edges=link_edges,
+        version=link_state.version,
+        log_pos=link_state.graph_log_pos,
+    )
+
+
+def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
+    """Bring a compiled snapshot up to date with its LinkState.
+
+    Replays the LinkState graph changelog since the snapshot: pure
+    weight/overload changes (link flap, metric change, drain) patch copies of
+    the w/overloaded arrays in place — same shapes, no recompilation and no
+    O(E) Python rebuild; structural changes (link/node add/remove) or a
+    dropped changelog fall back to a full compile_graph. This is the
+    single-link-flap incremental event path (BASELINE.md config 2)."""
+    if graph.version == link_state.version:
+        return graph
+    changes = link_state.graph_changes_since(graph.log_pos)
+    if changes is None or any(kind == "structure" for kind, _ in changes):
+        return compile_graph(link_state)
+
+    w = graph.w.copy()
+    overloaded = graph.overloaded.copy()
+    for kind, obj in changes:
+        if kind == "link":
+            pos = graph.link_edges.get(obj)
+            if pos is None:  # changelog raced a structural entry we missed
+                return compile_graph(link_state)
+            up = obj.is_up()
+            w[pos[0]] = obj.metric_from_node(obj.n1) if up else INF
+            w[pos[1]] = obj.metric_from_node(obj.n2) if up else INF
+        else:  # "node"
+            i = graph.node_index.get(obj)
+            if i is None:
+                return compile_graph(link_state)
+            overloaded[i] = link_state.is_node_overloaded(obj)
+
+    return CompiledGraph(
+        names=graph.names,
+        node_index=graph.node_index,
+        n=graph.n,
+        e=graph.e,
+        n_pad=graph.n_pad,
+        e_pad=graph.e_pad,
+        src=graph.src,
+        dst=graph.dst,
+        w=w,
+        overloaded=overloaded,
+        link_edges=graph.link_edges,
+        version=link_state.version,
+        log_pos=link_state.graph_log_pos,
     )

@@ -1,0 +1,65 @@
+"""Plugin extension seam (reference: openr/plugin/Plugin.h:24-34).
+
+An external integration (the reference's use case is a BGP speaker; the
+rebuild's is also the slot where an alternative route-computation backend
+can inject static routes) receives the daemon's queues and config:
+
+  - `prefix_updates_queue`   — push PrefixEvent batches to originate
+                               prefixes through PrefixManager
+  - `static_routes_queue`    — push StaticRoutesUpdate deltas straight into
+                               Decision (MPLS label -> nexthops), bypassing
+                               SPF (Decision.cpp:868-907 semantics)
+  - `route_updates_reader`   — RQueue reader of computed DecisionRouteUpdate
+                               deltas (to re-advertise into BGP etc.)
+  - `config`                 — the running Config
+
+`plugin_start`/`plugin_stop` are process-wide hooks, default no-op
+(Plugin.cpp:11-19); a deployment replaces them via `set_plugin`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from openr_tpu.messaging import RQueue, ReplicateQueue
+
+
+@dataclass
+class PluginArgs:
+    prefix_updates_queue: ReplicateQueue
+    static_routes_queue: ReplicateQueue
+    route_updates_reader: RQueue
+    config: object
+
+
+_start_hook: Optional[Callable[[PluginArgs], None]] = None
+_stop_hook: Optional[Callable[[], None]] = None
+
+
+def set_plugin(
+    start: Callable[[PluginArgs], None],
+    stop: Optional[Callable[[], None]] = None,
+) -> None:
+    """Install a plugin implementation (before the daemon starts)."""
+    global _start_hook, _stop_hook
+    _start_hook = start
+    _stop_hook = stop
+
+
+def has_plugin() -> bool:
+    """Whether a plugin is installed; the daemon skips building PluginArgs
+    (which registers a route-updates queue reader that must be drained)
+    when nothing would consume them."""
+    return _start_hook is not None
+
+
+def plugin_start(args: PluginArgs) -> None:
+    """Invoked by the daemon when BGP peering is enabled (Main.cpp:589-595)."""
+    if _start_hook is not None:
+        _start_hook(args)
+
+
+def plugin_stop() -> None:
+    if _stop_hook is not None:
+        _stop_hook()

@@ -33,7 +33,13 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from openr_tpu.lsdb.link_state import Link, LinkState, Path
-from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket, compile_graph
+from openr_tpu.ops.graph import (
+    INF,
+    CompiledGraph,
+    _next_bucket,
+    compile_graph,
+    refresh_graph,
+)
 from openr_tpu.ops.spf import batched_spf, batched_spf_vw
 from openr_tpu.solver.cpu import Metric, SpfSolver
 
@@ -100,57 +106,112 @@ class _TpuSpfResult:
         if cached is not None:
             return cached
         area = self._area
-        me = self._source
         nhs: Set[str] = set()
-        if dest != me:
+        if dest != self._source:
             col = area.graph.node_index.get(dest)
             if col is not None:
-                d_me = area.d[self._src_row, col]
-                if d_me < INF:
-                    ls = area.link_state
-                    for link in ls.ordered_links_from_node(me):
-                        if not link.is_up():
-                            continue
-                        n = link.other_node_name(me)
-                        n_row = area.row_map.get(n)
-                        if n_row is None:
-                            continue
-                        if ls.is_node_overloaded(n) and n != dest:
-                            continue
-                        w = link.metric_from_node(me)
-                        if w + area.d[n_row, col] == d_me:
-                            nhs.add(n)
+                names, mask = area.nh_mask()
+                nhs = {n for n, hit in zip(names, mask[:, col]) if hit}
         self._nh_cache[dest] = nhs
         return nhs
 
 
 class _AreaSolve:
-    """One batched device solve: sources = [me] + up-neighbors(me)."""
+    """One batched device solve: sources = [me] + up-neighbors(me).
+
+    Incremental event path: on topology change, `refresh()` patches the
+    compiled arrays via the LinkState changelog (weight-only changes keep
+    shapes and jit executables) and re-runs the device solve. The source
+    batch is bucket-padded so a changed neighbor count stays in the same
+    executable too."""
 
     def __init__(self, link_state: LinkState, me: str) -> None:
         self.link_state = link_state
         self.me = me
         self.graph: CompiledGraph = compile_graph(link_state)
+        self.device_solves = 0
+        self.ksp_device_batches = 0
+        self._solve()
+
+    def _solve(self) -> None:
+        me = self.me
         neighbors = sorted(
             {
                 link.other_node_name(me)
-                for link in link_state.links_from_node(me)
+                for link in self.link_state.links_from_node(me)
                 if link.is_up()
             }
         )
         self.sources: List[str] = [me] + neighbors
-        rows = np.array(
-            [self.graph.node_index[s] for s in self.sources], dtype=np.int32
-        )
-        # one device call for the whole batch; copy back once
-        self.d = np.asarray(batched_spf(self.graph, rows))
         self.row_map: Dict[str, int] = {
             name: i for i, name in enumerate(self.sources)
         }
+        rows = np.array(
+            [self.graph.node_index[s] for s in self.sources], dtype=np.int32
+        )
+        s_pad = _next_bucket(len(rows), minimum=8)
+        rows = np.concatenate(
+            [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
+        )
+        # one device call for the whole batch; copy back once
+        self.d = np.asarray(batched_spf(self.graph, rows))
+        self.device_solves += 1
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
-        # lives with the snapshot, so topology changes invalidate it for free
+        # reset with the snapshot, so topology changes invalidate it for free
         self._ksp: Dict[Tuple[str, int], List[Path]] = {}
-        self.ksp_device_batches = 0
+        self._nh_links: Optional[List[str]] = None
+        self._nh_mask: Optional[np.ndarray] = None
+
+    def nh_mask(self) -> Tuple[List[str], np.ndarray]:
+        """(neighbor names, [L, n_pad] bool): entry [i, t] is True iff the
+        i-th up-link from me is an ECMP first hop toward node t.
+
+        One vectorized triangle-condition broadcast over the solved rows
+        (w(me,v) + D[v, t] == D[me, t], LinkState.cpp:855-871 semantics,
+        with overloaded neighbors valid only as final destinations) replaces
+        the per-destination link loop."""
+        if self._nh_mask is None:
+            ls = self.link_state
+            names: List[str] = []
+            rows: List[int] = []
+            ws: List[int] = []
+            ov: List[bool] = []
+            for link in ls.ordered_links_from_node(self.me):
+                if not link.is_up():
+                    continue
+                n = link.other_node_name(self.me)
+                r = self.row_map.get(n)
+                if r is None:
+                    continue
+                names.append(n)
+                rows.append(r)
+                ws.append(link.metric_from_node(self.me))
+                ov.append(ls.is_node_overloaded(n))
+            if not names:
+                self._nh_links = []
+                self._nh_mask = np.zeros(
+                    (0, self.graph.n_pad), dtype=bool
+                )
+                return self._nh_links, self._nh_mask
+            w_col = np.asarray(ws, dtype=np.int32)[:, None]
+            mask = (w_col + self.d[rows]) == self.d[0][None, :]
+            # an overloaded neighbor relays nothing: valid only when it is
+            # itself the destination
+            for i, (n, is_ov) in enumerate(zip(names, ov)):
+                if is_ov:
+                    only = np.zeros(self.graph.n_pad, dtype=bool)
+                    only[self.graph.node_index[n]] = True
+                    mask[i] &= only
+            self._nh_links = names
+            self._nh_mask = mask
+        return self._nh_links, self._nh_mask
+
+    def refresh(self) -> None:
+        """Re-solve against the current LinkState snapshot if it moved."""
+        if self.graph.version == self.link_state.version:
+            return
+        self.graph = refresh_graph(self.graph, self.link_state)
+        self._solve()
 
     # -- KSP (k-edge-disjoint shortest paths), device-batched ------------
 
@@ -289,12 +350,11 @@ class TpuSpfSolver(SpfSolver):
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        # (area name, node) -> (LinkState identity, topology version, solve);
-        # keyed by the stable area name so a replaced LinkState object for the
-        # same area overwrites its predecessor instead of leaking it
-        self._solves: Dict[
-            Tuple[str, str], Tuple[int, int, _AreaSolve]
-        ] = {}
+        # (area name, node) -> (LinkState identity, solve); keyed by the
+        # stable area name so a replaced LinkState object for the same area
+        # overwrites its predecessor instead of leaking it; topology-version
+        # tracking lives in _AreaSolve.refresh()
+        self._solves: Dict[Tuple[str, str], Tuple[int, _AreaSolve]] = {}
         self.device_solves = 0  # counter: batched device calls
 
     def _area_solve(
@@ -308,15 +368,15 @@ class TpuSpfSolver(SpfSolver):
             return None
         key = (link_state.area, node)
         cached = self._solves.get(key)
-        if (
-            cached is not None
-            and cached[0] == id(link_state)
-            and cached[1] == link_state.version
-        ):
-            return cached[2]
+        if cached is not None and cached[0] == id(link_state):
+            solve = cached[1]
+            before = solve.device_solves
+            solve.refresh()  # incremental: patch arrays + one device call
+            self.device_solves += solve.device_solves - before
+            return solve
         solve = _AreaSolve(link_state, node)
-        self.device_solves += 1
-        self._solves[key] = (id(link_state), link_state.version, solve)
+        self.device_solves += solve.device_solves
+        self._solves[key] = (id(link_state), solve)
         return solve
 
     # -- SPF access seam -------------------------------------------------

@@ -1,0 +1,71 @@
+"""Smoke tests: every benchmark module runs end-to-end at tiny sizes and
+prints parseable JSON result lines (the contract bench.py also follows)."""
+
+import json
+
+import pytest
+
+
+def run_and_parse(capsys, main, env, monkeypatch):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    main([])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "no JSON lines emitted"
+    results = [json.loads(line) for line in out]
+    for r in results:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(r)
+        assert isinstance(r["value"], (int, float))
+    return results
+
+
+def test_decision_bench(capsys, monkeypatch):
+    from benchmarks.decision_bench import main
+
+    results = run_and_parse(
+        capsys,
+        main,
+        {
+            "DECISION_GRID_SIDES": "3",
+            "DECISION_FABRIC_PODS": "1",
+            "DECISION_KSP2_SIDES": "3",
+            "DECISION_EVENTS": "2",
+            "DECISION_KSP2_PREFIXES": "3",
+        },
+        monkeypatch,
+    )
+    assert len(results) == 3
+
+
+def test_kvstore_bench(capsys, monkeypatch):
+    from benchmarks.kvstore_bench import main
+
+    results = run_and_parse(
+        capsys,
+        main,
+        {
+            "KVSTORE_MERGE_SIZES": "50:10",
+            "KVSTORE_DUMP_SIZES": "50",
+        },
+        monkeypatch,
+    )
+    assert len(results) == 2
+    assert all(r["value"] > 0 for r in results)
+
+
+def test_scale_bench(capsys, monkeypatch):
+    from benchmarks.scale_bench import main
+
+    results = run_and_parse(
+        capsys,
+        main,
+        {
+            "SCALE_CLOS_PODS": "1",
+            "SCALE_WAN_N": "64",
+            "SCALE_KSP_N": "64",
+            "SCALE_SOURCES": "8",
+            "SCALE_METRICS": "2",
+        },
+        monkeypatch,
+    )
+    assert len(results) == 4

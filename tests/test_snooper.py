@@ -1,0 +1,62 @@
+"""KvStore snooper tool: stream decode of adj/prefix deltas
+(reference: openr/kvstore/tools/KvStoreSnooper.cpp)."""
+
+import asyncio
+import io
+import threading
+
+from openr_tpu.ctrl import CtrlServer
+from openr_tpu.kvstore import InProcessTransport, KvStore
+from openr_tpu.kvstore.snooper import snoop
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    Value,
+)
+from openr_tpu.utils import serializer
+
+
+def test_snooper_decodes_stream():
+    async def body():
+        store = KvStore("n1", ["0"], InProcessTransport())
+        adj_db = AdjacencyDatabase(
+            "n1",
+            [Adjacency("n2", "if-n1-n2", metric=7)],
+            area="0",
+        )
+        store.set_key("adj:n1", Value(1, "n1", serializer.dumps(adj_db)))
+        server = CtrlServer("n1", port=0, kvstore=store)
+        port = await server.start()
+
+        out = io.StringIO()
+        result = {}
+
+        def run_snoop():
+            result["frames"] = snoop(
+                "127.0.0.1", port, out=out, max_frames=2
+            )
+
+        t = threading.Thread(target=run_snoop)
+        t.start()
+        await asyncio.sleep(0.3)
+        pfx_db = PrefixDatabase(
+            "n3", [PrefixEntry(IpPrefix("10.0.0.0/24"))]
+        )
+        store.set_key("prefix:n3", Value(1, "n3", serializer.dumps(pfx_db)))
+        await asyncio.to_thread(t.join, 5)
+        assert not t.is_alive()
+        await server.stop()
+
+        text = out.getvalue()
+        assert result["frames"] == 2
+        assert "[SNAPSHOT] adj:n1" in text
+        assert "n2/if-n1-n2:7" in text  # decoded adjacency
+        assert "[DELTA] prefix:n3" in text
+        assert "10.0.0.0/24" in text  # decoded prefix entry
+
+    asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(body(), 15)
+    )
