@@ -16,7 +16,7 @@ from typing import List
 
 from benchmarks.common import emit, note
 
-from openr_tpu.kvstore.store import KvStoreFilters, merge_key_values
+from openr_tpu.kvstore.store import merge_key_values
 from openr_tpu.types import Value
 
 
@@ -66,16 +66,16 @@ def bench_merge(store_size: int, update_size: int, rounds: int = 5) -> None:
 
 
 def bench_dump(store_size: int, rounds: int = 5) -> None:
-    store = _make_store(store_size)
-    filters = KvStoreFilters()
+    from openr_tpu.kvstore import InProcessTransport, KvStore
+
+    kv = KvStore("bench", ["0"], InProcessTransport())
+    kv.db("0").store.update(_make_store(store_size))
     best = float("inf")
     for _ in range(rounds):
         t0 = time.time()
-        dumped = {
-            k: v for k, v in store.items() if filters.key_match(k, v)
-        }
+        pub = kv.dump_all(area="0")
         dt = time.time() - t0
-        assert len(dumped) == store_size
+        assert len(pub.key_vals) == store_size
         best = min(best, dt)
     rate = store_size / best
     note(f"dumpAll n={store_size}: {best*1e3:.2f}ms ({rate:,.0f} keys/s)")

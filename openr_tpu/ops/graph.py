@@ -18,7 +18,7 @@ Reference semantics compiled in:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -62,6 +62,17 @@ class CompiledGraph:
     # snapshot markers for incremental refresh (refresh_graph)
     version: int = -1  # LinkState.version at compile time
     log_pos: int = 0  # LinkState.graph_log_pos at compile time
+    # ELL (padded per-destination in-neighbor lists) "pull" layout — the
+    # fast path for bounded-degree graphs: relaxation becomes max_in_degree
+    # row-gathers + mins instead of a gather/scatter over the edge list
+    # (measured ~6x faster per round on TPU for degree-4 grids). None when
+    # the degree spread makes ELL wasteful (e.g. Clos spines).
+    nbr: Optional[np.ndarray] = None  # int32 [n_pad, md] in-neighbor ids
+    wg: Optional[np.ndarray] = None  # int32 [n_pad, md]; INF padding
+    # edge position i in src/dst/w -> its (row, slot) in nbr/wg, for
+    # incremental weight patches
+    ell_row: Optional[np.ndarray] = None  # int32 [e_pad]
+    ell_slot: Optional[np.ndarray] = None  # int32 [e_pad]
 
 
 def compile_graph(link_state: LinkState) -> CompiledGraph:
@@ -116,7 +127,7 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
     for i, name in enumerate(names):
         overloaded[i] = link_state.is_node_overloaded(name)
 
-    return CompiledGraph(
+    graph = CompiledGraph(
         names=names,
         node_index=node_index,
         n=n,
@@ -131,6 +142,43 @@ def compile_graph(link_state: LinkState) -> CompiledGraph:
         version=link_state.version,
         log_pos=link_state.graph_log_pos,
     )
+    _build_ell(graph)
+    return graph
+
+
+# ELL is only worthwhile while md gathers of the full distance matrix beat
+# one edge-list gather+scatter; cap the wasted work at 4x and bound md
+_ELL_WASTE_CAP = 4
+_ELL_MAX_DEGREE = 128
+
+
+def _build_ell(graph: CompiledGraph) -> None:
+    """Derive the padded in-neighbor (ELL) layout from the edge arrays.
+
+    Only real edges participate (array-padding edges are permanently INF and
+    never patched); down links carry INF in wg and never relax, keeping
+    slots stable across flaps."""
+    n_pad, e = graph.n_pad, graph.e
+    if e == 0:
+        graph.nbr = graph.wg = graph.ell_row = graph.ell_slot = None
+        return
+    dst = graph.dst[:e]
+    # per-destination slot index: dst is sorted, so slot = i - segment_start
+    counts = np.bincount(dst, minlength=n_pad)
+    md = int(counts.max())
+    if md > _ELL_MAX_DEGREE or md * n_pad > _ELL_WASTE_CAP * graph.e_pad:
+        graph.nbr = graph.wg = graph.ell_row = graph.ell_slot = None
+        return
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot = np.arange(e, dtype=np.int64) - starts[dst]
+    nbr = np.zeros((n_pad, md), dtype=np.int32)
+    wg = np.full((n_pad, md), INF, dtype=np.int32)
+    nbr[dst, slot] = graph.src[:e]
+    wg[dst, slot] = graph.w[:e]
+    graph.nbr = nbr
+    graph.wg = wg
+    graph.ell_row = dst.astype(np.int32)
+    graph.ell_slot = slot.astype(np.int32)
 
 
 def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
@@ -149,6 +197,7 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
         return compile_graph(link_state)
 
     w = graph.w.copy()
+    wg = graph.wg.copy() if graph.wg is not None else None
     overloaded = graph.overloaded.copy()
     for kind, obj in changes:
         if kind == "link":
@@ -156,8 +205,13 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
             if pos is None:  # changelog raced a structural entry we missed
                 return compile_graph(link_state)
             up = obj.is_up()
-            w[pos[0]] = obj.metric_from_node(obj.n1) if up else INF
-            w[pos[1]] = obj.metric_from_node(obj.n2) if up else INF
+            for p, metric in (
+                (pos[0], obj.metric_from_node(obj.n1)),
+                (pos[1], obj.metric_from_node(obj.n2)),
+            ):
+                w[p] = metric if up else INF
+                if wg is not None:
+                    wg[graph.ell_row[p], graph.ell_slot[p]] = w[p]
         else:  # "node"
             i = graph.node_index.get(obj)
             if i is None:
@@ -178,4 +232,8 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
         link_edges=graph.link_edges,
         version=link_state.version,
         log_pos=link_state.graph_log_pos,
+        nbr=graph.nbr,
+        wg=wg,
+        ell_row=graph.ell_row,
+        ell_slot=graph.ell_slot,
     )

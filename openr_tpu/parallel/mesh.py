@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from openr_tpu.ops.graph import CompiledGraph
-from openr_tpu.ops.spf import _bf_fixpoint, _ecmp_dag
+from openr_tpu.ops.spf import _bf_fixpoint, _bf_fixpoint_ell, _ecmp_dag
 
 
 def make_mesh(
@@ -56,17 +56,33 @@ def sharded_batched_spf(
 ) -> jnp.ndarray:
     """Batched SPF with the sources axis sharded over mesh axis 'batch'.
 
-    Returns D [S_padded, n_pad] sharded P('batch', None).
+    Uses the ELL pull kernel when the graph qualifies (dest-major [N, S]
+    matrix: the source axis is the minor dim, still sharded over 'batch'
+    since the kernel returns D transposed). Returns D [S_padded, n_pad]
+    sharded P('batch', None).
     """
     batch = mesh.shape["batch"]
     sources = _pad_sources(source_rows, batch)
 
     row_sharded = NamedSharding(mesh, P("batch"))
     replicated = NamedSharding(mesh, P())
+    out_sharding = NamedSharding(mesh, P("batch", None))
+    if graph.nbr is not None:
+        fn = jax.jit(
+            _bf_fixpoint_ell,
+            in_shardings=(row_sharded, replicated, replicated, replicated),
+            out_shardings=out_sharding,
+        )
+        return fn(
+            jax.device_put(jnp.asarray(sources), row_sharded),
+            jax.device_put(jnp.asarray(graph.nbr), replicated),
+            jax.device_put(jnp.asarray(graph.wg), replicated),
+            jax.device_put(jnp.asarray(graph.overloaded), replicated),
+        )
     fn = jax.jit(
         _bf_fixpoint,
         in_shardings=(row_sharded, replicated, replicated, replicated, replicated),
-        out_shardings=NamedSharding(mesh, P("batch", None)),
+        out_shardings=out_sharding,
     )
     return fn(
         jax.device_put(jnp.asarray(sources), row_sharded),

@@ -142,6 +142,38 @@ class TestBatchedSpf:
         for n in (5, 7):
             all_pairs_distance_check(build_ls(ring_edges(n)))
 
+    def test_ell_and_edge_list_kernels_agree(self):
+        from openr_tpu.ops.spf import _bf_fixpoint, _bf_fixpoint_ell
+
+        rng = random.Random(5)
+        for trial in range(5):
+            n = rng.randint(4, 12)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = [
+                (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 9))
+                for i in range(1, n)
+            ]
+            overloaded = {nodes[i] for i in range(1, n) if rng.random() < 0.2}
+            ls = build_ls(edges, overloaded_nodes=overloaded)
+            g = compile_graph(ls)
+            assert g.nbr is not None  # small bounded-degree: ELL selected
+            rows = np.arange(g.n_pad, dtype=np.int32)
+            d_ell = np.asarray(
+                _bf_fixpoint_ell(rows, g.nbr, g.wg, g.overloaded)
+            )
+            d_edge = np.asarray(
+                _bf_fixpoint(rows, g.src, g.dst, g.w, g.overloaded)
+            )
+            np.testing.assert_array_equal(d_ell, d_edge)
+
+    def test_high_degree_falls_back_to_edge_list(self):
+        # star: hub in-degree exceeds the ELL cap -> edge-list path
+        edges = [("hub", f"leaf{i}", 1) for i in range(150)]
+        ls = build_ls(edges)
+        g = compile_graph(ls)
+        assert g.nbr is None
+        all_pairs_distance_check(ls)
+
 
 class TestIncrementalRefresh:
     """refresh_graph must patch weight/overload arrays in place for
@@ -160,6 +192,11 @@ class TestIncrementalRefresh:
         g2 = refresh_graph(g1, ls)
         assert g2.src is g1.src and g2.dst is g1.dst  # no rebuild
         assert g2.version == ls.version
+        # ELL weights patched consistently with the edge weights
+        assert g2.wg is not None
+        np.testing.assert_array_equal(
+            g2.wg[g2.ell_row, g2.ell_slot], g2.w[: g2.e]
+        )
         all_pairs_distance_check_graph(ls, g2)
 
     def test_node_overload_patches_in_place(self):
