@@ -1,0 +1,659 @@
+// openr-tpu standalone FIB agent — native equivalent of the reference's
+// platform_linux binary (openr/platform/LinuxPlatformMain.cpp, target
+// CMakeLists.txt:410): a FibService server that programs the Linux kernel
+// FIB through the native netlink library (../nl/onl_netlink.h) so the
+// kernel-facing agent runs without a Python runtime.
+//
+// Wire protocol: newline-delimited JSON over TCP, same RPC shape as the
+// ctrl server ({"id", "method", "params"} -> {"id", "result"|"error"}),
+// methods mirroring openr/if/Platform.thrift FibService:116-204:
+//   aliveSince, addUnicastRoutes, deleteUnicastRoutes, syncFib,
+//   addMplsRoutes, deleteMplsRoutes, syncMplsFib,
+//   getRouteTableByClient, getMplsRouteTableByClient
+//
+// --dryrun keeps the route table in memory only (no kernel writes), which
+// is how tests exercise the full binary + wire protocol without privileges.
+// --port 0 binds an ephemeral port; the agent prints "LISTENING <port>" on
+// stdout either way so a supervisor can parse it.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "../nl/onl_netlink.h"
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (objects, arrays, strings, ints, bools, null) — enough for
+// the FibService wire shapes; no external deps in this image.
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Type { NUL, BOOL, INT, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  long long i = 0;
+  std::string s;
+  std::vector<Json> arr;
+  std::vector<std::pair<std::string, Json>> obj;
+
+  const Json* get(const std::string& key) const {
+    for (auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  long long get_int(const std::string& key, long long dflt = 0) const {
+    const Json* v = get(key);
+    return v && v->type == INT ? v->i : dflt;
+  }
+  std::string get_str(const std::string& key, const std::string& d = "") const {
+    const Json* v = get(key);
+    return v && v->type == STR ? v->s : d;
+  }
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit Parser(const std::string& text)
+      : p(text.data()), end(text.data() + text.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* w) {
+    size_t n = strlen(w);
+    if (size_t(end - p) >= n && memcmp(p, w, n) == 0) {
+      p += n;
+      return true;
+    }
+    return false;
+  }
+  Json parse() {
+    ws();
+    Json out;
+    if (p >= end) {
+      ok = false;
+      return out;
+    }
+    char c = *p;
+    if (c == '{') {
+      ++p;
+      out.type = Json::OBJ;
+      ws();
+      if (p < end && *p == '}') {
+        ++p;
+        return out;
+      }
+      while (ok) {
+        ws();
+        Json key = parse();
+        if (key.type != Json::STR) {
+          ok = false;
+          break;
+        }
+        ws();
+        if (p >= end || *p != ':') {
+          ok = false;
+          break;
+        }
+        ++p;
+        Json val = parse();
+        out.obj.emplace_back(key.s, std::move(val));
+        ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        if (p < end && *p == '}') {
+          ++p;
+          break;
+        }
+        ok = false;
+      }
+    } else if (c == '[') {
+      ++p;
+      out.type = Json::ARR;
+      ws();
+      if (p < end && *p == ']') {
+        ++p;
+        return out;
+      }
+      while (ok) {
+        out.arr.push_back(parse());
+        ws();
+        if (p < end && *p == ',') {
+          ++p;
+          continue;
+        }
+        if (p < end && *p == ']') {
+          ++p;
+          break;
+        }
+        ok = false;
+      }
+    } else if (c == '"') {
+      ++p;
+      out.type = Json::STR;
+      while (p < end && *p != '"') {
+        if (*p == '\\' && p + 1 < end) {
+          ++p;
+          switch (*p) {
+            case 'n': out.s += '\n'; break;
+            case 't': out.s += '\t'; break;
+            case 'r': out.s += '\r'; break;
+            case '"': out.s += '"'; break;
+            case '\\': out.s += '\\'; break;
+            case '/': out.s += '/'; break;
+            default: out.s += *p;  // \uXXXX unsupported (ASCII protocol)
+          }
+          ++p;
+        } else {
+          out.s += *p++;
+        }
+      }
+      if (p < end)
+        ++p;
+      else
+        ok = false;
+    } else if (c == 't' && lit("true")) {
+      out.type = Json::BOOL;
+      out.b = true;
+    } else if (c == 'f' && lit("false")) {
+      out.type = Json::BOOL;
+    } else if (c == 'n' && lit("null")) {
+      out.type = Json::NUL;
+    } else if (c == '-' || (c >= '0' && c <= '9')) {
+      out.type = Json::INT;
+      char* e = nullptr;
+      out.i = strtoll(p, &e, 10);
+      if (e == p)
+        ok = false;
+      else
+        p = e;
+      // fractional part: truncate (protocol uses ints only)
+      if (p < end && *p == '.') {
+        ++p;
+        while (p < end && *p >= '0' && *p <= '9') ++p;
+      }
+    } else {
+      ok = false;
+    }
+    return out;
+  }
+};
+
+static void dump(const Json& v, std::string& out) {
+  char buf[32];
+  switch (v.type) {
+    case Json::NUL: out += "null"; break;
+    case Json::BOOL: out += v.b ? "true" : "false"; break;
+    case Json::INT:
+      snprintf(buf, sizeof buf, "%lld", v.i);
+      out += buf;
+      break;
+    case Json::STR:
+      out += '"';
+      for (char c : v.s) {
+        if (c == '"' || c == '\\') {
+          out += '\\';
+          out += c;
+        } else if (c == '\n') {
+          out += "\\n";
+        } else {
+          out += c;
+        }
+      }
+      out += '"';
+      break;
+    case Json::ARR:
+      out += '[';
+      for (size_t i = 0; i < v.arr.size(); ++i) {
+        if (i) out += ',';
+        dump(v.arr[i], out);
+      }
+      out += ']';
+      break;
+    case Json::OBJ:
+      out += '{';
+      for (size_t i = 0; i < v.obj.size(); ++i) {
+        if (i) out += ',';
+        Json k;
+        k.type = Json::STR;
+        k.s = v.obj[i].first;
+        dump(k, out);
+        out += ':';
+        dump(v.obj[i].second, out);
+      }
+      out += '}';
+      break;
+  }
+}
+
+static Json jint(long long v) {
+  Json j;
+  j.type = Json::INT;
+  j.i = v;
+  return j;
+}
+static Json jstr(const std::string& v) {
+  Json j;
+  j.type = Json::STR;
+  j.s = v;
+  return j;
+}
+static Json jarr() {
+  Json j;
+  j.type = Json::ARR;
+  return j;
+}
+static Json jobj() {
+  Json j;
+  j.type = Json::OBJ;
+  return j;
+}
+
+// ---------------------------------------------------------------------------
+// Agent state: per-client route tables (mirrors NetlinkFibHandler's
+// protocol-tagged kernel view; authoritative in dryrun, a cache otherwise).
+// ---------------------------------------------------------------------------
+
+struct Nexthop {
+  std::string via;
+  std::string iface;
+  int weight = 0;
+  int mpls_action = 0;
+  std::vector<int> labels;
+};
+
+struct Agent {
+  bool dryrun = false;
+  void* nl = nullptr;
+  long long alive_since = 0;
+  // client id -> route tables
+  std::map<int, std::map<std::string, std::vector<Nexthop>>> unicast;
+  std::map<int, std::map<int, std::vector<Nexthop>>> mpls;
+  std::map<std::string, int> if_index;
+
+  std::string err;
+
+  bool refresh_links() {
+    if (dryrun) return true;
+    onl_link links[512];
+    int n = onl_get_links(nl, links, 512);
+    if (n < 0) {
+      err = onl_strerror(nl);
+      return false;
+    }
+    if_index.clear();
+    for (int i = 0; i < n; ++i) if_index[links[i].name] = links[i].ifindex;
+    return true;
+  }
+
+  bool to_onl(const std::vector<Nexthop>& nhs, std::vector<onl_nexthop>& out) {
+    out.clear();
+    for (const auto& nh : nhs) {
+      onl_nexthop o;
+      memset(&o, 0, sizeof o);
+      snprintf(o.via, sizeof o.via, "%s", nh.via.c_str());
+      if (!nh.iface.empty()) {
+        auto it = if_index.find(nh.iface);
+        if (it == if_index.end()) {
+          refresh_links();
+          it = if_index.find(nh.iface);
+          if (it == if_index.end()) {
+            err = "unknown interface " + nh.iface;
+            return false;
+          }
+        }
+        o.ifindex = it->second;
+      }
+      o.weight = nh.weight;
+      o.mpls_action = nh.mpls_action;
+      o.num_labels = (int)nh.labels.size() > 8 ? 8 : (int)nh.labels.size();
+      for (int i = 0; i < o.num_labels; ++i) o.labels[i] = nh.labels[i];
+      out.push_back(o);
+    }
+    return true;
+  }
+
+  bool k_add_unicast(const std::string& dest, const std::vector<Nexthop>& nhs) {
+    if (dryrun) return true;
+    std::vector<onl_nexthop> o;
+    if (!to_onl(nhs, o)) return false;
+    if (onl_add_unicast_route(nl, dest.c_str(), 99, 254, o.data(),
+                              (int)o.size(), 1) != 0) {
+      err = onl_strerror(nl);
+      return false;
+    }
+    return true;
+  }
+  bool k_del_unicast(const std::string& dest) {
+    if (dryrun) return true;
+    if (onl_del_unicast_route(nl, dest.c_str(), 99, 254) != 0) {
+      err = onl_strerror(nl);
+      return false;
+    }
+    return true;
+  }
+  bool k_add_mpls(int label, const std::vector<Nexthop>& nhs) {
+    if (dryrun) return true;
+    std::vector<onl_nexthop> o;
+    if (!to_onl(nhs, o)) return false;
+    if (onl_add_mpls_route(nl, label, o.data(), (int)o.size(), 1) != 0) {
+      err = onl_strerror(nl);
+      return false;
+    }
+    return true;
+  }
+  bool k_del_mpls(int label) {
+    if (dryrun) return true;
+    if (onl_del_mpls_route(nl, label) != 0) {
+      err = onl_strerror(nl);
+      return false;
+    }
+    return true;
+  }
+};
+
+static bool parse_nexthops(const Json* nhs, std::vector<Nexthop>& out) {
+  out.clear();
+  if (!nhs || nhs->type != Json::ARR) return false;
+  for (const Json& j : nhs->arr) {
+    Nexthop nh;
+    nh.via = j.get_str("via");
+    nh.iface = j.get_str("iface");
+    nh.weight = (int)j.get_int("weight", 0);
+    nh.mpls_action = (int)j.get_int("mpls_action", 0);
+    const Json* labels = j.get("labels");
+    if (labels && labels->type == Json::ARR)
+      for (const Json& l : labels->arr)
+        if (l.type == Json::INT) nh.labels.push_back((int)l.i);
+    out.push_back(std::move(nh));
+  }
+  return true;
+}
+
+static Json dump_nexthops(const std::vector<Nexthop>& nhs) {
+  Json arr = jarr();
+  for (const auto& nh : nhs) {
+    Json o = jobj();
+    o.obj.emplace_back("via", jstr(nh.via));
+    o.obj.emplace_back("iface", jstr(nh.iface));
+    o.obj.emplace_back("weight", jint(nh.weight));
+    o.obj.emplace_back("mpls_action", jint(nh.mpls_action));
+    Json labels = jarr();
+    for (int l : nh.labels) labels.arr.push_back(jint(l));
+    o.obj.emplace_back("labels", std::move(labels));
+    arr.arr.push_back(std::move(o));
+  }
+  return arr;
+}
+
+static Json handle(Agent& ag, const std::string& method, const Json& params,
+                   std::string& err) {
+  long long client = params.get_int("client", 786);  // kFibId default
+
+  if (method == "aliveSince") return jint(ag.alive_since);
+
+  if (method == "addUnicastRoutes" || method == "syncFib") {
+    const Json* routes = params.get("routes");
+    if (!routes || routes->type != Json::ARR) {
+      err = "missing routes";
+      return Json();
+    }
+    std::map<std::string, std::vector<Nexthop>> desired;
+    for (const Json& r : routes->arr) {
+      std::vector<Nexthop> nhs;
+      if (!parse_nexthops(r.get("nexthops"), nhs)) {
+        err = "bad nexthops";
+        return Json();
+      }
+      desired[r.get_str("dest")] = std::move(nhs);
+    }
+    auto& table = ag.unicast[(int)client];
+    if (method == "syncFib") {
+      // diff: delete stale, then add/replace all desired
+      for (auto it = table.begin(); it != table.end();) {
+        if (!desired.count(it->first)) {
+          if (!ag.k_del_unicast(it->first)) {
+            err = ag.err;
+            return Json();
+          }
+          it = table.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& kv : desired) {
+      if (!ag.k_add_unicast(kv.first, kv.second)) {
+        err = ag.err;
+        return Json();
+      }
+      table[kv.first] = kv.second;
+    }
+    return Json();
+  }
+
+  if (method == "deleteUnicastRoutes") {
+    const Json* prefixes = params.get("prefixes");
+    if (!prefixes || prefixes->type != Json::ARR) {
+      err = "missing prefixes";
+      return Json();
+    }
+    auto& table = ag.unicast[(int)client];
+    for (const Json& p : prefixes->arr) {
+      if (table.erase(p.s) && !ag.k_del_unicast(p.s)) {
+        err = ag.err;
+        return Json();
+      }
+    }
+    return Json();
+  }
+
+  if (method == "addMplsRoutes" || method == "syncMplsFib") {
+    const Json* routes = params.get("routes");
+    if (!routes || routes->type != Json::ARR) {
+      err = "missing routes";
+      return Json();
+    }
+    std::map<int, std::vector<Nexthop>> desired;
+    for (const Json& r : routes->arr) {
+      std::vector<Nexthop> nhs;
+      if (!parse_nexthops(r.get("nexthops"), nhs)) {
+        err = "bad nexthops";
+        return Json();
+      }
+      desired[(int)r.get_int("label")] = std::move(nhs);
+    }
+    auto& table = ag.mpls[(int)client];
+    if (method == "syncMplsFib") {
+      for (auto it = table.begin(); it != table.end();) {
+        if (!desired.count(it->first)) {
+          if (!ag.k_del_mpls(it->first)) {
+            err = ag.err;
+            return Json();
+          }
+          it = table.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto& kv : desired) {
+      if (!ag.k_add_mpls(kv.first, kv.second)) {
+        err = ag.err;
+        return Json();
+      }
+      table[kv.first] = kv.second;
+    }
+    return Json();
+  }
+
+  if (method == "deleteMplsRoutes") {
+    const Json* labels = params.get("labels");
+    if (!labels || labels->type != Json::ARR) {
+      err = "missing labels";
+      return Json();
+    }
+    auto& table = ag.mpls[(int)client];
+    for (const Json& l : labels->arr) {
+      if (table.erase((int)l.i) && !ag.k_del_mpls((int)l.i)) {
+        err = ag.err;
+        return Json();
+      }
+    }
+    return Json();
+  }
+
+  if (method == "getRouteTableByClient") {
+    Json arr = jarr();
+    for (auto& kv : ag.unicast[(int)client]) {
+      Json r = jobj();
+      r.obj.emplace_back("dest", jstr(kv.first));
+      r.obj.emplace_back("nexthops", dump_nexthops(kv.second));
+      arr.arr.push_back(std::move(r));
+    }
+    return arr;
+  }
+
+  if (method == "getMplsRouteTableByClient") {
+    Json arr = jarr();
+    for (auto& kv : ag.mpls[(int)client]) {
+      Json r = jobj();
+      r.obj.emplace_back("label", jint(kv.first));
+      r.obj.emplace_back("nexthops", dump_nexthops(kv.second));
+      arr.arr.push_back(std::move(r));
+    }
+    return arr;
+  }
+
+  err = "unknown method " + method;
+  return Json();
+}
+
+// ---------------------------------------------------------------------------
+// Server loop: poll() over listener + clients, newline-framed requests.
+// ---------------------------------------------------------------------------
+
+int main(int argc, char** argv) {
+  int port = 60100;
+  bool dryrun = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--dryrun")) {
+      dryrun = true;
+    } else if (!strcmp(argv[i], "--port") && i + 1 < argc) {
+      port = atoi(argv[++i]);
+    } else {
+      fprintf(stderr, "usage: %s [--port N] [--dryrun]\n", argv[0]);
+      return 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  Agent ag;
+  ag.dryrun = dryrun;
+  ag.alive_since = (long long)time(nullptr);
+  if (!dryrun) {
+    ag.nl = onl_open();
+    if (!ag.nl) {
+      fprintf(stderr, "fatal: cannot open netlink socket\n");
+      return 1;
+    }
+    ag.refresh_links();
+  }
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0 || listen(lfd, 16) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (sockaddr*)&addr, &alen);
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::map<int, std::string> bufs;  // fd -> pending input
+  for (;;) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto& kv : bufs) pfds.push_back({kv.first, POLLIN, 0});
+    if (poll(pfds.data(), (nfds_t)pfds.size(), -1) < 0) continue;
+
+    if (pfds[0].revents & POLLIN) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd >= 0) {
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        bufs[cfd];
+      }
+    }
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      int fd = pfds[i].fd;
+      char chunk[65536];
+      ssize_t n = recv(fd, chunk, sizeof chunk, 0);
+      if (n <= 0) {
+        close(fd);
+        bufs.erase(fd);
+        continue;
+      }
+      std::string& buf = bufs[fd];
+      buf.append(chunk, (size_t)n);
+      size_t pos;
+      while ((pos = buf.find('\n')) != std::string::npos) {
+        std::string line = buf.substr(0, pos);
+        buf.erase(0, pos + 1);
+        if (line.empty()) continue;
+        Parser parser(line);
+        Json req = parser.parse();
+        Json resp = jobj();
+        const Json* id = req.get("id");
+        resp.obj.emplace_back("id", id ? *id : Json());
+        if (!parser.ok || req.type != Json::OBJ) {
+          resp.obj.emplace_back("error", jstr("parse error"));
+        } else {
+          std::string err;
+          Json params = jobj();
+          const Json* p = req.get("params");
+          Json result =
+              handle(ag, req.get_str("method"), p ? *p : params, err);
+          if (!err.empty())
+            resp.obj.emplace_back("error", jstr(err));
+          else
+            resp.obj.emplace_back("result", std::move(result));
+        }
+        std::string out;
+        dump(resp, out);
+        out += '\n';
+        ssize_t off = 0;
+        while (off < (ssize_t)out.size()) {
+          ssize_t w = send(fd, out.data() + off, out.size() - off, 0);
+          if (w <= 0) break;
+          off += w;
+        }
+      }
+    }
+  }
+}

@@ -139,6 +139,10 @@ class OpenrConfig:
     dryrun: bool = False
     enable_v4: bool = True
     enable_netlink_fib_handler: bool = False
+    # route programming through the standalone native agent binary
+    # (onl_fib_agent, the platform_linux equivalent) at fib_port instead of
+    # the in-process netlink handler
+    enable_fib_agent: bool = False
     eor_time_s: Optional[int] = None
     prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (

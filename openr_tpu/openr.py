@@ -229,7 +229,13 @@ class OpenrDaemon:
 
         # --- fib -------------------------------------------------------
         if fib_service is None:
-            if config.is_netlink_fib_handler_enabled():
+            if c.enable_fib_agent:
+                # standalone native agent (platform_linux equivalent) at
+                # fib_port; Fib's aliveSince keep-alive handles restarts
+                from openr_tpu.platform import RemoteFibService
+
+                fib_service = RemoteFibService(port=c.fib_port)
+            elif config.is_netlink_fib_handler_enabled():
                 from openr_tpu.platform import NetlinkFibHandler
 
                 fib_service = NetlinkFibHandler(loop=loop)

@@ -13,6 +13,7 @@ from openr_tpu.platform.fib_service import (
     PlatformError,
 )
 from openr_tpu.platform.netlink_fib import NetlinkFibHandler, NetlinkPublisher
+from openr_tpu.platform.remote import RemoteFibService, spawn_agent
 
 __all__ = [
     "FIB_CLIENT_OPENR",
@@ -21,4 +22,6 @@ __all__ = [
     "NetlinkFibHandler",
     "NetlinkPublisher",
     "PlatformError",
+    "RemoteFibService",
+    "spawn_agent",
 ]

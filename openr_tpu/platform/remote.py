@@ -1,0 +1,269 @@
+"""Remote FIB agent client + spawner.
+
+The reference runs route programming in a standalone native binary
+(`platform_linux`, openr/platform/LinuxPlatformMain.cpp) that the Fib
+module reaches over thrift (openr/fib/Fib.cpp:697 createFibClient). Here
+the native agent is native/platform/onl_fib_agent.cpp (built into
+openr_tpu/_native/onl_fib_agent) speaking newline-delimited JSON, and
+RemoteFibService is the FibService-shaped client the Fib module plugs in.
+
+Wire route shapes:
+  unicast: {"dest": "10.0.0.0/24", "nexthops": [nh...]}
+  mpls:    {"label": 100100, "nexthops": [nh...]}
+  nh:      {"via": addr|"", "iface": name|"", "weight": int,
+            "mpls_action": 0-3 (onl_mpls_action), "labels": [int...]}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.types import (
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
+from openr_tpu.platform.fib_service import FibService, PlatformError
+
+_ACTION_TO_WIRE = {
+    MplsActionCode.PUSH: 1,
+    MplsActionCode.SWAP: 2,
+    MplsActionCode.PHP: 3,
+    MplsActionCode.POP_AND_LOOKUP: 3,
+}
+_WIRE_TO_ACTION = {
+    1: MplsActionCode.PUSH,
+    2: MplsActionCode.SWAP,
+    3: MplsActionCode.PHP,
+}
+
+AGENT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native",
+    "onl_fib_agent",
+)
+
+
+def spawn_agent(
+    port: int = 0, dryrun: bool = False, agent_path: Optional[str] = None
+) -> Tuple[subprocess.Popen, int]:
+    """Start the native agent; returns (process, bound port)."""
+    path = agent_path or AGENT_PATH
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not built — run `make -C native` first"
+        )
+    args = [path, "--port", str(port)]
+    if dryrun:
+        args.append("--dryrun")
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+    )
+    line = proc.stdout.readline().strip()
+    if not line.startswith("LISTENING "):
+        proc.kill()
+        raise PlatformError(f"agent failed to start: {line!r}")
+    return proc, int(line.split()[1])
+
+
+def _nh_to_wire(nh: NextHop) -> Dict:
+    action, labels = 0, []
+    if nh.mpls_action is not None:
+        action = _ACTION_TO_WIRE[nh.mpls_action.action]
+        if nh.mpls_action.action == MplsActionCode.SWAP:
+            labels = [nh.mpls_action.swap_label]
+        elif nh.mpls_action.action == MplsActionCode.PUSH:
+            labels = list(nh.mpls_action.push_labels)
+    via = nh.address
+    if via in ("0.0.0.0", "::"):
+        via = ""
+    return {
+        "via": via,
+        "iface": nh.iface or "",
+        "weight": max(1, nh.weight),
+        "mpls_action": action,
+        "labels": labels,
+    }
+
+
+def _nh_from_wire(d: Dict) -> NextHop:
+    action = _WIRE_TO_ACTION.get(d.get("mpls_action", 0))
+    mpls = None
+    if action is not None:
+        labels = d.get("labels") or []
+        if action == MplsActionCode.SWAP:
+            mpls = MplsAction(action, swap_label=labels[0] if labels else None)
+        elif action == MplsActionCode.PUSH:
+            mpls = MplsAction(action, push_labels=tuple(labels))
+        else:
+            mpls = MplsAction(action)
+    return NextHop(
+        address=d.get("via", ""),
+        iface=d.get("iface") or None,
+        weight=d.get("weight", 0),
+        mpls_action=mpls,
+    )
+
+
+class RemoteFibService(FibService):
+    """FibService client speaking the native agent's JSON protocol."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 60100) -> None:
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._reader = self._writer = None
+
+    async def _call(self, method: str, **params):
+        async with self._lock:
+            # (re)connect lazily so agent restarts surface as one failed
+            # call and then recover — Fib's keepAliveCheck handles the rest
+            if self._writer is None:
+                try:
+                    await self._connect()
+                except OSError as exc:
+                    raise PlatformError(f"agent unreachable: {exc}") from exc
+            self._next_id += 1
+            req = {"id": self._next_id, "method": method, "params": params}
+            try:
+                self._writer.write(json.dumps(req).encode() + b"\n")
+                await self._writer.drain()
+                line = await self._reader.readline()
+            except OSError as exc:
+                await self.close()
+                raise PlatformError(f"agent io error: {exc}") from exc
+            if not line:
+                await self.close()
+                raise PlatformError("agent closed connection")
+            resp = json.loads(line)
+            if resp.get("error") is not None:
+                raise PlatformError(resp["error"])
+            return resp.get("result")
+
+    # -- FibService ------------------------------------------------------
+
+    async def alive_since(self) -> int:
+        return await self._call("aliveSince")
+
+    async def add_unicast_routes(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        await self._call(
+            "addUnicastRoutes",
+            client=client_id,
+            routes=[
+                {
+                    "dest": str(r.dest),
+                    "nexthops": [_nh_to_wire(nh) for nh in r.nexthops],
+                }
+                for r in routes
+            ],
+        )
+
+    async def delete_unicast_routes(
+        self, client_id: int, prefixes: List[IpPrefix]
+    ) -> None:
+        await self._call(
+            "deleteUnicastRoutes",
+            client=client_id,
+            prefixes=[str(p) for p in prefixes],
+        )
+
+    async def sync_fib(
+        self, client_id: int, routes: List[UnicastRoute]
+    ) -> None:
+        await self._call(
+            "syncFib",
+            client=client_id,
+            routes=[
+                {
+                    "dest": str(r.dest),
+                    "nexthops": [_nh_to_wire(nh) for nh in r.nexthops],
+                }
+                for r in routes
+            ],
+        )
+
+    async def add_mpls_routes(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        await self._call(
+            "addMplsRoutes",
+            client=client_id,
+            routes=[
+                {
+                    "label": r.top_label,
+                    "nexthops": [_nh_to_wire(nh) for nh in r.nexthops],
+                }
+                for r in routes
+            ],
+        )
+
+    async def delete_mpls_routes(
+        self, client_id: int, labels: List[int]
+    ) -> None:
+        await self._call(
+            "deleteMplsRoutes", client=client_id, labels=list(labels)
+        )
+
+    async def sync_mpls_fib(
+        self, client_id: int, routes: List[MplsRoute]
+    ) -> None:
+        await self._call(
+            "syncMplsFib",
+            client=client_id,
+            routes=[
+                {
+                    "label": r.top_label,
+                    "nexthops": [_nh_to_wire(nh) for nh in r.nexthops],
+                }
+                for r in routes
+            ],
+        )
+
+    async def get_route_table_by_client(
+        self, client_id: int
+    ) -> List[UnicastRoute]:
+        rows = await self._call("getRouteTableByClient", client=client_id)
+        return [
+            UnicastRoute(
+                dest=IpPrefix(r["dest"]),
+                nexthops=tuple(_nh_from_wire(nh) for nh in r["nexthops"]),
+            )
+            for r in rows
+        ]
+
+    async def get_mpls_route_table_by_client(
+        self, client_id: int
+    ) -> List[MplsRoute]:
+        rows = await self._call("getMplsRouteTableByClient", client=client_id)
+        return [
+            MplsRoute(
+                top_label=r["label"],
+                nexthops=tuple(_nh_from_wire(nh) for nh in r["nexthops"]),
+            )
+            for r in rows
+        ]

@@ -1,0 +1,232 @@
+"""Native FIB agent integration: the standalone onl_fib_agent binary
+(native/platform/onl_fib_agent.cpp, the platform_linux equivalent) driven
+end-to-end over its JSON wire protocol by RemoteFibService and by the full
+Fib module, in --dryrun mode (no kernel writes, no privileges needed)."""
+
+import asyncio
+import os
+import subprocess
+
+import pytest
+
+from openr_tpu.fib import Fib, FibConfig
+from openr_tpu.messaging import RWQueue
+from openr_tpu.platform import FIB_CLIENT_OPENR, PlatformError
+from openr_tpu.platform.remote import AGENT_PATH, RemoteFibService, spawn_agent
+from openr_tpu.solver import DecisionRouteUpdate
+from openr_tpu.solver.routes import RibMplsEntry, RibUnicastEntry
+from openr_tpu.types import (
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
+
+
+def _ensure_agent():
+    if not os.path.exists(AGENT_PATH):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.join(root, "native")],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception as exc:  # pragma: no cover - toolchain missing
+            pytest.skip(f"native agent unavailable: {exc}")
+
+
+@pytest.fixture
+def agent():
+    _ensure_agent()
+    proc, port = spawn_agent(dryrun=True)
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+def run(coro, timeout=15.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def nh(addr, iface="eth0", label=None, push=None):
+    action = None
+    if label is not None:
+        action = MplsAction(MplsActionCode.SWAP, swap_label=label)
+    elif push is not None:
+        action = MplsAction(MplsActionCode.PUSH, push_labels=tuple(push))
+    return NextHop(address=addr, iface=iface, mpls_action=action)
+
+
+class TestWireProtocol:
+    def test_unicast_roundtrip(self, agent):
+        async def body():
+            svc = RemoteFibService(port=agent)
+            t0 = await svc.alive_since()
+            assert t0 > 0
+
+            routes = [
+                UnicastRoute(
+                    IpPrefix("10.1.0.0/24"),
+                    (nh("fe80::1"), nh("fe80::2", "eth1")),
+                ),
+                UnicastRoute(IpPrefix("10.2.0.0/24"), (nh("fe80::3"),)),
+            ]
+            await svc.add_unicast_routes(FIB_CLIENT_OPENR, routes)
+            got = await svc.get_route_table_by_client(FIB_CLIENT_OPENR)
+            assert {str(r.dest) for r in got} == {
+                "10.1.0.0/24",
+                "10.2.0.0/24",
+            }
+            two = next(r for r in got if str(r.dest) == "10.1.0.0/24")
+            assert {(n.address, n.iface) for n in two.nexthops} == {
+                ("fe80::1", "eth0"),
+                ("fe80::2", "eth1"),
+            }
+
+            await svc.delete_unicast_routes(
+                FIB_CLIENT_OPENR, [IpPrefix("10.2.0.0/24")]
+            )
+            got = await svc.get_route_table_by_client(FIB_CLIENT_OPENR)
+            assert {str(r.dest) for r in got} == {"10.1.0.0/24"}
+
+            # syncFib drops everything not in the desired set
+            await svc.sync_fib(
+                FIB_CLIENT_OPENR,
+                [UnicastRoute(IpPrefix("10.9.0.0/16"), (nh("fe80::9"),))],
+            )
+            got = await svc.get_route_table_by_client(FIB_CLIENT_OPENR)
+            assert {str(r.dest) for r in got} == {"10.9.0.0/16"}
+            await svc.close()
+
+        run(body())
+
+    def test_mpls_roundtrip(self, agent):
+        async def body():
+            svc = RemoteFibService(port=agent)
+            await svc.add_mpls_routes(
+                FIB_CLIENT_OPENR,
+                [
+                    MplsRoute(100001, (nh("fe80::1", label=100002),)),
+                    MplsRoute(100003, (nh("fe80::2", push=[1, 2, 3]),)),
+                ],
+            )
+            got = await svc.get_mpls_route_table_by_client(FIB_CLIENT_OPENR)
+            by_label = {r.top_label: r for r in got}
+            assert set(by_label) == {100001, 100003}
+            swap = next(iter(by_label[100001].nexthops))
+            assert swap.mpls_action.action == MplsActionCode.SWAP
+            assert swap.mpls_action.swap_label == 100002
+            push = next(iter(by_label[100003].nexthops))
+            assert push.mpls_action.push_labels == (1, 2, 3)
+
+            await svc.sync_mpls_fib(
+                FIB_CLIENT_OPENR, [MplsRoute(100001, (nh("fe80::1"),))]
+            )
+            got = await svc.get_mpls_route_table_by_client(FIB_CLIENT_OPENR)
+            assert [r.top_label for r in got] == [100001]
+            await svc.close()
+
+        run(body())
+
+    def test_error_on_unknown_method(self, agent):
+        async def body():
+            svc = RemoteFibService(port=agent)
+            with pytest.raises(PlatformError, match="unknown method"):
+                await svc._call("noSuchMethod")
+            # connection still usable
+            assert await svc.alive_since() > 0
+            await svc.close()
+
+        run(body())
+
+    def test_agent_unreachable(self):
+        async def body():
+            svc = RemoteFibService(port=1)  # nothing listens there
+            with pytest.raises(PlatformError, match="unreachable"):
+                await svc.alive_since()
+
+        run(body())
+
+
+class TestFibModuleOverAgent:
+    def test_full_fib_pipeline(self, agent):
+        async def body():
+            svc = RemoteFibService(port=agent)
+            route_q, if_q = RWQueue(), RWQueue()
+            fib = Fib(
+                FibConfig(my_node_name="node-1"), svc, route_q, if_q
+            )
+            fib.start()
+
+            async def synced():
+                deadline = asyncio.get_event_loop().time() + 5
+                while not fib.has_synced_fib:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.01)
+
+            await synced()
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        RibUnicastEntry(
+                            prefix=IpPrefix("10.0.0.0/24"),
+                            nexthops={nh("fe80::1")},
+                        )
+                    ],
+                    mpls_routes_to_update=[
+                        RibMplsEntry(
+                            label=100100,
+                            nexthops={nh("fe80::1", label=100101)},
+                        )
+                    ],
+                )
+            )
+            deadline = asyncio.get_event_loop().time() + 5
+            while True:
+                got = await svc.get_route_table_by_client(FIB_CLIENT_OPENR)
+                if {str(r.dest) for r in got} == {"10.0.0.0/24"}:
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            fib.stop()
+            await svc.close()
+
+        run(body())
+
+    def test_agent_restart_detection(self):
+        _ensure_agent()
+
+        async def body():
+            proc, port = spawn_agent(dryrun=True)
+            try:
+                svc = RemoteFibService(port=port)
+                first = await svc.alive_since()
+                assert first > 0
+                proc.kill()
+                proc.wait()
+                # next call fails (connection lost)
+                with pytest.raises(PlatformError):
+                    await svc.alive_since()
+                    await svc.alive_since()
+                # agent comes back on the same port with a new aliveSince
+                await asyncio.sleep(1.1)  # ensure clock tick
+                proc2, _ = spawn_agent(port=port, dryrun=True)
+                try:
+                    second = await svc.alive_since()
+                    assert second != first
+                finally:
+                    proc2.kill()
+                    proc2.wait()
+                await svc.close()
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+
+        run(body())
