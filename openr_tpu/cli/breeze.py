@@ -15,6 +15,14 @@ same command vocabulary:
   breeze prefixmgr view|advertise|withdraw|sync
   breeze monitor counters|logs
   breeze openr version|config
+  breeze perf view                   (fib perf event database — 'breeze perf')
+  breeze config show|dryrun          (running config / validate candidate)
+  breeze tech-support                (one-shot full state dump)
+
+plus `breeze decision path SRC DST` (all shortest paths between two nodes,
+computed client-side from the adjacency dump like
+openr/py/openr/cli/commands/decision.py PathCmd) and `breeze kvstore snoop`
+(stream deltas; the standalone snooper lives in openr_tpu.kvstore.snooper).
 
 Run as: python -m openr_tpu.cli.breeze --host H --port P <module> <cmd> ...
 """
@@ -95,6 +103,19 @@ def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
         )
     elif args.cmd == "areas":
         _print_json(client.call("getAreasConfig"))
+    elif args.cmd == "snoop":
+        for delta in client.subscribe(
+            "subscribeKvStoreFilter",
+            area=args.area,
+            prefixes=[args.prefix] if args.prefix else [],
+        ):
+            for key, val in sorted(delta.get("key_vals", {}).items()):
+                print(
+                    f"{key} v={val['version']} "
+                    f"from={val['originator_id']} ttl={val['ttl']}"
+                )
+            for key in delta.get("expired_keys", []):
+                print(f"{key} EXPIRED")
 
 
 def cmd_decision(client: BlockingCtrlClient, args) -> None:
@@ -139,6 +160,112 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
             _print_table(["Label", "Nexthops"], rows)
     elif args.cmd == "rib-policy":
         _print_json(client.call("getRibPolicy"))
+    elif args.cmd == "path":
+        # all shortest paths src -> dst over the live adjacency dump
+        # (py/openr/cli/commands/decision.py PathCmd equivalent)
+        dbs = client.call("getDecisionAdjacencyDbs")
+        graph = {}  # node -> {neighbor: (metric, iface)}
+        for node, blob in dbs.items():
+            db = decode_obj(blob)
+            for adj in db.adjacencies:
+                if adj.is_overloaded:
+                    continue
+                cur = graph.setdefault(node, {}).get(adj.other_node_name)
+                if cur is None or adj.metric < cur[0]:
+                    graph[node][adj.other_node_name] = (
+                        adj.metric, adj.if_name
+                    )
+        paths = _all_shortest_paths(graph, args.src, args.dst)
+        if not paths:
+            print(f"no path from {args.src} to {args.dst}")
+            return
+        for i, (cost, hops) in enumerate(paths):
+            legs = " -> ".join(
+                f"{a}[{graph[a][b][1]}]" for a, b in zip(hops, hops[1:])
+            )
+            print(f"path {i + 1}: cost {cost}: {legs} -> {args.dst}")
+
+
+def _all_shortest_paths(graph, src, dst, limit=16):
+    """Dijkstra from src, then enumerate up to `limit` equal-cost paths by
+    walking the shortest-path DAG."""
+    import heapq
+
+    dist = {src: 0}
+    pq = [(0, src)]
+    while pq:
+        d, u = heapq.heappop(pq)
+        if d > dist.get(u, float("inf")):
+            continue
+        for v, (w, _) in graph.get(u, {}).items():
+            nd = d + w
+            if nd < dist.get(v, float("inf")):
+                dist[v] = nd
+                heapq.heappush(pq, (nd, v))
+    if dst not in dist:
+        return []
+    paths = []
+
+    def walk(node, acc):
+        if len(paths) >= limit:
+            return
+        if node == dst:
+            paths.append((dist[dst], acc))
+            return
+        for v, (w, _) in sorted(graph.get(node, {}).items()):
+            if dist.get(v) == dist[node] + w and v not in set(acc):
+                walk(v, acc + [v])
+
+    walk(src, [src])
+    return [(c, p) for c, p in paths]
+
+
+def cmd_perf(client: BlockingCtrlClient, args) -> None:
+    perf_db = client.call("getPerfDb")
+    for blob in perf_db:
+        events = blob.get("events", blob) if isinstance(blob, dict) else blob
+        print("PerfEvents:")
+        base = None
+        for ev in events:
+            ts = ev["unix_ts"] if isinstance(ev, dict) else ev[2]
+            name = ev["event_name"] if isinstance(ev, dict) else ev[1]
+            node = ev["node_name"] if isinstance(ev, dict) else ev[0]
+            if base is None:
+                base = ts
+            print(f"  {name:<40} {node:<16} +{(ts - base) * 1000:.1f}ms")
+
+
+def cmd_config(client: BlockingCtrlClient, args) -> None:
+    if args.cmd == "show":
+        _print_json(client.call("getRunningConfig"))
+    elif args.cmd == "dryrun":
+        with open(args.file) as fh:
+            text = fh.read()
+        _print_json(client.call("dryrunConfig", file=text))
+        print("config OK", file=sys.stderr)
+
+
+def cmd_tech_support(client: BlockingCtrlClient, args) -> None:
+    """One-shot dump of everything an operator needs for a bug report
+    (py/openr/cli/clis/tech_support.py equivalent)."""
+    sections = [
+        ("version", lambda: VERSION),
+        ("node", lambda: client.call("getMyNodeName")),
+        ("config", lambda: client.call("getRunningConfig")),
+        ("counters", lambda: client.call("getCounters")),
+        ("interfaces", lambda: client.call("getInterfaces")),
+        ("adjacencies", lambda: client.call("getLinkMonitorAdjacencies")),
+        ("routes", lambda: client.call("getRouteDb")),
+        ("kvstore-keys", lambda: client.call("getKvStoreKeyValsFiltered",
+                                             area="0", prefixes=[])),
+        ("event-logs", lambda: client.call("getEventLogs")),
+    ]
+    for title, fn in sections:
+        print(f"\n==== {title} ====")
+        try:
+            _print_json(fn())
+        except Exception as exc:  # a module may not be wired in
+            print(f"<unavailable: {exc}>")
 
 
 def cmd_fib(client: BlockingCtrlClient, args) -> None:
@@ -293,6 +420,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = kv.add_parser("peers")
     p.add_argument("--area", default="0")
     kv.add_parser("areas")
+    p = kv.add_parser("snoop")
+    p.add_argument("--prefix", default="")
+    p.add_argument("--area", default="0")
 
     dec = sub.add_parser("decision").add_subparsers(dest="cmd", required=True)
     dec.add_parser("adj")
@@ -300,6 +430,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = dec.add_parser("routes")
     p.add_argument("--node", default=None)
     dec.add_parser("rib-policy")
+    p = dec.add_parser("path")
+    p.add_argument("src")
+    p.add_argument("dst")
 
     fib = sub.add_parser("fib").add_subparsers(dest="cmd", required=True)
     p = fib.add_parser("routes")
@@ -336,6 +469,16 @@ def build_parser() -> argparse.ArgumentParser:
     op.add_parser("version")
     op.add_parser("config")
 
+    perf = sub.add_parser("perf").add_subparsers(dest="cmd", required=True)
+    perf.add_parser("view")
+
+    cfg = sub.add_parser("config").add_subparsers(dest="cmd", required=True)
+    cfg.add_parser("show")
+    p = cfg.add_parser("dryrun")
+    p.add_argument("file")
+
+    sub.add_parser("tech-support")
+
     return parser
 
 
@@ -347,6 +490,9 @@ _HANDLERS = {
     "prefixmgr": cmd_prefixmgr,
     "monitor": cmd_monitor,
     "openr": cmd_openr,
+    "perf": cmd_perf,
+    "config": cmd_config,
+    "tech-support": cmd_tech_support,
 }
 
 

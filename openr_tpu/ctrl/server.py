@@ -209,6 +209,46 @@ class CtrlServer:
 
         return enc(self.config.config)
 
+    def m_dryrunConfig(self, params) -> dict:
+        """Validate a candidate config (JSON text) without applying it;
+        returns the parsed config dict or raises
+        (OpenrCtrl.thrift dryrunConfig)."""
+        import json as _json
+
+        from openr_tpu.config import Config
+
+        text = params.get("file")
+        if params.get("path"):
+            with open(params["path"], "r") as fh:
+                text = fh.read()
+        cfg = Config.from_dict(_json.loads(text))
+        saved, self.config = self.config, cfg
+        try:
+            return self.m_getRunningConfig(params)
+        finally:
+            self.config = saved
+
+    def m_processKvStoreDualMessage(self, params) -> None:
+        """Inject a DualMessages batch into the area's KvStore DUAL node
+        (OpenrCtrl.thrift processKvStoreDualMessage)."""
+        assert self.kvstore is not None
+        from openr_tpu.dual import DualMessage, DualMessages, DualMessageType
+
+        msgs = DualMessages(
+            src_id=params["messages"]["src_id"],
+            messages=[
+                DualMessage(
+                    dst_id=m["dst_id"],
+                    distance=int(m["distance"]),
+                    type=DualMessageType[m["type"]]
+                    if isinstance(m["type"], str)
+                    else DualMessageType(m["type"]),
+                )
+                for m in params["messages"]["messages"]
+            ],
+        )
+        self.kvstore.handle_dual_messages(params.get("area", "0"), msgs)
+
     def m_getCounters(self, params) -> Dict[str, int]:
         if self.monitor is not None:
             return self.monitor.get_counters()
@@ -287,6 +327,17 @@ class CtrlServer:
             node: _obj_to_json(db)
             for node, db in self.decision.get_adjacency_databases().items()
         }
+
+    def m_getAllDecisionAdjacencyDbs(self, params) -> List[Any]:
+        """Deprecated list form of getDecisionAdjacencyDbs
+        (OpenrCtrl.thrift getAllDecisionAdjacencyDbs)."""
+        assert self.decision is not None
+        return [
+            _obj_to_json(db)
+            for _, db in sorted(
+                self.decision.get_adjacency_databases().items()
+            )
+        ]
 
     def m_getDecisionPrefixDbs(self, params) -> Dict[str, Any]:
         assert self.decision is not None
@@ -565,6 +616,20 @@ class CtrlServer:
     def m_unsetInterfaceMetric(self, params) -> None:
         assert self.link_monitor is not None
         self.link_monitor.set_link_metric(params["interface"], None)
+
+    def m_setAdjacencyMetric(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_adjacency_metric(
+            params["interface"],
+            params["adjNodeName"],
+            int(params["metric"]),
+        )
+
+    def m_unsetAdjacencyMetric(self, params) -> None:
+        assert self.link_monitor is not None
+        self.link_monitor.set_adjacency_metric(
+            params["interface"], params["adjNodeName"], None
+        )
 
     def m_getInterfaces(self, params) -> Dict[str, Any]:
         assert self.link_monitor is not None

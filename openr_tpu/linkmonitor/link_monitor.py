@@ -103,6 +103,9 @@ class LinkMonitor(CountersMixin):
         self.node_overloaded = False
         self.overloaded_links: Set[str] = set()
         self.link_metric_overrides: Dict[str, int] = {}
+        # (local iface, adjacent node) -> metric; wins over the link-wide
+        # override (LinkMonitor.cpp setAdjacencyMetric)
+        self.adj_metric_overrides: Dict[Tuple[str, str], int] = {}
 
         self._load_state()
         self._adv_throttle = AsyncThrottle(
@@ -144,6 +147,10 @@ class LinkMonitor(CountersMixin):
         self.link_metric_overrides = dict(
             state.get("link_metric_overrides", {})
         )
+        self.adj_metric_overrides = {
+            tuple(key.split("|", 1)): metric
+            for key, metric in state.get("adj_metric_overrides", {}).items()
+        }
 
     def _save_state(self) -> None:
         if self.config_store is None:
@@ -157,6 +164,11 @@ class LinkMonitor(CountersMixin):
                     "link_metric_overrides": dict(
                         self.link_metric_overrides
                     ),
+                    "adj_metric_overrides": {
+                        f"{iface}|{node}": metric
+                        for (iface, node), metric
+                        in self.adj_metric_overrides.items()
+                    },
                 }
             ),
         )
@@ -265,6 +277,11 @@ class LinkMonitor(CountersMixin):
                     self._adv_throttle()
 
     def _metric_for(self, event: NeighborEvent) -> int:
+        adj_override = self.adj_metric_overrides.get(
+            (event.local_if_name, event.node_name)
+        )
+        if adj_override is not None:
+            return adj_override
         if self.config.enable_rtt_metric and event.rtt_us > 0:
             # rtt-based metric: microseconds / 100 (getRttMetric)
             return max(1, event.rtt_us // 100)
@@ -381,6 +398,19 @@ class LinkMonitor(CountersMixin):
         self._rebuild_adjacencies()
         self._adv_throttle()
 
+    def set_adjacency_metric(
+        self, if_name: str, adj_node: str, metric: Optional[int]
+    ) -> None:
+        """Per-adjacency metric override; wins over set_link_metric
+        (LinkMonitor.cpp setAdjacencyMetric/unsetAdjacencyMetric)."""
+        if metric is None:
+            self.adj_metric_overrides.pop((if_name, adj_node), None)
+        else:
+            self.adj_metric_overrides[(if_name, adj_node)] = metric
+        self._save_state()
+        self._rebuild_adjacencies()
+        self._adv_throttle()
+
     def _rebuild_adjacencies(self) -> None:
         from openr_tpu.types import replace
 
@@ -389,6 +419,11 @@ class LinkMonitor(CountersMixin):
             metric = adj.metric
             if not self.config.enable_rtt_metric:
                 metric = self.link_metric_overrides.get(adj.if_name, 1)
+            adj_override = self.adj_metric_overrides.get(
+                (adj.if_name, adj.other_node_name)
+            )
+            if adj_override is not None:
+                metric = adj_override
             entry.adjacency = replace(
                 adj,
                 metric=metric,

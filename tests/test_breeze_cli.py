@@ -99,3 +99,32 @@ def test_monitor_counters(ctrl_endpoint, capsys):
 def test_connection_refused_exit_code(capsys):
     assert breeze("127.0.0.1", 1, "openr", "version") == 1
     assert "cannot connect" in capsys.readouterr().err
+
+
+def test_config_show_and_tech_support(ctrl_endpoint, capsys):
+    host, port = ctrl_endpoint
+    assert breeze(host, port, "config", "show") == 0
+    capsys.readouterr()
+    assert breeze(host, port, "tech-support") == 0
+    out = capsys.readouterr().out
+    assert "==== version ====" in out
+    assert "==== kvstore-keys ====" in out
+    assert "adj:cli-node" in out
+
+
+def test_all_shortest_paths_enumeration():
+    from openr_tpu.cli.breeze import _all_shortest_paths
+
+    # square: a-b-d and a-c-d equal cost; a-d direct is more expensive
+    graph = {
+        "a": {"b": (1, "if-ab"), "c": (1, "if-ac"), "d": (5, "if-ad")},
+        "b": {"d": (1, "if-bd")},
+        "c": {"d": (1, "if-cd")},
+        "d": {},
+    }
+    paths = _all_shortest_paths(graph, "a", "d")
+    assert [(c, p) for c, p in paths] == [
+        (2, ["a", "b", "d"]),
+        (2, ["a", "c", "d"]),
+    ]
+    assert _all_shortest_paths(graph, "d", "a") == []

@@ -223,3 +223,76 @@ class TestRouteApis:
             await server.stop()
 
         run(body())
+
+
+class TestConfigAndMiscApis:
+    def test_dryrun_config_valid_and_invalid(self):
+        import json
+
+        async def body():
+            server, client = await make_server()
+            parsed = await client.call(
+                "dryrunConfig",
+                file=json.dumps(
+                    {"node_name": "n1", "spark_config": {"hello_time_s": 5}}
+                ),
+            )
+            assert parsed["node_name"] == "n1"
+            assert parsed["spark_config"]["hello_time_s"] == 5
+            # running config untouched
+            assert await client.call("getRunningConfig") is None
+            with pytest.raises(CtrlError):
+                await client.call(
+                    "dryrunConfig", file=json.dumps({"bogus_key": 1})
+                )
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_get_all_decision_adjacency_dbs(self):
+        async def body():
+            class FakeDecision:
+                def get_adjacency_databases(self):
+                    return {
+                        "b": AdjacencyDatabase(this_node_name="b"),
+                        "a": AdjacencyDatabase(this_node_name="a"),
+                    }
+
+            server, client = await make_server(decision=FakeDecision())
+            dbs = await client.call("getAllDecisionAdjacencyDbs")
+            names = [decode_obj(blob).this_node_name for blob in dbs]
+            assert names == ["a", "b"]
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_process_kvstore_dual_message(self):
+        async def body():
+            from openr_tpu.kvstore import KvStoreParams
+
+            kv = KvStore(
+                "test-node",
+                ["0"],
+                InProcessTransport(),
+                params=KvStoreParams(
+                    node_id="test-node", enable_flood_optimization=True
+                ),
+            )
+            server, client = await make_server(kvstore=kv)
+            await client.call(
+                "processKvStoreDualMessage",
+                area="0",
+                messages={
+                    "src_id": "peer-1",
+                    "messages": [
+                        {"dst_id": "root-1", "distance": 10,
+                         "type": "UPDATE"},
+                    ],
+                },
+            )
+            await client.close()
+            await server.stop()
+
+        run(body())

@@ -355,6 +355,39 @@ class TestLinkMonitor:
 
         run(body())
 
+    def test_adjacency_metric_override_wins_over_link_metric(self):
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            transport = InProcessTransport()
+            kv_a, spark_a, lm_a = self.make_node("a", net, transport)
+            kv_b, spark_b, lm_b = self.make_node("b", net, transport)
+            lm_a.update_interface("if-a", True)
+            lm_b.update_interface("if-b", True)
+
+            async def until(pred):
+                while not pred():
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(
+                until(lambda: ("b", "if-a") in lm_a.adjacencies), 5
+            )
+            lm_a.set_link_metric("if-a", 42)
+            lm_a.set_adjacency_metric("if-a", "b", 7)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert db.adjacencies[0].metric == 7
+            lm_a.set_adjacency_metric("if-a", "b", None)
+            await asyncio.sleep(0.05)
+            db = serializer.loads(kv_a.get_key(adj_key("a")).value)
+            assert db.adjacencies[0].metric == 42
+            for x in (lm_a, lm_b):
+                x.stop()
+            for s in (spark_a, spark_b):
+                s.stop()
+
+        run(body())
+
     def test_flap_dampening(self):
         async def body():
             net = MockIoNetwork()
