@@ -1,0 +1,255 @@
+// Native KvStore engine implementation. See onl_kvstore.h for the wire
+// format and openr/kvstore/KvStore.cpp:261-411 for the merge semantics
+// being reproduced.
+
+#include "onl_kvstore.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <optional>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kTtlInfinity = -(int64_t(1) << 31);
+
+struct Stored {
+  int64_t version = 0;
+  std::string originator;
+  bool has_value = false;
+  std::string value;
+  int64_t ttl = kTtlInfinity;
+  int64_t ttl_version = 0;
+  bool has_hash = false;
+  int64_t hash = 0;
+};
+
+struct Record {
+  std::string key;
+  Stored v;
+};
+
+struct Store {
+  std::unordered_map<std::string, Stored> map;
+};
+
+// ---------------------------------------------------------------- parsing
+
+class Reader {
+ public:
+  Reader(const uint8_t *buf, size_t len) : p_(buf), end_(buf + len) {}
+
+  bool u8(uint8_t *v) {
+    if (p_ + 1 > end_) return false;
+    *v = *p_++;
+    return true;
+  }
+  bool u32(uint32_t *v) {
+    if (p_ + 4 > end_) return false;
+    std::memcpy(v, p_, 4);
+    p_ += 4;
+    return true;
+  }
+  bool i64(int64_t *v) {
+    if (p_ + 8 > end_) return false;
+    std::memcpy(v, p_, 8);
+    p_ += 8;
+    return true;
+  }
+  bool bytes(std::string *out, uint32_t n) {
+    if (p_ + n > end_) return false;
+    out->assign(reinterpret_cast<const char *>(p_), n);
+    p_ += n;
+    return true;
+  }
+  bool done() const { return p_ == end_; }
+
+ private:
+  const uint8_t *p_;
+  const uint8_t *end_;
+};
+
+bool readRecord(Reader &r, Record *rec) {
+  uint32_t n;
+  uint8_t flag;
+  Stored &v = rec->v;
+  if (!r.u32(&n) || !r.bytes(&rec->key, n)) return false;
+  if (!r.i64(&v.version)) return false;
+  if (!r.u32(&n) || !r.bytes(&v.originator, n)) return false;
+  if (!r.u8(&flag)) return false;
+  v.has_value = flag != 0;
+  if (v.has_value) {
+    if (!r.u32(&n) || !r.bytes(&v.value, n)) return false;
+  }
+  if (!r.i64(&v.ttl)) return false;
+  if (!r.i64(&v.ttl_version)) return false;
+  if (!r.u8(&flag)) return false;
+  v.has_hash = flag != 0;
+  if (v.has_hash && !r.i64(&v.hash)) return false;
+  return true;
+}
+
+class Writer {
+ public:
+  void u8(uint8_t v) { buf_.push_back(v); }
+  void u32(uint32_t v) { append(&v, 4); }
+  void i64(int64_t v) { append(&v, 8); }
+  void bytes(const std::string &s) {
+    u32(static_cast<uint32_t>(s.size()));
+    append(s.data(), s.size());
+  }
+  void record(const std::string &key, const Stored &s) {
+    bytes(key);
+    i64(s.version);
+    bytes(s.originator);
+    u8(s.has_value ? 1 : 0);
+    if (s.has_value) bytes(s.value);
+    i64(s.ttl);
+    i64(s.ttl_version);
+    u8(s.has_hash ? 1 : 0);
+    if (s.has_hash) i64(s.hash);
+  }
+  void raw(const Writer &other) {
+    buf_.insert(buf_.end(), other.buf_.begin(), other.buf_.end());
+  }
+  // Hand the buffer to C: malloc'd copy the caller frees with okv_free.
+  void release(uint8_t **out, size_t *out_len) {
+    *out_len = buf_.size();
+    *out = static_cast<uint8_t *>(std::malloc(buf_.size() ? buf_.size() : 1));
+    if (!buf_.empty()) std::memcpy(*out, buf_.data(), buf_.size());
+  }
+
+ private:
+  void append(const void *p, size_t n) {
+    const uint8_t *b = static_cast<const uint8_t *>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+  std::vector<uint8_t> buf_;
+};
+
+}  // namespace
+
+// ----------------------------------------------------------------- C API
+
+extern "C" {
+
+void *okv_create() { return new Store(); }
+
+void okv_destroy(void *h) { delete static_cast<Store *>(h); }
+
+int okv_merge(void *h, const uint8_t *buf, size_t len, uint8_t **out,
+              size_t *out_len) {
+  auto *store = static_cast<Store *>(h);
+  Reader r(buf, len);
+  uint32_t count;
+  if (!r.u32(&count)) return -1;
+
+  Writer updates;
+  uint32_t accepted = 0;
+  for (uint32_t i = 0; i < count; ++i) {
+    Record rec;
+    if (!readRecord(r, &rec)) return -1;
+
+    const Stored &in = rec.v;
+    // versions start at 1 (KvStore.cpp:277-279)
+    if (in.version < 1) continue;
+    // TTL must be infinite or positive
+    if (in.ttl != kTtlInfinity && in.ttl <= 0) continue;
+
+    auto it = store->map.find(rec.key);
+    const Stored *existing = it == store->map.end() ? nullptr : &it->second;
+    int64_t my_version = existing ? existing->version : 0;
+    if (in.version < my_version) continue;  // stale
+
+    bool update_all = false;
+    bool update_ttl = false;
+    if (in.has_value) {
+      if (in.version > my_version) {
+        update_all = true;
+      } else if (in.originator > existing->originator) {
+        update_all = true;
+      } else if (in.originator == existing->originator) {
+        if (!existing->has_value || in.value > existing->value) {
+          // deterministic winner on divergent same-version values
+          update_all = true;
+        } else if (in.value == existing->value) {
+          if (in.ttl_version > existing->ttl_version) update_ttl = true;
+        }
+      }
+    }
+    // ttl refresh (no value body)
+    if (!in.has_value && existing && in.version == existing->version &&
+        in.originator == existing->originator &&
+        in.ttl_version > existing->ttl_version) {
+      update_ttl = true;
+    }
+
+    if (!update_all && !update_ttl) continue;
+
+    if (update_all) {
+      // caller pre-computes missing hashes
+      store->map[rec.key] = std::move(rec.v);
+    } else {  // update_ttl
+      Stored &s = it->second;
+      s.ttl = in.ttl;
+      s.ttl_version = in.ttl_version;
+    }
+    updates.bytes(rec.key);
+    ++accepted;
+  }
+
+  Writer result;
+  result.u32(accepted);
+  result.raw(updates);
+  result.release(out, out_len);
+  return static_cast<int>(accepted);
+}
+
+int okv_get(void *h, const uint8_t *key, size_t key_len, uint8_t **out,
+            size_t *out_len) {
+  auto *store = static_cast<Store *>(h);
+  std::string k(reinterpret_cast<const char *>(key), key_len);
+  auto it = store->map.find(k);
+  Writer w;
+  if (it == store->map.end()) {
+    w.u32(0);
+    w.release(out, out_len);
+    return 0;
+  }
+  w.u32(1);
+  w.record(k, it->second);
+  w.release(out, out_len);
+  return 1;
+}
+
+int okv_set(void *h, const uint8_t *rec_buf, size_t len) {
+  auto *store = static_cast<Store *>(h);
+  Reader r(rec_buf, len);
+  Record rec;
+  if (!readRecord(r, &rec)) return -1;
+  store->map[rec.key] = std::move(rec.v);
+  return 0;
+}
+
+int okv_erase(void *h, const uint8_t *key, size_t key_len) {
+  auto *store = static_cast<Store *>(h);
+  std::string k(reinterpret_cast<const char *>(key), key_len);
+  return store->map.erase(k) ? 1 : 0;
+}
+
+size_t okv_size(void *h) { return static_cast<Store *>(h)->map.size(); }
+
+int okv_dump(void *h, uint8_t **out, size_t *out_len) {
+  auto *store = static_cast<Store *>(h);
+  Writer w;
+  w.u32(static_cast<uint32_t>(store->map.size()));
+  for (const auto &[key, s] : store->map) w.record(key, s);
+  w.release(out, out_len);
+  return static_cast<int>(store->map.size());
+}
+
+void okv_free(uint8_t *buf) { std::free(buf); }
+
+}  // extern "C"

@@ -1,0 +1,63 @@
+// Native KvStore storage + CRDT merge engine.
+//
+// Behavioral equivalent of the merge core of openr/kvstore/KvStore.cpp:
+//   mergeKeyValues (KvStore.cpp:261-411): higher version wins; same version
+//   -> higher originatorId; same originator -> higher value bytes; identical
+//   value -> retain higher ttlVersion; ttl-refresh updates (no value body)
+//   bump ttl/ttlVersion only.
+//
+// The store is a flat hash table of versioned records; Python talks to it
+// through this C API with a compact little-endian record format (ctypes on
+// the other side — no pybind11 in this image):
+//
+//   record :=
+//     u32 key_len | key bytes
+//     i64 version
+//     u32 originator_len | originator bytes
+//     u8  has_value  [ u32 value_len | value bytes ]
+//     i64 ttl
+//     i64 ttl_version
+//     u8  has_hash   [ i64 hash ]
+//
+//   record_list := u32 count | record*
+//
+// Hashes are computed by the caller (generateHash runs at the originator in
+// the reference too); the engine only compares and stores them.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Opaque store handle.
+void *okv_create();
+void okv_destroy(void *h);
+
+// Merge a record_list into the store. Returns the number of accepted
+// updates and writes their keys (u32 count | (u32 len | key bytes)*) to
+// *out/*out_len (malloc'd; free with okv_free) — the caller already holds
+// the incoming values mergeKeyValues publishes, so only keys cross the
+// boundary. Returns -1 on malformed input.
+int okv_merge(void *h, const uint8_t *buf, size_t len, uint8_t **out,
+              size_t *out_len);
+
+// Fetch one record (record_list of 0 or 1). Returns 1 if found.
+int okv_get(void *h, const uint8_t *key, size_t key_len, uint8_t **out,
+            size_t *out_len);
+
+// Unconditional insert/overwrite of a single record. Returns 0, -1 on
+// malformed input.
+int okv_set(void *h, const uint8_t *rec, size_t len);
+
+// Erase a key. Returns 1 if it existed.
+int okv_erase(void *h, const uint8_t *key, size_t key_len);
+
+size_t okv_size(void *h);
+
+// Dump every record as a record_list (iteration order unspecified).
+int okv_dump(void *h, uint8_t **out, size_t *out_len);
+
+void okv_free(uint8_t *buf);
+
+}  // extern "C"

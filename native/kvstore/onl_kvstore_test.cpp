@@ -1,0 +1,140 @@
+// C++-side unit tests for the native KvStore engine (assert-based; the
+// image has no gtest). Exercises the CRDT ordering rules of
+// openr/kvstore/KvStore.cpp:261-411 directly against the C API, without
+// the Python binding in the loop. Run by tests/test_kvstore_native.py.
+
+#include "onl_kvstore.h"
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kTtlInfinity = -(int64_t(1) << 31);
+
+void putU32(std::vector<uint8_t> &b, uint32_t v) {
+  const uint8_t *p = reinterpret_cast<const uint8_t *>(&v);
+  b.insert(b.end(), p, p + 4);
+}
+void putI64(std::vector<uint8_t> &b, int64_t v) {
+  const uint8_t *p = reinterpret_cast<const uint8_t *>(&v);
+  b.insert(b.end(), p, p + 8);
+}
+void putStr(std::vector<uint8_t> &b, const std::string &s) {
+  putU32(b, static_cast<uint32_t>(s.size()));
+  b.insert(b.end(), s.begin(), s.end());
+}
+
+std::vector<uint8_t> record(const std::string &key, int64_t version,
+                            const std::string &orig, const char *value,
+                            int64_t ttl = kTtlInfinity,
+                            int64_t ttl_version = 0) {
+  std::vector<uint8_t> b;
+  putStr(b, key);
+  putI64(b, version);
+  putStr(b, orig);
+  if (value) {
+    b.push_back(1);
+    putStr(b, value);
+  } else {
+    b.push_back(0);
+  }
+  putI64(b, ttl);
+  putI64(b, ttl_version);
+  b.push_back(0);  // no hash
+  return b;
+}
+
+int mergeOne(void *h, const std::vector<uint8_t> &rec) {
+  std::vector<uint8_t> buf;
+  putU32(buf, 1);
+  buf.insert(buf.end(), rec.begin(), rec.end());
+  uint8_t *out;
+  size_t out_len;
+  int rc = okv_merge(h, buf.data(), buf.size(), &out, &out_len);
+  okv_free(out);
+  return rc;
+}
+
+std::string getValue(void *h, const std::string &key) {
+  uint8_t *out;
+  size_t out_len;
+  int rc = okv_get(h, reinterpret_cast<const uint8_t *>(key.data()),
+                   key.size(), &out, &out_len);
+  assert(rc == 1);
+  // skip: u32 count, u32 klen + key, i64 version, u32 olen + orig
+  const uint8_t *p = out + 4;
+  uint32_t klen;
+  std::memcpy(&klen, p, 4);
+  p += 4 + klen;
+  p += 8;
+  uint32_t olen;
+  std::memcpy(&olen, p, 4);
+  p += 4 + olen;
+  assert(*p == 1);  // has_value
+  ++p;
+  uint32_t vlen;
+  std::memcpy(&vlen, p, 4);
+  p += 4;
+  std::string v(reinterpret_cast<const char *>(p), vlen);
+  okv_free(out);
+  return v;
+}
+
+}  // namespace
+
+int main() {
+  void *h = okv_create();
+
+  // higher version wins
+  assert(mergeOne(h, record("k", 2, "b", "old")) == 1);
+  assert(mergeOne(h, record("k", 1, "z", "zzz")) == 0);
+  assert(mergeOne(h, record("k", 3, "a", "new")) == 1);
+  assert(getValue(h, "k") == "new");
+
+  // same version: higher originator wins
+  assert(mergeOne(h, record("o", 1, "bbb", "x")) == 1);
+  assert(mergeOne(h, record("o", 1, "aaa", "y")) == 0);
+  assert(mergeOne(h, record("o", 1, "ccc", "y")) == 1);
+
+  // same originator: higher value bytes win
+  assert(mergeOne(h, record("v", 1, "a", "mmm")) == 1);
+  assert(mergeOne(h, record("v", 1, "a", "aaa")) == 0);
+  assert(mergeOne(h, record("v", 1, "a", "zzz")) == 1);
+  assert(getValue(h, "v") == "zzz");
+
+  // ttl refresh without body bumps ttl only
+  assert(mergeOne(h, record("t", 1, "a", "body", 5000, 1)) == 1);
+  assert(mergeOne(h, record("t", 1, "a", nullptr, 9000, 2)) == 1);
+  assert(getValue(h, "t") == "body");
+  // stale refresh rejected
+  assert(mergeOne(h, record("t", 1, "a", nullptr, 100, 2)) == 0);
+
+  // invalid version / ttl rejected
+  assert(mergeOne(h, record("bad", 0, "a", "v")) == 0);
+  assert(mergeOne(h, record("bad", 1, "a", "v", 0)) == 0);
+  assert(mergeOne(h, record("bad", 1, "a", "v", -5)) == 0);
+
+  // erase + size + dump
+  assert(okv_size(h) == 4);
+  std::string key = "k";
+  assert(okv_erase(h, reinterpret_cast<const uint8_t *>(key.data()),
+                   key.size()) == 1);
+  assert(okv_size(h) == 3);
+  uint8_t *out;
+  size_t out_len;
+  assert(okv_dump(h, &out, &out_len) == 3);
+  okv_free(out);
+
+  // malformed buffer rejected, store untouched
+  uint8_t junk[7] = {9, 9, 9, 9, 9, 9, 9};
+  assert(okv_merge(h, junk, sizeof(junk), &out, &out_len) == -1);
+  assert(okv_size(h) == 3);
+
+  okv_destroy(h);
+  std::printf("onl_kvstore_test OK\n");
+  return 0;
+}

@@ -223,16 +223,16 @@ def _all_shortest_paths(graph, src, dst, limit=16):
 def cmd_perf(client: BlockingCtrlClient, args) -> None:
     perf_db = client.call("getPerfDb")
     for blob in perf_db:
-        events = blob.get("events", blob) if isinstance(blob, dict) else blob
+        perf = decode_obj(blob)  # PerfEvents; unix_ts already in ms
         print("PerfEvents:")
         base = None
-        for ev in events:
-            ts = ev["unix_ts"] if isinstance(ev, dict) else ev[2]
-            name = ev["event_name"] if isinstance(ev, dict) else ev[1]
-            node = ev["node_name"] if isinstance(ev, dict) else ev[0]
+        for ev in perf.events:
             if base is None:
-                base = ts
-            print(f"  {name:<40} {node:<16} +{(ts - base) * 1000:.1f}ms")
+                base = ev.unix_ts
+            print(
+                f"  {ev.event_descr:<40} {ev.node_name:<16} "
+                f"+{ev.unix_ts - base}ms"
+            )
 
 
 def cmd_config(client: BlockingCtrlClient, args) -> None:
@@ -243,6 +243,19 @@ def cmd_config(client: BlockingCtrlClient, args) -> None:
             text = fh.read()
         _print_json(client.call("dryrunConfig", file=text))
         print("config OK", file=sys.stderr)
+
+
+def _dump_all_areas(client: BlockingCtrlClient):
+    def dump():
+        areas = client.call("getAreasConfig")["areas"]
+        return {
+            area: client.call(
+                "getKvStoreKeyValsFiltered", area=area, prefixes=[]
+            )
+            for area in areas
+        }
+
+    return dump
 
 
 def cmd_tech_support(client: BlockingCtrlClient, args) -> None:
@@ -256,8 +269,7 @@ def cmd_tech_support(client: BlockingCtrlClient, args) -> None:
         ("interfaces", lambda: client.call("getInterfaces")),
         ("adjacencies", lambda: client.call("getLinkMonitorAdjacencies")),
         ("routes", lambda: client.call("getRouteDb")),
-        ("kvstore-keys", lambda: client.call("getKvStoreKeyValsFiltered",
-                                             area="0", prefixes=[])),
+        ("kvstore-keys", _dump_all_areas(client)),
         ("event-logs", lambda: client.call("getEventLogs")),
     ]
     for title, fn in sections:

@@ -77,6 +77,25 @@ def _publication_to_json(pub: Publication) -> Dict[str, Any]:
     }
 
 
+def _encode_config(config) -> dict:
+    """Serialize a Config's OpenrConfig dataclass tree to plain JSON."""
+    import dataclasses
+
+    def enc(obj):
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            return {
+                f.name: enc(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            }
+        if isinstance(obj, (list, tuple)):
+            return [enc(x) for x in obj]
+        if hasattr(obj, "name") and hasattr(obj, "value"):
+            return obj.name  # enum
+        return obj
+
+    return enc(config.config)
+
+
 def _obj_to_json(obj: Any) -> Any:
     """Wire dataclasses ride the deterministic serializer as b64 blobs."""
     return _b64(serializer.dumps(obj))
@@ -193,21 +212,7 @@ class CtrlServer:
     def m_getRunningConfig(self, params) -> Optional[dict]:
         if self.config is None:
             return None
-        import dataclasses
-
-        def enc(obj):
-            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-                return {
-                    f.name: enc(getattr(obj, f.name))
-                    for f in dataclasses.fields(obj)
-                }
-            if isinstance(obj, (list, tuple)):
-                return [enc(x) for x in obj]
-            if hasattr(obj, "name") and hasattr(obj, "value"):
-                return obj.name  # enum
-            return obj
-
-        return enc(self.config.config)
+        return _encode_config(self.config)
 
     def m_dryrunConfig(self, params) -> dict:
         """Validate a candidate config (JSON text) without applying it;
@@ -221,12 +226,7 @@ class CtrlServer:
         if params.get("path"):
             with open(params["path"], "r") as fh:
                 text = fh.read()
-        cfg = Config.from_dict(_json.loads(text))
-        saved, self.config = self.config, cfg
-        try:
-            return self.m_getRunningConfig(params)
-        finally:
-            self.config = saved
+        return _encode_config(Config.from_dict(_json.loads(text)))
 
     def m_processKvStoreDualMessage(self, params) -> None:
         """Inject a DualMessages batch into the area's KvStore DUAL node

@@ -52,6 +52,9 @@ def merge_key_values(
     filters: Optional["KvStoreFilters"] = None,
 ) -> KeyVals:
     """Merge key_vals into store; return the accepted updates to flood."""
+    native_merge = getattr(store, "native_merge", None)
+    if native_merge is not None:
+        return native_merge(key_vals, filters)
     updates: KeyVals = {}
     for key, value in key_vals.items():
         if filters is not None and not filters.key_match(key, value):
@@ -260,6 +263,10 @@ class KvStoreParams:
     # the full peer mesh (KvstoreConfig.enable_flood_optimization)
     enable_flood_optimization: bool = False
     is_flood_root: bool = False
+    # keep the key->Value table and CRDT merge in the native C++ engine
+    # (native/kvstore); falls back to the Python dict if the library is
+    # unavailable
+    use_native_store: bool = False
 
 
 class KvStoreDb(CountersMixin):
@@ -277,6 +284,14 @@ class KvStoreDb(CountersMixin):
         self.updates_queue = updates_queue
         self._loop = loop
         self.store: KeyVals = {}
+        if params.use_native_store:
+            from openr_tpu.kvstore.native import (
+                NativeKvTable,
+                native_kv_available,
+            )
+
+            if native_kv_available():
+                self.store = NativeKvTable()
         self.peers: Dict[str, _Peer] = {}
         self._ttl_heap: List[_TtlEntry] = []
         # per-key write epoch: bumped on every accepted update so TTL heap

@@ -128,3 +128,27 @@ def test_all_shortest_paths_enumeration():
         (2, ["a", "c", "d"]),
     ]
     assert _all_shortest_paths(graph, "d", "a") == []
+
+
+def test_perf_view_renders_events(capsys):
+    from openr_tpu.cli.breeze import cmd_perf
+    from openr_tpu.ctrl.client import encode_obj
+    from openr_tpu.types import PerfEvent, PerfEvents
+
+    perf = PerfEvents(
+        events=[
+            PerfEvent("node-a", "DECISION_RECEIVED", 1000),
+            PerfEvent("node-a", "ROUTE_UPDATE", 1003),
+        ]
+    )
+
+    class StubClient:
+        def call(self, method, **params):
+            assert method == "getPerfDb"
+            return [encode_obj(perf)]
+
+    cmd_perf(StubClient(), None)
+    out = capsys.readouterr().out
+    assert "DECISION_RECEIVED" in out
+    assert "+0ms" in out
+    assert "+3ms" in out
