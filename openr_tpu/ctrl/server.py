@@ -24,6 +24,7 @@ import json
 import logging
 from typing import Any, Callable, Dict, List, Optional
 
+from openr_tpu.kvstore import wire
 from openr_tpu.messaging import QueueClosedError
 from openr_tpu.types import (
     ADJ_DB_MARKER,
@@ -45,34 +46,18 @@ def _unb64(text: Optional[str]) -> Optional[bytes]:
     return None if text is None else base64.b64decode(text)
 
 
-def _value_to_json(v: Value) -> Dict[str, Any]:
-    return {
-        "version": v.version,
-        "originator_id": v.originator_id,
-        "value": _b64(v.value),
-        "ttl": v.ttl,
-        "ttl_version": v.ttl_version,
-        "hash": v.hash,
-    }
-
-
-def _value_from_json(d: Dict[str, Any]) -> Value:
-    return Value(
-        version=d["version"],
-        originator_id=d["originator_id"],
-        value=_unb64(d.get("value")),
-        ttl=d.get("ttl", -(2**31)),
-        ttl_version=d.get("ttl_version", 0),
-        hash=d.get("hash"),
-    )
+# Value codecs are shared with the TCP peer protocol (kvstore/wire.py) so
+# the ctrl API and peer wire format cannot drift apart
+_value_to_json = wire.value_to_json
+_value_from_json = wire.value_from_json
 
 
 def _publication_to_json(pub: Publication) -> Dict[str, Any]:
+    """Subscriber-facing publication: node_ids/tobe_updated_keys (peer-sync
+    internals) are intentionally omitted."""
     return {
         "area": pub.area,
-        "key_vals": {
-            k: _value_to_json(v) for k, v in pub.key_vals.items()
-        },
+        "key_vals": wire.key_vals_to_json(pub.key_vals),
         "expired_keys": list(pub.expired_keys),
     }
 

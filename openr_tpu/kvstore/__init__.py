@@ -19,6 +19,7 @@ from openr_tpu.kvstore.store import (
     merge_key_values,
 )
 from openr_tpu.kvstore.transport import InProcessTransport, KvStoreTransport
+from openr_tpu.kvstore.tcp import KvStoreTcpServer, TcpTransport
 from openr_tpu.kvstore.client import KvStoreClient
 
 __all__ = [
@@ -33,4 +34,6 @@ __all__ = [
     "merge_key_values",
     "InProcessTransport",
     "KvStoreTransport",
+    "KvStoreTcpServer",
+    "TcpTransport",
 ]

@@ -1,0 +1,101 @@
+"""JSON wire codecs for the KvStore peer protocol.
+
+The reference ships thrift-serialized structs between stores
+(openr/if/KvStore.thrift: Value:20, Publication:228, openr/if/Dual.thrift
+DualMessages); the TCP peer transport here (openr_tpu.kvstore.tcp) carries
+the same fields as newline-delimited JSON, with value bytes base64-encoded.
+Full fidelity matters: node_ids (flood loop prevention) and
+tobe_updated_keys (3-way sync) must round-trip exactly.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.dual.dual import DualMessage, DualMessages, DualMessageType
+from openr_tpu.types import TTL_INFINITY, KeyVals, Publication, Value
+
+
+def _b64(data: Optional[bytes]) -> Optional[str]:
+    return None if data is None else base64.b64encode(data).decode()
+
+
+def _unb64(text: Optional[str]) -> Optional[bytes]:
+    return None if text is None else base64.b64decode(text)
+
+
+def value_to_json(v: Value) -> Dict[str, Any]:
+    return {
+        "version": v.version,
+        "originator_id": v.originator_id,
+        "value": _b64(v.value),
+        "ttl": v.ttl,
+        "ttl_version": v.ttl_version,
+        "hash": v.hash,
+    }
+
+
+def value_from_json(d: Dict[str, Any]) -> Value:
+    return Value(
+        version=d["version"],
+        originator_id=d["originator_id"],
+        value=_unb64(d.get("value")),
+        ttl=d.get("ttl", TTL_INFINITY),
+        ttl_version=d.get("ttl_version", 0),
+        hash=d.get("hash"),
+    )
+
+
+def key_vals_to_json(kv: KeyVals) -> Dict[str, Any]:
+    return {k: value_to_json(v) for k, v in kv.items()}
+
+
+def key_vals_from_json(d: Optional[Dict[str, Any]]) -> KeyVals:
+    if not d:
+        return {}
+    return {k: value_from_json(v) for k, v in d.items()}
+
+
+def publication_to_json(pub: Publication) -> Dict[str, Any]:
+    return {
+        "key_vals": key_vals_to_json(pub.key_vals),
+        "expired_keys": list(pub.expired_keys),
+        "node_ids": pub.node_ids,
+        "tobe_updated_keys": pub.tobe_updated_keys,
+        "area": pub.area,
+    }
+
+
+def publication_from_json(d: Dict[str, Any]) -> Publication:
+    return Publication(
+        key_vals=key_vals_from_json(d.get("key_vals")),
+        expired_keys=list(d.get("expired_keys") or []),
+        node_ids=d.get("node_ids"),
+        tobe_updated_keys=d.get("tobe_updated_keys"),
+        area=d.get("area", "0"),
+    )
+
+
+def dual_messages_to_json(msgs: DualMessages) -> Dict[str, Any]:
+    return {
+        "src_id": msgs.src_id,
+        "messages": [
+            {"dst_id": m.dst_id, "distance": m.distance, "type": m.type.name}
+            for m in msgs.messages
+        ],
+    }
+
+
+def dual_messages_from_json(d: Dict[str, Any]) -> DualMessages:
+    return DualMessages(
+        src_id=d.get("src_id", ""),
+        messages=[
+            DualMessage(
+                dst_id=m["dst_id"],
+                distance=m["distance"],
+                type=DualMessageType[m["type"]],
+            )
+            for m in d.get("messages") or []
+        ],
+    )

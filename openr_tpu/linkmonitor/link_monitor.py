@@ -45,6 +45,11 @@ class LinkMonitorConfig:
     flap_max_backoff: float = 1.0
     adv_throttle: float = 0.005  # advertisement coalescing window
     areas: List[str] = field(default_factory=lambda: ["0"])
+    # KvStore peer addressing (createPeerSpec, LinkMonitor.cpp:60-74):
+    # "node_id"  — in-process transport, peers addressed by node name
+    # "tcp"      — real sockets: "host:port" from the Spark handshake's
+    #              transport address + kvstore_cmd_port
+    peer_addr_mode: str = "node_id"
 
 
 class InterfaceEntry:
@@ -75,6 +80,7 @@ class _AdjacencyEntry:
     adjacency: Adjacency
     area: str
     is_restarting: bool = False
+    peer_addr: str = ""  # KvStore transport address for this neighbor
 
 
 class LinkMonitor(CountersMixin):
@@ -304,11 +310,28 @@ class LinkMonitor(CountersMixin):
             nexthop_v6=event.transport_address_v6,
         )
 
+    def _peer_addr_for(self, event: NeighborEvent) -> str:
+        """KvStore transport address for a discovered neighbor."""
+        if self.config.peer_addr_mode == "tcp":
+            # fall back to v4 first: a v6 transport address is typically
+            # link-local (fe80::) whose scope id cannot ride "host:port"
+            host = (
+                event.kvstore_host
+                or event.transport_address_v4
+                or event.transport_address_v6
+            )
+            return f"{host}:{event.kvstore_cmd_port}"
+        return event.node_name
+
     def _neighbor_up(self, event: NeighborEvent) -> None:
         self._bump("link_monitor.neighbor_up")
         area = event.area or "0"
         self.adjacencies[(event.node_name, event.local_if_name)] = (
-            _AdjacencyEntry(self._make_adjacency(event), area)
+            _AdjacencyEntry(
+                self._make_adjacency(event),
+                area,
+                peer_addr=self._peer_addr_for(event),
+            )
         )
         self._advertise_kvstore_peers()
         self._adv_throttle()
@@ -331,7 +354,7 @@ class LinkMonitor(CountersMixin):
             for (node, _), entry in self.adjacencies.items():
                 if entry.area != area:
                     continue
-                desired[node] = PeerSpec(peer_addr=node)
+                desired[node] = PeerSpec(peer_addr=entry.peer_addr or node)
             current = self.kvstore.dbs[area].get_peers()
             to_del = [n for n in current if n not in desired]
             to_add = {

@@ -49,10 +49,21 @@ class OpenrDaemon:
         fib_service=None,
         config_store_path: Optional[str] = None,
         ctrl_port: Optional[int] = None,
+        kvstore_host: str = "127.0.0.1",
+        kvstore_port: int = 0,
         loop: Optional[asyncio.AbstractEventLoop] = None,
     ) -> None:
+        from openr_tpu.kvstore import KvStoreTcpServer, TcpTransport
+
         self.config = config
         self._loop = loop
+        # real-socket deployment: when KvStore peers over TCP, this daemon
+        # must also *serve* the peer RPC surface, Spark must advertise the
+        # serving port in its handshake, and LinkMonitor must peer by
+        # host:port instead of node id
+        self._kv_tcp = isinstance(kv_transport, TcpTransport)
+        self._kv_transport = kv_transport
+        self.kvstore_server: Optional[KvStoreTcpServer] = None
         c = config.config
         node = c.node_name
         areas = config.get_area_ids()
@@ -110,6 +121,10 @@ class OpenrDaemon:
             ),
             loop=loop,
         )
+        if self._kv_tcp:
+            self.kvstore_server = KvStoreTcpServer(
+                self.kvstore, host=kvstore_host, port=kvstore_port
+            )
         self.kvstore_client = KvStoreClient(self.kvstore, node, loop)
 
         # --- prefix manager -------------------------------------------
@@ -182,6 +197,7 @@ class OpenrDaemon:
                 keepalive_time=sc.keepalive_time_s,
                 hold_time=sc.hold_time_s,
                 graceful_restart_time=sc.graceful_restart_time_s,
+                **({"kvstore_host": kvstore_host} if self._kv_tcp else {}),
             ),
             io_provider,
             self.neighbor_updates_queue,
@@ -197,6 +213,7 @@ class OpenrDaemon:
                 flap_initial_backoff=lmc.linkflap_initial_backoff_ms / 1000,
                 flap_max_backoff=lmc.linkflap_max_backoff_ms / 1000,
                 areas=areas,
+                peer_addr_mode="tcp" if self._kv_tcp else "node_id",
             ),
             self.neighbor_updates_queue.get_reader(),
             self.kvstore,
@@ -287,6 +304,11 @@ class OpenrDaemon:
 
     async def start(self) -> int:
         """Start modules in dependency order; returns the ctrl port."""
+        if self.kvstore_server is not None:
+            # serve KvStore peering before anyone can discover us; the
+            # bound (possibly ephemeral) port goes into Spark's handshake
+            await self.kvstore_server.start()
+            self.spark.config.kvstore_cmd_port = self.kvstore_server.port
         self.monitor.start()
         if self.watchdog is not None:
             for name in ("kvstore", "decision", "fib", "link_monitor"):
@@ -339,6 +361,10 @@ class OpenrDaemon:
             self.prefix_allocator.stop()
         self.prefix_manager.stop()
         self.kvstore_client.stop()
+        if self.kvstore_server is not None:
+            await self.kvstore_server.stop()
+        if self._kv_tcp:
+            self._kv_transport.close()  # persistent peer connections
         self.kvstore.stop()
         if self.watchdog is not None:
             self.watchdog.stop()

@@ -13,7 +13,12 @@ from openr_tpu.spark.messages import (
     SparkHeartbeatMsg,
     ReflectedNeighborInfo,
 )
-from openr_tpu.spark.io_provider import IoProvider, MockIoNetwork, MockIoProvider
+from openr_tpu.spark.io_provider import (
+    IoProvider,
+    MockIoNetwork,
+    MockIoProvider,
+    UdpIoProvider,
+)
 from openr_tpu.spark.spark import (
     NeighborEvent,
     NeighborEventType,
@@ -31,6 +36,7 @@ __all__ = [
     "IoProvider",
     "MockIoNetwork",
     "MockIoProvider",
+    "UdpIoProvider",
     "NeighborEvent",
     "NeighborEventType",
     "Spark",

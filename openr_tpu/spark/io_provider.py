@@ -39,6 +39,201 @@ class IoProvider:
         return int(time.monotonic() * 1_000_000)
 
 
+class UdpIoProvider(IoProvider):
+    """Real UDP multicast provider (the production IoProvider).
+
+    One socket per interface, bound to the Spark port and joined to the
+    discovery multicast group on that interface — the reference's
+    ff02::1:6666 scheme (openr/common/Constants.h:132, Spark.h:424), with
+    an IPv4 group supported for environments without usable link-local
+    IPv6 (e.g. loopback in containers, where same-host instances share the
+    port via SO_REUSEPORT and the kernel delivers the group to every
+    member). Receive timestamps are taken at datagram arrival — the
+    userspace stand-in for the reference's kernel timestamps
+    (spark/IoProvider.h recvfrom with SO_TIMESTAMPNS).
+    """
+
+    def __init__(
+        self,
+        port: int = 6666,
+        group: str = "ff02::1",
+        *,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.port = port
+        self.group = group
+        self._v6 = ":" in group
+        self._loop = loop
+        self._callback = None
+        # if_name -> (socket, asyncio transport, ifindex or None)
+        self._endpoints: Dict[str, Tuple[object, object, Optional[int]]] = {}
+        self._opening: set = set()  # interfaces with an open in flight
+        self._closed = False
+
+    # -- socket plumbing -------------------------------------------------
+
+    def _make_socket(self, if_name: str):
+        import socket
+        import struct
+
+        if self._v6:
+            sock = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind(("::", self.port))
+            ifindex = socket.if_nametoindex(if_name)
+            mreq = socket.inet_pton(
+                socket.AF_INET6, self.group
+            ) + struct.pack("@I", ifindex)
+            sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_JOIN_GROUP, mreq)
+            sock.setsockopt(
+                socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_IF, ifindex
+            )
+            sock.setsockopt(
+                socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_LOOP, 1
+            )
+            sock.setsockopt(
+                socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_HOPS, 1
+            )
+        else:
+            if_addr = _ipv4_addr_of(if_name)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind(("", self.port))
+            mreq = socket.inet_aton(self.group) + socket.inet_aton(if_addr)
+            sock.setsockopt(
+                socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq
+            )
+            sock.setsockopt(
+                socket.IPPROTO_IP,
+                socket.IP_MULTICAST_IF,
+                socket.inet_aton(if_addr),
+            )
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+        try:
+            # attribute arrivals to the right interface on multi-homed
+            # hosts (the reference binds one socket per interface too)
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_BINDTODEVICE, if_name.encode()
+            )
+        except (OSError, AttributeError):
+            pass  # unprivileged: wildcard-bound socket still works
+        sock.setblocking(False)
+        return sock
+
+    async def add_interface(self, if_name: str) -> None:
+        """Open + join the multicast socket for one interface."""
+        if if_name in self._endpoints or self._closed:
+            return
+        import socket as socket_mod
+
+        from openr_tpu.spark.messages import packet_from_bytes
+
+        sock = self._make_socket(if_name)
+        ifindex = (
+            socket_mod.if_nametoindex(if_name) if self._v6 else None
+        )
+        loop = self._loop or asyncio.get_event_loop()
+        provider = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data: bytes, addr) -> None:
+                callback = provider._callback
+                if callback is None:
+                    return
+                try:
+                    packet = packet_from_bytes(data)
+                except (ValueError, KeyError, TypeError, AttributeError):
+                    return  # not a Spark packet; ignore
+                callback(
+                    ReceivedPacket(
+                        if_name=if_name,
+                        packet=packet,
+                        recv_ts_us=provider.now_us(),
+                    )
+                )
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _Proto, sock=sock
+        )
+        if self._closed:  # closed while this open was in flight
+            transport.close()
+            return
+        self._endpoints[if_name] = (sock, transport, ifindex)
+
+    def close(self) -> None:
+        self._closed = True
+        self._callback = None
+        for _, transport, _ifindex in self._endpoints.values():
+            transport.close()
+        self._endpoints.clear()
+        self._opening.clear()
+
+    # -- IoProvider surface ----------------------------------------------
+
+    def set_receiver(self, instance_id: str, callback) -> None:
+        self._callback = callback
+
+    def send(self, if_name: str, packet: SparkHelloPacket) -> int:
+        from openr_tpu.spark.messages import packet_to_bytes
+
+        endpoint = self._endpoints.get(if_name)
+        now = self.now_us()
+        if endpoint is None:
+            # first send on an unopened interface: schedule the socket
+            # open and drop this packet — Spark's fast-init hello timer
+            # retries within tens of ms (Spark.cpp fast-init cadence)
+            if if_name not in self._opening:
+                self._opening.add(if_name)
+
+                async def _open() -> None:
+                    try:
+                        await self.add_interface(if_name)
+                    except OSError as exc:
+                        # interface down / unaddressed: next send retries
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "spark: open %s failed: %s", if_name, exc
+                        )
+                    finally:
+                        self._opening.discard(if_name)
+
+                loop = self._loop or asyncio.get_event_loop()
+                loop.create_task(_open())
+            return now
+        _sock, transport, ifindex = endpoint
+        data = packet_to_bytes(packet)
+        if self._v6:
+            transport.sendto(data, (self.group, self.port, 0, ifindex))
+        else:
+            transport.sendto(data, (self.group, self.port))
+        return now
+
+
+def _ipv4_addr_of(if_name: str) -> str:
+    """Primary IPv4 address of an interface (for IP_MULTICAST_IF)."""
+    if if_name == "lo":
+        return "127.0.0.1"
+    import fcntl
+    import socket
+    import struct
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # SIOCGIFADDR
+        packed = fcntl.ioctl(
+            sock.fileno(),
+            0x8915,
+            struct.pack("256s", if_name[:15].encode()),
+        )
+        return socket.inet_ntoa(packed[20:24])
+    finally:
+        sock.close()
+
+
 class MockIoNetwork:
     """Shared virtual network: connects (instance, iface) endpoints in
     pairs with per-link latency (ConnectedIfPairs)."""

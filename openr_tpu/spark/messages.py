@@ -47,6 +47,9 @@ class SparkHandshakeMsg:
     kvstore_cmd_port: int
     area: str
     neighbor_node_name: Optional[str] = None
+    # host where this node's KvStore peer RPC listens (TCP deployments);
+    # distinct from the data-plane transport addresses above
+    kvstore_host: str = ""
 
 
 @dataclass
@@ -62,3 +65,49 @@ class SparkHelloPacket:
     hello_msg: Optional[SparkHelloMsg] = None
     handshake_msg: Optional[SparkHandshakeMsg] = None
     heartbeat_msg: Optional[SparkHeartbeatMsg] = None
+
+
+# ---------------------------------------------------------------------------
+# Wire codec — the reference serializes SparkHelloPacket with thrift compact
+# protocol onto the UDP multicast socket (Spark.cpp sendHelloMsg); here the
+# envelope rides JSON (one datagram per packet).
+# ---------------------------------------------------------------------------
+
+
+def packet_to_bytes(packet: SparkHelloPacket) -> bytes:
+    import dataclasses
+    import json
+
+    return json.dumps(
+        dataclasses.asdict(packet), separators=(",", ":")
+    ).encode()
+
+
+def packet_from_bytes(data: bytes) -> SparkHelloPacket:
+    import json
+
+    d = json.loads(data)
+    hello = d.get("hello_msg")
+    handshake = d.get("handshake_msg")
+    heartbeat = d.get("heartbeat_msg")
+    return SparkHelloPacket(
+        hello_msg=(
+            SparkHelloMsg(
+                **{
+                    **hello,
+                    "neighbor_infos": {
+                        k: ReflectedNeighborInfo(**v)
+                        for k, v in (hello.get("neighbor_infos") or {}).items()
+                    },
+                }
+            )
+            if hello is not None
+            else None
+        ),
+        handshake_msg=(
+            SparkHandshakeMsg(**handshake) if handshake is not None else None
+        ),
+        heartbeat_msg=(
+            SparkHeartbeatMsg(**heartbeat) if heartbeat is not None else None
+        ),
+    )

@@ -97,6 +97,7 @@ class NeighborEvent:
     transport_address_v4: str = ""
     transport_address_v6: str = ""
     kvstore_cmd_port: int = 0
+    kvstore_host: str = ""
     openr_ctrl_thrift_port: int = 0
 
 
@@ -119,6 +120,7 @@ class SparkConfig:
     transport_address_v4: str = "169.254.0.1"
     transport_address_v6: str = "fe80::1"
     kvstore_cmd_port: int = 60002
+    kvstore_host: str = ""  # KvStore peer-RPC host (TCP deployments)
     openr_ctrl_thrift_port: int = 2018
     node_label: int = 0
 
@@ -151,6 +153,7 @@ class _Neighbor:
         self.transport_address_v4 = ""
         self.transport_address_v6 = ""
         self.kvstore_cmd_port = 0
+        self.kvstore_host = ""
         self.openr_ctrl_thrift_port = 0
         # reflected timestamps for the hello we send back
         self.last_nbr_msg_sent_ts_us = 0
@@ -336,6 +339,7 @@ class Spark(CountersMixin):
                     transport_address_v4=self.config.transport_address_v4,
                     openr_ctrl_thrift_port=self.config.openr_ctrl_thrift_port,
                     kvstore_cmd_port=self.config.kvstore_cmd_port,
+                    kvstore_host=self.config.kvstore_host,
                     area=area if area is not None else "",
                     neighbor_node_name=neighbor.node_name,
                 )
@@ -485,6 +489,7 @@ class Spark(CountersMixin):
                             self.config.openr_ctrl_thrift_port
                         ),
                         kvstore_cmd_port=self.config.kvstore_cmd_port,
+                        kvstore_host=self.config.kvstore_host,
                         area=area if area is not None else "",
                         neighbor_node_name=msg.node_name,
                     )
@@ -507,6 +512,7 @@ class Spark(CountersMixin):
         neighbor.transport_address_v4 = msg.transport_address_v4
         neighbor.transport_address_v6 = msg.transport_address_v6
         neighbor.kvstore_cmd_port = msg.kvstore_cmd_port
+        neighbor.kvstore_host = msg.kvstore_host
         neighbor.openr_ctrl_thrift_port = msg.openr_ctrl_thrift_port
         neighbor.fsm(SparkNeighEvent.HANDSHAKE_RCVD)
         neighbor.cancel_timers()
@@ -581,6 +587,7 @@ class Spark(CountersMixin):
                 transport_address_v4=neighbor.transport_address_v4,
                 transport_address_v6=neighbor.transport_address_v6,
                 kvstore_cmd_port=neighbor.kvstore_cmd_port,
+                kvstore_host=neighbor.kvstore_host,
                 openr_ctrl_thrift_port=neighbor.openr_ctrl_thrift_port,
             )
         )

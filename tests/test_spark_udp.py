@@ -1,0 +1,176 @@
+"""Spark discovery over real UDP multicast sockets.
+
+The reference discovers neighbors with UDP multicast hellos on ff02::1:6666
+(openr/common/Constants.h:132); these tests run the same 3-message protocol
+(hello / handshake / heartbeat) through UdpIoProvider on a loopback IPv4
+multicast group — first two Spark instances in one process (distinct
+sockets in one SO_REUSEPORT group), then against a Spark in a separate OS
+process, proving the packets really cross the kernel.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.spark import NeighborEventType, Spark, SparkConfig
+from openr_tpu.spark.io_provider import UdpIoProvider
+from openr_tpu.spark.messages import (
+    SparkHelloMsg,
+    SparkHelloPacket,
+    packet_from_bytes,
+    packet_to_bytes,
+)
+
+GROUP = "239.88.77.66"
+
+
+def run(coro, timeout=30.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def fast_config(name, **kw):
+    return SparkConfig(
+        node_name=name,
+        fastinit_hello_time=0.02,
+        hello_time=0.5,
+        handshake_time=0.02,
+        keepalive_time=0.05,
+        hold_time=0.5,
+        graceful_restart_time=0.5,
+        negotiate_hold_time=0.3,
+        **kw,
+    )
+
+
+async def wait_event(reader, event_type, timeout=10.0):
+    while True:
+        ev = await asyncio.wait_for(reader.get(), timeout)
+        if ev.event_type == event_type:
+            return ev
+
+
+def test_packet_codec_roundtrip():
+    packet = SparkHelloPacket(
+        hello_msg=SparkHelloMsg(
+            domain_name="d",
+            node_name="n",
+            if_name="lo",
+            seq_num=7,
+            sent_ts_in_us=123,
+        )
+    )
+    decoded = packet_from_bytes(packet_to_bytes(packet))
+    assert decoded == packet
+
+
+class TestUdpDiscovery:
+    def test_two_instances_same_host(self):
+        async def body():
+            port = 26660 + os.getpid() % 1000
+            providers, sparks, readers = [], [], []
+            for name in ("a", "b"):
+                io = UdpIoProvider(port=port, group=GROUP)
+                await io.add_interface("lo")
+                q = ReplicateQueue()
+                spark = Spark(fast_config(name), io, q)
+                providers.append(io)
+                sparks.append(spark)
+                readers.append(q.get_reader())
+                spark.update_interfaces(["lo"])
+            up_a = await wait_event(readers[0], NeighborEventType.NEIGHBOR_UP)
+            up_b = await wait_event(readers[1], NeighborEventType.NEIGHBOR_UP)
+            assert up_a.node_name == "b"
+            assert up_b.node_name == "a"
+            assert up_a.local_if_name == "lo"
+            for spark in sparks:
+                spark.stop()
+            for io in providers:
+                io.close()
+
+        run(body())
+
+    def test_neighbor_down_on_process_exit(self):
+        """Cross-process: discover a Spark in another OS process, then see
+        it expire (hold timer) when that process dies."""
+        port = 27660 + os.getpid() % 1000
+        child_script = f"""
+import asyncio
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.spark import Spark, SparkConfig
+from openr_tpu.spark.io_provider import UdpIoProvider
+
+
+async def main():
+    io = UdpIoProvider(port={port}, group="{GROUP}")
+    await io.add_interface("lo")
+    q = ReplicateQueue()
+    spark = Spark(
+        SparkConfig(
+            node_name="remote",
+            fastinit_hello_time=0.02,
+            hello_time=0.5,
+            handshake_time=0.02,
+            keepalive_time=0.05,
+            hold_time=0.5,
+            graceful_restart_time=0.5,
+            negotiate_hold_time=0.3,
+        ),
+        io,
+        q,
+    )
+    reader = q.get_reader()
+    spark.update_interfaces(["lo"])
+    while True:
+        ev = await reader.get()
+        if ev.event_type.name == "NEIGHBOR_UP":
+            print("UP", ev.node_name, flush=True)
+            await asyncio.sleep(3600)
+
+
+asyncio.new_event_loop().run_until_complete(main())
+"""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, [os.getcwd(), env.get("PYTHONPATH")])
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        child = subprocess.Popen(
+            [sys.executable, "-c", child_script],
+            stdout=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        try:
+
+            async def body():
+                io = UdpIoProvider(port=port, group=GROUP)
+                await io.add_interface("lo")
+                q = ReplicateQueue()
+                spark = Spark(fast_config("local"), io, q)
+                reader = q.get_reader()
+                spark.update_interfaces(["lo"])
+                up = await wait_event(reader, NeighborEventType.NEIGHBOR_UP)
+                assert up.node_name == "remote"
+                # the child saw us too
+                line = child.stdout.readline().strip()
+                assert line == "UP local", line
+                # kill the child; its heartbeats stop; hold timer expires
+                child.kill()
+                down = await wait_event(
+                    reader, NeighborEventType.NEIGHBOR_DOWN
+                )
+                assert down.node_name == "remote"
+                spark.stop()
+                io.close()
+
+            run(body())
+        finally:
+            child.kill()
+            child.wait(timeout=10)

@@ -1,0 +1,142 @@
+"""Whole-stack convergence over REAL sockets.
+
+The mock-fabric system tests (test_system.py) prove protocol logic; this
+one proves deployment plumbing: two full OpenrDaemons in one process whose
+Sparks discover each other through genuine UDP multicast datagrams on
+loopback and whose KvStores peer over genuine TCP connections on ephemeral
+ports — discovery → handshake (advertising each store's TCP port) →
+KvStore full sync → adjacency/prefix flood → SPF → FIB programming, with
+zero in-process shortcuts on the wire path. Mirrors what
+openr/tests/OpenrSystemTest.cpp does over real ZMQ/thrift sockets.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from openr_tpu.config import Config
+from openr_tpu.kvstore import TcpTransport
+from openr_tpu.openr import OpenrDaemon
+from openr_tpu.platform import MockFibHandler
+from openr_tpu.spark.io_provider import UdpIoProvider
+from openr_tpu.testing.wrapper import wait_until
+from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+
+GROUP = "239.88.66.55"
+
+
+def run(coro, timeout=60.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def make_daemon(name: str, spark_port: int):
+    cfg = Config.from_dict(
+        {
+            "node_name": name,
+            "dryrun": False,
+            "spark_config": {
+                "hello_time_s": 2.0,
+                "fastinit_hello_time_ms": 50.0,
+                "keepalive_time_s": 0.2,
+                "hold_time_s": 1.0,
+                "graceful_restart_time_s": 3.0,
+            },
+            "decision_config": {
+                "debounce_min_ms": 5.0,
+                "debounce_max_ms": 20.0,
+            },
+        }
+    )
+    fib = MockFibHandler()
+    io = UdpIoProvider(port=spark_port, group=GROUP)
+    daemon = OpenrDaemon(
+        cfg,
+        io_provider=io,
+        kv_transport=TcpTransport(),
+        fib_service=fib,
+        ctrl_port=0,
+        kvstore_host="127.0.0.1",
+        kvstore_port=0,  # ephemeral; advertised via Spark handshake
+    )
+    return daemon, io, fib
+
+
+def programmed(fib) -> list:
+    from openr_tpu.platform import FIB_CLIENT_OPENR
+
+    return sorted(
+        str(dest) for dest in fib.unicast_routes.get(FIB_CLIENT_OPENR, {})
+    )
+
+
+class TestRealSockets:
+    def test_two_daemons_converge_over_udp_and_tcp(self):
+        async def body():
+            spark_port = 28660 + os.getpid() % 1000
+            d_a, io_a, fib_a = make_daemon("node-a", spark_port)
+            d_b, io_b, fib_b = make_daemon("node-b", spark_port)
+            await d_a.start()
+            await d_b.start()
+            # distinct ephemeral KvStore ports were bound and advertised
+            assert d_a.kvstore_server.port != d_b.kvstore_server.port
+            assert (
+                d_a.spark.config.kvstore_cmd_port == d_a.kvstore_server.port
+            )
+
+            d_a.prefix_manager.advertise_prefixes(
+                [
+                    PrefixEntry(
+                        prefix=IpPrefix("10.1.0.0/24"),
+                        type=PrefixType.LOOPBACK,
+                    )
+                ]
+            )
+            d_b.prefix_manager.advertise_prefixes(
+                [
+                    PrefixEntry(
+                        prefix=IpPrefix("10.2.0.0/24"),
+                        type=PrefixType.LOOPBACK,
+                    )
+                ]
+            )
+
+            # bring up loopback on both: UDP multicast discovery begins
+            d_a.link_monitor.update_interface("lo", True)
+            d_b.link_monitor.update_interface("lo", True)
+
+            # adjacency via real UDP; KvStore peering via real TCP
+            await wait_until(
+                lambda: any(
+                    node == "node-b"
+                    for node, _ in d_a.link_monitor.get_adjacencies()
+                ),
+                timeout=20,
+            )
+            # the KvStore peer address is host:port, not a node id
+            peers = d_a.kvstore.dbs["0"].get_peers()
+            assert "node-b" in peers
+            assert peers["node-b"].peer_addr == (
+                f"127.0.0.1:{d_b.kvstore_server.port}"
+            )
+
+            # full route convergence in both directions
+            await wait_until(
+                lambda: "10.2.0.0/24" in programmed(fib_a), timeout=20
+            )
+            await wait_until(
+                lambda: "10.1.0.0/24" in programmed(fib_b), timeout=20
+            )
+            # adjacency DBs flooded over TCP into both stores
+            keys_a = sorted(d_a.kvstore.dump_all().key_vals)
+            assert any(k.startswith("adj:node-b") for k in keys_a)
+
+            await d_a.stop()
+            await d_b.stop()
+            io_a.close()
+            io_b.close()
+
+        run(body())
