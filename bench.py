@@ -134,6 +134,10 @@ def main() -> None:
     assert got == 2 * (grid_side - 1), got
 
     # --- CPU oracle: per-source Dijkstra (the reference architecture) ---
+    # The baseline of record is the native C++ Dijkstra (native/spf) — the
+    # honest stand-in for the reference's C++ SpfSolver hot loop
+    # (openr/decision/LinkState.cpp:806-880); the Python oracle rate is
+    # reported on stderr for context only.
     sample_nodes = graph.names[:: max(1, len(graph.names) // cpu_samples)][
         :cpu_samples
     ]
@@ -141,12 +145,36 @@ def main() -> None:
     for node in sample_nodes:
         ls.run_spf(node)
     cpu_elapsed = time.time() - t0
-    cpu_rate = len(sample_nodes) / cpu_elapsed
+    py_rate = len(sample_nodes) / cpu_elapsed
     print(
-        f"cpu oracle: {len(sample_nodes)} Dijkstra runs in "
-        f"{cpu_elapsed*1e3:.1f}ms -> {cpu_rate:,.0f} SPF/s",
+        f"python oracle: {len(sample_nodes)} Dijkstra runs in "
+        f"{cpu_elapsed*1e3:.1f}ms -> {py_rate:,.0f} SPF/s",
         file=sys.stderr,
     )
+
+    cpu_rate = py_rate
+    baseline_kind = "python-oracle"
+    from openr_tpu.solver.native_spf import (
+        NativeSpfSolver,
+        native_spf_available,
+    )
+
+    if native_spf_available():
+        baseline_kind = "native-c++"
+        solver = NativeSpfSolver(graph)
+        native_sources = np.arange(graph.n, dtype=np.int32)
+        solver.run_many(native_sources[:8])  # warm caches
+        t0 = time.time()
+        solver.run_many(native_sources)
+        native_elapsed = time.time() - t0
+        cpu_rate = len(native_sources) / native_elapsed
+        print(
+            f"native C++ oracle: {len(native_sources)} Dijkstra runs in "
+            f"{native_elapsed*1e3:.1f}ms -> {cpu_rate:,.0f} SPF/s "
+            "(baseline of record)",
+            file=sys.stderr,
+        )
+        solver.close()
 
     print(
         json.dumps(
@@ -155,6 +183,7 @@ def main() -> None:
                 "value": round(tpu_rate, 1),
                 "unit": f"SPF/s ({graph.n}-node grid, ECMP DAG fused)",
                 "vs_baseline": round(tpu_rate / cpu_rate, 1),
+                "baseline": baseline_kind,
             }
         )
     )

@@ -1,0 +1,197 @@
+// Native SPF oracle implementation — see onl_spf.h for the contract and
+// openr/decision/LinkState.cpp:806-880 for the semantics being reproduced.
+
+#include "onl_spf.h"
+
+#include <algorithm>
+#include <cstring>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Graph {
+  int32_t n = 0;
+  int64_t e = 0;
+  // CSR by source node
+  std::vector<int64_t> row;    // [n + 1]
+  std::vector<int32_t> col;    // [e] neighbor ids, grouped by source
+  std::vector<int32_t> wcsr;   // [e] weights (CSR order)
+  std::vector<int32_t> slot;   // [e] out-edge slot index within the source
+  std::vector<int64_t> csr_of; // [e] original edge position -> CSR position
+  std::vector<uint8_t> overloaded;  // [n]
+
+  // scratch reused across runs (single-threaded handle)
+  std::vector<int32_t> dist;
+  std::vector<uint8_t> settled;
+  std::vector<std::vector<uint64_t>> nh;  // per-node first-hop bitmask
+};
+
+using HeapEntry = std::pair<int32_t, int32_t>;  // (metric, node)
+
+int64_t run_dijkstra(Graph& g, int32_t source, int32_t* dist_out,
+                     uint64_t* nh_out, int32_t nh_words) {
+  const int32_t n = g.n;
+  if (source < 0 || source >= n) return -1;
+
+  g.dist.assign(n, ONL_SPF_INF);
+  g.settled.assign(n, 0);
+  const bool want_nh = nh_out != nullptr && nh_words > 0;
+  const int32_t deg =
+      static_cast<int32_t>(g.row[source + 1] - g.row[source]);
+  const int32_t words = (deg + 63) / 64;
+  if (want_nh) {
+    g.nh.assign(n, {});
+  }
+
+  // min-heap with lazy deletion; ties pop in node-id order, which is the
+  // reference's nodeName order (ids assigned from sorted names)
+  std::priority_queue<HeapEntry, std::vector<HeapEntry>,
+                      std::greater<HeapEntry>>
+      heap;
+  g.dist[source] = 0;
+  heap.push({0, source});
+  int64_t settled_count = 0;
+
+  while (!heap.empty()) {
+    auto [metric, u] = heap.top();
+    heap.pop();
+    if (g.settled[u] || metric != g.dist[u]) continue;  // stale entry
+    g.settled[u] = 1;
+    ++settled_count;
+
+    // overloaded nodes are reachable but carry no transit traffic unless
+    // they are the source (LinkState.cpp:829-836)
+    if (u != source && g.overloaded[u]) continue;
+
+    for (int64_t i = g.row[u]; i < g.row[u + 1]; ++i) {
+      const int32_t w = g.wcsr[i];
+      if (w >= ONL_SPF_INF) continue;  // down link / padding
+      const int32_t v = g.col[i];
+      if (g.settled[v]) continue;
+      const int32_t nd = metric + w;
+      if (nd < g.dist[v]) {
+        g.dist[v] = nd;
+        heap.push({nd, v});
+        if (want_nh) g.nh[v].assign(words, 0);
+      } else if (nd > g.dist[v]) {
+        continue;
+      }
+      if (want_nh) {
+        // equal-or-better path: union first hops (LinkState.cpp:855-871)
+        if (u == source) {
+          // directly connected: first hop is this out-edge slot
+          const int32_t s = g.slot[i];
+          if (s / 64 < words) g.nh[v][s / 64] |= 1ull << (s % 64);
+        } else {
+          auto& dst_set = g.nh[v];
+          const auto& src_set = g.nh[u];
+          if (dst_set.size() < src_set.size()) dst_set.resize(words, 0);
+          for (size_t k = 0; k < src_set.size(); ++k)
+            dst_set[k] |= src_set[k];
+        }
+      }
+    }
+  }
+
+  if (dist_out) std::memcpy(dist_out, g.dist.data(), sizeof(int32_t) * n);
+  if (want_nh) {
+    std::memset(nh_out, 0, sizeof(uint64_t) * static_cast<size_t>(n) *
+                               nh_words);
+    const int32_t copy_words = std::min(words, nh_words);
+    for (int32_t v = 0; v < n; ++v) {
+      const auto& set = g.nh[v];
+      for (int32_t k = 0; k < copy_words && k < (int32_t)set.size(); ++k)
+        nh_out[static_cast<int64_t>(v) * nh_words + k] = set[k];
+    }
+  }
+  return settled_count;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* onl_spf_create(int32_t n, int64_t e, const int32_t* src,
+                     const int32_t* dst, const int32_t* w,
+                     const uint8_t* overloaded) {
+  if (n <= 0 || e < 0) return nullptr;
+  auto* g = new Graph();
+  g->n = n;
+  g->e = e;
+  g->row.assign(n + 1, 0);
+  for (int64_t i = 0; i < e; ++i) {
+    if (src[i] < 0 || src[i] >= n || dst[i] < 0 || dst[i] >= n) {
+      delete g;
+      return nullptr;
+    }
+    ++g->row[src[i] + 1];
+  }
+  for (int32_t v = 0; v < n; ++v) g->row[v + 1] += g->row[v];
+  g->col.resize(e);
+  g->wcsr.resize(e);
+  g->slot.resize(e);
+  g->csr_of.resize(e);
+  std::vector<int64_t> fill(g->row.begin(), g->row.end() - 1);
+  for (int64_t i = 0; i < e; ++i) {
+    const int64_t p = fill[src[i]]++;
+    g->col[p] = dst[i];
+    g->wcsr[p] = w[i];
+    g->slot[p] = static_cast<int32_t>(p - g->row[src[i]]);
+    g->csr_of[i] = p;
+  }
+  g->overloaded.assign(n, 0);
+  if (overloaded) std::memcpy(g->overloaded.data(), overloaded, n);
+  return g;
+}
+
+void onl_spf_destroy(void* h) { delete static_cast<Graph*>(h); }
+
+void onl_spf_set_weight(void* h, int64_t edge, int32_t w) {
+  auto* g = static_cast<Graph*>(h);
+  if (edge < 0 || edge >= g->e) return;
+  g->wcsr[g->csr_of[edge]] = w;
+}
+
+void onl_spf_set_overloaded(void* h, int32_t node, uint8_t overloaded) {
+  auto* g = static_cast<Graph*>(h);
+  if (node < 0 || node >= g->n) return;
+  g->overloaded[node] = overloaded;
+}
+
+int32_t onl_spf_out_degree(void* h, int32_t source) {
+  auto* g = static_cast<Graph*>(h);
+  if (source < 0 || source >= g->n) return -1;
+  return static_cast<int32_t>(g->row[source + 1] - g->row[source]);
+}
+
+int32_t onl_spf_out_neighbors(void* h, int32_t source, int32_t* out,
+                              int32_t cap) {
+  auto* g = static_cast<Graph*>(h);
+  if (source < 0 || source >= g->n) return -1;
+  const int32_t deg =
+      static_cast<int32_t>(g->row[source + 1] - g->row[source]);
+  for (int32_t k = 0; k < deg && k < cap; ++k)
+    out[k] = g->col[g->row[source] + k];
+  return deg;
+}
+
+int64_t onl_spf_run(void* h, int32_t source, int32_t* dist_out,
+                    uint64_t* nh_out, int32_t nh_words) {
+  return run_dijkstra(*static_cast<Graph*>(h), source, dist_out, nh_out,
+                      nh_words);
+}
+
+int64_t onl_spf_run_many(void* h, const int32_t* sources, int32_t count) {
+  auto* g = static_cast<Graph*>(h);
+  int64_t total = 0;
+  for (int32_t i = 0; i < count; ++i) {
+    const int64_t r = run_dijkstra(*g, sources[i], nullptr, nullptr, 0);
+    if (r < 0) return r;
+    total += r;
+  }
+  return total;
+}
+
+}  // extern "C"

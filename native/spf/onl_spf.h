@@ -1,0 +1,67 @@
+// Native single-source SPF oracle — the small-graph fallback / reference
+// baseline solver of openr-tpu (SURVEY.md §7: the C++ SpfSolver stays as
+// oracle next to the TPU batched solver).
+//
+// Semantics match the reference Dijkstra (openr/decision/LinkState.cpp:806-880):
+//   - lazy-deletion binary heap keyed (metric, node id); node ids are
+//     assigned in sorted-name order by the Python graph compiler, so id
+//     order == the reference's nodeName tie-break order
+//   - overloaded nodes are reachable but offer no transit unless they are
+//     the source (LinkState.cpp:829-836)
+//   - equal-cost relaxations union first-hop (ECMP) sets
+//     (LinkState.cpp:855-871); first hops are recorded as bit positions
+//     over the source's out-edge slots
+//   - edges with weight >= ONL_SPF_INF (down links, padding) never relax
+//
+// Input arrays are exactly the CompiledGraph layout produced by
+// openr_tpu/ops/graph.py (directed edge list, int32 weights, INF = 1<<29).
+//
+// C ABI, no dependencies beyond the C++17 standard library.
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+// int32-safe infinity; must match openr_tpu.ops.graph.INF
+#define ONL_SPF_INF (1 << 29)
+
+// Build a solver over a directed edge list. Copies the inputs; the handle
+// owns a CSR-by-source adjacency. `e` may include INF-weight entries.
+void* onl_spf_create(int32_t n, int64_t e, const int32_t* src,
+                     const int32_t* dst, const int32_t* w,
+                     const uint8_t* overloaded);
+
+void onl_spf_destroy(void* h);
+
+// Patch one edge weight (position i in the original edge list) — the link
+// flap / metric-change path; ONL_SPF_INF takes a link down.
+void onl_spf_set_weight(void* h, int64_t edge, int32_t w);
+
+// Set a node's overload (drain) bit.
+void onl_spf_set_overloaded(void* h, int32_t node, uint8_t overloaded);
+
+// Number of out-edge slots of `source` (including down links; their bits
+// simply never appear in results). Returns -1 on bad node.
+int32_t onl_spf_out_degree(void* h, int32_t source);
+
+// Neighbor node id for each out-edge slot of `source`; fills up to `cap`.
+// Returns the out-degree.
+int32_t onl_spf_out_neighbors(void* h, int32_t source, int32_t* out,
+                              int32_t cap);
+
+// Single-source Dijkstra. dist_out must hold n int32 (ONL_SPF_INF =
+// unreachable). If nh_out is non-null it must hold n * nh_words uint64;
+// row v receives the first-hop set of v as a bitmask over the source's
+// out-edge slots (nh_words >= ceil(out_degree/64); excess slots ignored,
+// short rows truncate silently). Returns the number of settled nodes.
+int64_t onl_spf_run(void* h, int32_t source, int32_t* dist_out,
+                    uint64_t* nh_out, int32_t nh_words);
+
+// Distances-only batch: run Dijkstra from each of `count` sources,
+// discarding results (benchmark path — measures pure solver throughput the
+// way the reference's decision_benchmark drives SpfSolver). Returns total
+// settled nodes across runs.
+int64_t onl_spf_run_many(void* h, const int32_t* sources, int32_t count);
+
+}  // extern "C"

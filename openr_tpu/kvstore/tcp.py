@@ -102,6 +102,10 @@ class KvStoreTcpServer:
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
+        except ValueError as exc:
+            # readline() raises when a frame exceeds the stream limit; make
+            # the failure diagnosable instead of an unretrieved-task mystery
+            log.error("kvstore tcp: dropping connection, %s", exc)
         finally:
             self._writers.discard(writer)
             writer.close()
@@ -195,7 +199,16 @@ class _PeerConn:
             + b"\n"
         )
         await self.writer.drain()
-        line = await self.reader.readline()
+        try:
+            line = await self.reader.readline()
+        except ValueError as exc:
+            # reply frame exceeded the stream limit: surface a diagnosable
+            # transport error (and drop the now-desynced connection) instead
+            # of leaking a bare ValueError into the sync FSM
+            self.close()
+            raise KvStoreTransportError(
+                f"reply exceeds {_MAX_LINE}-byte frame limit: {exc}"
+            )
         if not line:
             raise ConnectionError("peer closed connection")
         reply = json.loads(line)

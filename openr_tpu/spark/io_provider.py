@@ -14,7 +14,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from openr_tpu.spark.messages import SparkHelloPacket
+from openr_tpu.spark.messages import (
+    SparkHelloPacket,
+    packet_from_bytes,
+    packet_to_bytes,
+)
 
 
 @dataclass
@@ -129,7 +133,6 @@ class UdpIoProvider(IoProvider):
             return
         import socket as socket_mod
 
-        from openr_tpu.spark.messages import packet_from_bytes
 
         sock = self._make_socket(if_name)
         ifindex = (
@@ -177,8 +180,6 @@ class UdpIoProvider(IoProvider):
         self._callback = callback
 
     def send(self, if_name: str, packet: SparkHelloPacket) -> int:
-        from openr_tpu.spark.messages import packet_to_bytes
-
         endpoint = self._endpoints.get(if_name)
         now = self.now_us()
         if endpoint is None:

@@ -8,6 +8,8 @@ SparkHeartbeatMsg:93 — liveness keepalive after establishment.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
@@ -75,17 +77,12 @@ class SparkHelloPacket:
 
 
 def packet_to_bytes(packet: SparkHelloPacket) -> bytes:
-    import dataclasses
-    import json
-
     return json.dumps(
         dataclasses.asdict(packet), separators=(",", ":")
     ).encode()
 
 
 def packet_from_bytes(data: bytes) -> SparkHelloPacket:
-    import json
-
     d = json.loads(data)
     hello = d.get("hello_msg")
     handshake = d.get("handshake_msg")
