@@ -1,21 +1,26 @@
-"""Benchmark: batched TPU SPF throughput vs the CPU SpfSolver oracle.
+"""Benchmark: batched TPU SPF throughput vs the native C++ SpfSolver oracle.
 
-Mirrors the reference's DecisionBenchmark grid harness
-(openr/decision/tests/DecisionBenchmark.cpp:806-823) on the BASELINE.md
-config-1 topology (1k-node grid): measures SPF recomputes/sec — single-source
-shortest-path computations per second — with ECMP first-hop DAG extraction
-fused into the device step (BASELINE config 4).
+Headline config is BASELINE.md config 3 — batched multi-source SPF on a
+100k-node synthetic WAN LSDB — the primary metric named in BASELINE.json
+("SPF recomputes/sec on 100k-node LSDB"). The TPU side runs the sliced-ELL
+pull relaxation (openr_tpu/ops/spf.py:_bf_fixpoint via _sell_solver); the
+baseline of record is the native C++ Dijkstra (native/spf), the honest
+stand-in for the reference's SpfSolver hot loop
+(openr/decision/LinkState.cpp:806-880).
 
-Methodology: R independent solves (distinct per-event edge weights, as if R
-LSDB events arrived) are chained inside one jit-compiled lax.scan, so one
-dispatch covers R solves; throughput is the marginal time between a short and
-a long chain, which cancels the fixed dispatch/sync latency of the device
-link (the axon tunnel costs ~70ms per sync, irrelevant to steady-state event
-processing where results stay device-resident). Baseline is the CPU oracle's
-per-source Dijkstra on this host.
+Methodology: R independent LSDB events are chained inside one jitted
+lax.scan — each event patches the edge weights and solves an S-source
+batch; a data dependency folds each result into a carry so no solve can be
+elided. Throughput is the marginal time between a short and a long chain,
+which cancels the fixed dispatch/sync latency of the device link (the axon
+tunnel costs ~70ms per sync, irrelevant to steady-state event processing
+where results stay device-resident).
+
+Set BENCH_TOPO=grid for the 1k-node grid config (BASELINE.md config 1, with
+ECMP first-hop DAG extraction fused — config 4 semantics).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "baseline": ...}
 plus detail lines on stderr.
 """
 
@@ -28,165 +33,256 @@ from functools import partial
 import numpy as np
 
 
-def main() -> None:
-    grid_side = int(os.environ.get("BENCH_GRID_SIDE", "32"))  # 32x32 = 1024
-    reps_small = int(os.environ.get("BENCH_REPS_SMALL", "8"))
-    reps_big = int(os.environ.get("BENCH_REPS_BIG", "64"))
-    cpu_samples = int(os.environ.get("BENCH_CPU_SAMPLES", "8"))
+from benchmarks.common import note as _note
+from benchmarks.common import time_marginal as _marginal_time
 
+
+def _native_rate(graph, samples: int) -> float:
+    """SPF/s of the native C++ Dijkstra on `samples` sources."""
+    from openr_tpu.solver.native_spf import NativeSpfSolver
+
+    solver = NativeSpfSolver(graph)
+    sources = np.linspace(0, graph.n - 1, samples, dtype=np.int32)
+    solver.run_many(sources[: max(2, samples // 4)])  # warm caches
+    t0 = time.time()
+    solver.run_many(sources)
+    elapsed = time.time() - t0
+    rate = samples / elapsed
+    _note(
+        f"native C++ oracle: {samples} Dijkstra runs in "
+        f"{elapsed*1e3:.1f}ms -> {rate:,.0f} SPF/s (baseline of record)"
+    )
+    solver.close()
+    return rate
+
+
+def bench_wan() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.graph import INF, compile_edges
+    from openr_tpu.ops.spf import _sell_solver_raw
+    from openr_tpu.solver.native_spf import native_spf_available
+    from openr_tpu.topology import wan_edges
+
+    n = int(os.environ.get("BENCH_WAN_N", "100000"))
+    n_sources = int(os.environ.get("BENCH_WAN_SOURCES", "1024"))
+    reps_small = int(os.environ.get("BENCH_REPS_SMALL", "1"))
+    reps_big = int(os.environ.get("BENCH_REPS_BIG", "3"))
+    events = max(reps_big, reps_small)
+
+    t0 = time.time()
+    graph = compile_edges(wan_edges(n, degree=4, seed=3))
+    _note(
+        f"wan: n={graph.n} e={graph.e} (padded {graph.n_pad}/{graph.e_pad}) "
+        f"built in {time.time()-t0:.1f}s on {jax.devices()[0]}"
+    )
+    sell = graph.sell
+    assert sell is not None, "WAN degree profile must qualify for sliced-ELL"
+
+    key = sell.shape_key()
+    solve = _sell_solver_raw(key[0], key[1], key)
+
+    rng = np.random.default_rng(7)
+    sources = jnp.asarray(
+        rng.choice(graph.n, size=n_sources, replace=False).astype(np.int32)
+    )
+    nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
+    ov = jnp.asarray(graph.overloaded)
+
+    # distinct weight sets = distinct LSDB events, patched into the sliced
+    # layout host-side exactly like refresh_graph's flap path
+    wg_stacks = []
+    for k in range(events):
+        w_k = np.where(
+            graph.w[: graph.e] < INF,
+            (graph.w[: graph.e] + k) % 100 + 1,
+            graph.w[: graph.e],
+        ).astype(np.int32)
+        wg_stacks.append(sell.patched_wg(w_k))
+    wg_variants = tuple(
+        jnp.asarray(np.stack([ws[i] for ws in wg_stacks]))
+        for i in range(len(sell.wg))
+    )
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def chained(wgv, reps):
+        def body(carry, wgs_event):
+            d = solve(sources, nbrs, wgs_event, ov)
+            return carry ^ d[0, -1], None
+
+        acc, _ = jax.lax.scan(
+            body,
+            jnp.int32(0),
+            tuple(a[:reps] for a in wgv),
+        )
+        return acc
+
+    t0 = time.time()
+    int(chained(wg_variants, reps_small))
+    int(chained(wg_variants, reps_big))
+    _note(f"compile+first runs: {time.time()-t0:.1f}s")
+
+    marginal = _marginal_time(
+        lambda r: int(chained(wg_variants, r)), reps_small, reps_big
+    )
+    tpu_rate = n_sources / marginal
+    _note(
+        f"tpu: {n_sources}-source batch per event in {marginal*1e3:.1f}ms "
+        f"-> {tpu_rate:,.0f} SPF/s"
+    )
+
+    # sanity: distances agree with the native oracle on unmodified weights
+    # (solve just the sampled sources — pulling the full [S, n_pad] matrix
+    # host-side would cost ~0.5GB over the device link for 3 rows)
+    from openr_tpu.ops.spf import sell_fixpoint
+
+    sample = np.asarray(sources)[[0, n_sources // 2, n_sources - 1]]
+    d = np.asarray(sell_fixpoint(sell, sample, sell.wg, graph.overloaded))
+    if native_spf_available():
+        from openr_tpu.solver.native_spf import NativeSpfSolver
+
+        solver = NativeSpfSolver(graph)
+        for i, s in enumerate(sample):
+            ref = solver.run(int(s))
+            np.testing.assert_array_equal(d[i, : graph.n], ref)
+        solver.close()
+        _note("sanity: device distances match native oracle")
+        cpu_rate = _native_rate(
+            graph, int(os.environ.get("BENCH_CPU_SAMPLES", "16"))
+        )
+        baseline = "native-c++"
+    else:  # toolchain missing: no honest baseline to report
+        cpu_rate = None
+        baseline = "unavailable"
+
+    return {
+        "metric": f"wan{graph.n}_spf_recomputes_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": f"SPF/s ({graph.n}-node WAN LSDB, {n_sources}-source batches)",
+        "vs_baseline": round(tpu_rate / cpu_rate, 1) if cpu_rate else 0.0,
+        "baseline": baseline,
+    }
+
+
+def bench_grid() -> dict:
     import jax
     import jax.numpy as jnp
 
     from openr_tpu.lsdb import LinkState
     from openr_tpu.ops import INF, compile_graph
-    from openr_tpu.ops.spf import _bf_fixpoint_ell, _ecmp_dag
+    from openr_tpu.ops.spf import _ecmp_dag, _sell_solver_raw
+    from openr_tpu.solver.native_spf import native_spf_available
     from openr_tpu.topology import build_adj_dbs, grid_edges
 
-    print(
-        f"bench: {grid_side}x{grid_side} grid on {jax.devices()[0]}",
-        file=sys.stderr,
-    )
+    grid_side = int(os.environ.get("BENCH_GRID_SIDE", "32"))  # 32x32 = 1024
+    reps_small = int(os.environ.get("BENCH_REPS_SMALL", "8"))
+    reps_big = int(os.environ.get("BENCH_REPS_BIG", "64"))
 
     ls = LinkState("0")
     for db in build_adj_dbs(grid_edges(grid_side)).values():
         ls.update_adjacency_database(db)
     graph = compile_graph(ls)
-    assert graph.nbr is not None  # grid qualifies for the ELL pull kernel
-    n_sources = graph.n
-    print(
-        f"graph: n={graph.n} e={graph.e} (padded {graph.n_pad}/{graph.e_pad})",
-        file=sys.stderr,
+    sell = graph.sell
+    assert sell is not None
+    _note(
+        f"grid: n={graph.n} e={graph.e} (padded {graph.n_pad}/{graph.e_pad})"
+        f" on {jax.devices()[0]}"
     )
 
+    key = sell.shape_key()
+    solve = _sell_solver_raw(key[0], key[1], key)
     sources = jnp.arange(graph.n_pad, dtype=jnp.int32)
-    src = jnp.asarray(graph.src)
-    dst = jnp.asarray(graph.dst)
+    nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
     ov = jnp.asarray(graph.overloaded)
-    nbr = jnp.asarray(graph.nbr)
+    src_e = jnp.asarray(graph.src)
+    dst_e = jnp.asarray(graph.dst)
+
+    reps = reps_big
+    w_rows = []
+    wg_stacks = []
+    for k in range(reps):
+        w_k = np.where(
+            graph.w < INF, (graph.w + k) % 7 + 1, graph.w
+        ).astype(np.int32)
+        w_rows.append(w_k)
+        wg_stacks.append(sell.patched_wg(w_k[: graph.e]))
+    w_variants = jnp.asarray(np.stack(w_rows))
+    wg_variants = tuple(
+        jnp.asarray(np.stack([ws[i] for ws in wg_stacks]))
+        for i in range(len(sell.wg))
+    )
 
     @partial(jax.jit, static_argnames=("reps",))
-    def chained(w_variants, wg_variants, reps):
-        def body(carry, wpair):
-            w, wg = wpair
-            d = _bf_fixpoint_ell(sources, nbr, wg, ov)
-            dag = _ecmp_dag(d, src, dst, w, ov)
+    def chained(wv, wgv, reps):
+        def body(carry, event):
+            w_e, wgs_event = event
+            d = solve(sources, nbrs, wgs_event, ov)
+            dag = _ecmp_dag(d, src_e, dst_e, w_e, ov)
             # fold a data dependency so no solve can be elided
             return carry ^ d[0, -1] ^ dag[0, -1].astype(jnp.int32), None
 
         acc, _ = jax.lax.scan(
-            body, jnp.int32(0), (w_variants[:reps], wg_variants[:reps])
+            body,
+            jnp.int32(0),
+            (wv[:reps], tuple(a[:reps] for a in wgv)),
         )
         return acc
-
-    # distinct weight sets = distinct LSDB events, in both layouts
-    w_np = [
-        np.where(graph.w < INF, (graph.w + k) % 7 + 1, graph.w).astype(
-            np.int32
-        )
-        for k in range(reps_big)
-    ]
-    wg_np = []
-    for w_k in w_np:
-        wg_k = graph.wg.copy()
-        wg_k[graph.ell_row, graph.ell_slot] = w_k[: graph.e]
-        wg_np.append(wg_k)
-    w_variants = jnp.asarray(np.stack(w_np))
-    wg_variants = jnp.asarray(np.stack(wg_np))
 
     t0 = time.time()
     int(chained(w_variants, wg_variants, reps_small))
     int(chained(w_variants, wg_variants, reps_big))
-    print(f"compile+first runs: {time.time()-t0:.1f}s", file=sys.stderr)
+    _note(f"compile+first runs: {time.time()-t0:.1f}s")
 
-    best_marginal = float("inf")
-    for _ in range(3):
-        t0 = time.time()
-        int(chained(w_variants, wg_variants, reps_small))
-        t_small = time.time() - t0
-        t0 = time.time()
-        int(chained(w_variants, wg_variants, reps_big))
-        t_big = time.time() - t0
-        marginal = (t_big - t_small) / (reps_big - reps_small)
-        if marginal > 0:  # noise guard: tiny shapes can invert the pair
-            best_marginal = min(best_marginal, marginal)
-        print(
-            f"chain {reps_small}: {t_small*1e3:.0f}ms  chain {reps_big}: "
-            f"{t_big*1e3:.0f}ms  marginal {marginal*1e3:.2f}ms/solve",
-            file=sys.stderr,
-        )
-    if not np.isfinite(best_marginal):
-        # all pairs inverted by noise: fall back to the amortized long chain
-        best_marginal = t_big / reps_big
-    tpu_rate = n_sources / best_marginal
-    print(
-        f"tpu: {n_sources}-source solve + ECMP DAG in "
-        f"{best_marginal*1e3:.2f}ms -> {tpu_rate:,.0f} SPF/s",
-        file=sys.stderr,
+    marginal = _marginal_time(
+        lambda r: int(chained(w_variants, wg_variants, r)),
+        reps_small,
+        reps_big,
+    )
+    tpu_rate = graph.n / marginal
+    _note(
+        f"tpu: {graph.n}-source solve + ECMP DAG in {marginal*1e3:.2f}ms "
+        f"-> {tpu_rate:,.0f} SPF/s"
     )
 
     # sanity: corner-to-corner distance with the unmodified weights
-    d = _bf_fixpoint_ell(sources, nbr, jnp.asarray(graph.wg), ov)
+    from openr_tpu.ops.spf import sell_fixpoint
+
+    d = sell_fixpoint(sell, np.arange(graph.n_pad), sell.wg, graph.overloaded)
     got = int(
         np.asarray(
-            d[graph.node_index["g0_0"], graph.node_index[f"g{grid_side-1}_{grid_side-1}"]]
+            d[
+                graph.node_index["g0_0"],
+                graph.node_index[f"g{grid_side-1}_{grid_side-1}"],
+            ]
         )
     )
     assert got == 2 * (grid_side - 1), got
 
-    # --- CPU oracle: per-source Dijkstra (the reference architecture) ---
-    # The baseline of record is the native C++ Dijkstra (native/spf) — the
-    # honest stand-in for the reference's C++ SpfSolver hot loop
-    # (openr/decision/LinkState.cpp:806-880); the Python oracle rate is
-    # reported on stderr for context only.
-    sample_nodes = graph.names[:: max(1, len(graph.names) // cpu_samples)][
-        :cpu_samples
-    ]
-    t0 = time.time()
-    for node in sample_nodes:
-        ls.run_spf(node)
-    cpu_elapsed = time.time() - t0
-    py_rate = len(sample_nodes) / cpu_elapsed
-    print(
-        f"python oracle: {len(sample_nodes)} Dijkstra runs in "
-        f"{cpu_elapsed*1e3:.1f}ms -> {py_rate:,.0f} SPF/s",
-        file=sys.stderr,
-    )
-
-    cpu_rate = py_rate
-    baseline_kind = "python-oracle"
-    from openr_tpu.solver.native_spf import (
-        NativeSpfSolver,
-        native_spf_available,
-    )
-
     if native_spf_available():
-        baseline_kind = "native-c++"
-        solver = NativeSpfSolver(graph)
-        native_sources = np.arange(graph.n, dtype=np.int32)
-        solver.run_many(native_sources[:8])  # warm caches
+        cpu_rate = _native_rate(graph, graph.n)
+        baseline = "native-c++"
+    else:
         t0 = time.time()
-        solver.run_many(native_sources)
-        native_elapsed = time.time() - t0
-        cpu_rate = len(native_sources) / native_elapsed
-        print(
-            f"native C++ oracle: {len(native_sources)} Dijkstra runs in "
-            f"{native_elapsed*1e3:.1f}ms -> {cpu_rate:,.0f} SPF/s "
-            "(baseline of record)",
-            file=sys.stderr,
-        )
-        solver.close()
+        sample = graph.names[:: max(1, graph.n // 8)][:8]
+        for node in sample:
+            ls.run_spf(node)
+        cpu_rate = len(sample) / (time.time() - t0)
+        baseline = "python-oracle"
 
-    print(
-        json.dumps(
-            {
-                "metric": "spf_recomputes_per_sec",
-                "value": round(tpu_rate, 1),
-                "unit": f"SPF/s ({graph.n}-node grid, ECMP DAG fused)",
-                "vs_baseline": round(tpu_rate / cpu_rate, 1),
-                "baseline": baseline_kind,
-            }
-        )
-    )
+    return {
+        "metric": "spf_recomputes_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": f"SPF/s ({graph.n}-node grid, ECMP DAG fused)",
+        "vs_baseline": round(tpu_rate / cpu_rate, 1),
+        "baseline": baseline,
+    }
+
+
+def main() -> None:
+    topo = os.environ.get("BENCH_TOPO", "wan")
+    result = bench_grid() if topo == "grid" else bench_wan()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

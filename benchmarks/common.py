@@ -17,7 +17,8 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from openr_tpu.ops.graph import INF, _next_bucket
+from openr_tpu.ops.graph import INF  # noqa: F401  (re-exported for benches)
+from openr_tpu.ops.graph import compile_edges as graph_compile_edges
 
 Edge = Tuple[str, str, int]
 
@@ -27,35 +28,12 @@ def compile_edges(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, Dict[str, int]]:
     """Edge list -> padded (src, dst, w, overloaded, node_index) arrays.
 
-    numpy-vectorized equivalent of ops.graph.compile_graph for synthetic
-    benchmark topologies where building a full LinkState (python object
-    graph) would dominate setup time at 100k+ nodes.
+    Thin wrapper over ops.graph.compile_edges (the numpy-vectorized fast
+    path) for the edge-list-form benchmark consumers; node ids follow its
+    in-degree renumbering, which consumers must reach through node_index.
     """
-    names = sorted({n for a, b, _ in edges for n in (a, b)})
-    node_index = {name: i for i, name in enumerate(names)}
-    n = len(names)
-    e = 2 * len(edges)
-
-    a = np.fromiter((node_index[x] for x, _, _ in edges), np.int32)
-    b = np.fromiter((node_index[y] for _, y, _ in edges), np.int32)
-    m = np.fromiter((w for _, _, w in edges), np.int32)
-
-    srcs = np.concatenate([a, b])
-    dsts = np.concatenate([b, a])
-    ws = np.concatenate([m, m])
-
-    n_pad = _next_bucket(max(n, 1))
-    e_pad = _next_bucket(max(e, 1))
-    src = np.zeros(e_pad, dtype=np.int32)
-    dst = np.zeros(e_pad, dtype=np.int32)
-    w = np.full(e_pad, INF, dtype=np.int32)
-    order = np.argsort(dsts, kind="stable")
-    src[:e] = srcs[order]
-    dst[:e] = dsts[order]
-    w[:e] = ws[order]
-    dst[e:] = dst[e - 1]
-    overloaded = np.zeros(n_pad, dtype=bool)
-    return src, dst, w, overloaded, node_index
+    graph = graph_compile_edges(edges)
+    return graph.src, graph.dst, graph.w, graph.overloaded, graph.node_index
 
 
 def time_marginal(run, reps_small: int, reps_big: int, rounds: int = 3) -> float:

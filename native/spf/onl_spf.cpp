@@ -45,8 +45,8 @@ int64_t run_dijkstra(Graph& g, int32_t source, int32_t* dist_out,
     g.nh.assign(n, {});
   }
 
-  // min-heap with lazy deletion; ties pop in node-id order, which is the
-  // reference's nodeName order (ids assigned from sorted names)
+  // min-heap with lazy deletion; ties pop in node-id order (see onl_spf.h:
+  // settled metrics and ECMP unions are tie-break independent)
   std::priority_queue<HeapEntry, std::vector<HeapEntry>,
                       std::greater<HeapEntry>>
       heap;

@@ -3,9 +3,11 @@
 // oracle next to the TPU batched solver).
 //
 // Semantics match the reference Dijkstra (openr/decision/LinkState.cpp:806-880):
-//   - lazy-deletion binary heap keyed (metric, node id); node ids are
-//     assigned in sorted-name order by the Python graph compiler, so id
-//     order == the reference's nodeName tie-break order
+//   - lazy-deletion binary heap keyed (metric, node id); ties pop in node-id
+//     order (the Python graph compiler renumbers ids by in-degree, so this
+//     is NOT the reference's nodeName order — harmless: Dijkstra's settled
+//     metrics and ECMP unions are tie-break independent; only per-path
+//     tie-breaking would need name ordering, and that lives host-side)
 //   - overloaded nodes are reachable but offer no transit unless they are
 //     the source (LinkState.cpp:829-836)
 //   - equal-cost relaxations union first-hop (ECMP) sets

@@ -26,6 +26,9 @@ cross-chip traffic inside a round.
 
 from __future__ import annotations
 
+import functools
+from typing import Tuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -90,59 +93,118 @@ def _bf_fixpoint(
     return _bf_fixpoint_vw(sources, src_e, dst_e, w_e[None, :], overloaded)
 
 
-@jax.jit
-def _bf_fixpoint_ell(
-    sources: jnp.ndarray,  # int32 [S]
-    nbr: jnp.ndarray,  # int32 [N, md] in-neighbor ids (ELL layout)
-    wg: jnp.ndarray,  # int32 [N, md]; INF for padding/down links
-    overloaded: jnp.ndarray,  # bool [N]
+@functools.lru_cache(maxsize=64)
+def _sell_solver_raw(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
+    """Unjitted sliced-ELL fixpoint for one bucket structure (SlicedEll
+    .shape_key()) — callers jit it themselves (with shardings for the mesh
+    path). Weight patches keep the structure, so per-structure executables
+    are reused across LSDB events; lru_cache bounds the executable
+    population the way the size-bucket padding does.
+
+    Each round processes the destination-major [N, S] distance matrix in
+    contiguous equal-degree row slices: slice k relaxes via dk row-gathers
+    + fused vector mins, writing only the [nk, S] slice — no scatter and no
+    [E, S] contribution materialization, which is what makes this ~1.7x
+    faster than the edge-list segment-min form at 100k nodes."""
+
+    # bound trace-time unrolling for fat buckets (Clos spines etc.); the
+    # fori_loop body indexes nbr/wg columns dynamically instead
+    _UNROLL_MAX = 32
+
+    def solve(sources, nbrs, wgs, overloaded):
+        (n,) = overloaded.shape
+        s = sources.shape[0]
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+
+        d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
+        d0 = d0.at[sources, jnp.arange(s)].set(0)
+        # transit allowed through u for source column j unless u is
+        # overloaded and u is not the source itself
+        allow = (~overloaded)[:, None] | (
+            node_ids[:, None] == sources[None, :]
+        )
+
+        def body(state):
+            d, _, it = state
+            dt = jnp.where(allow, d, INF)
+            parts = [d[:zero_end]] if zero_end else []
+            end = zero_end
+            for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
+                nk, dk = shapes[2][k]
+                bs = starts[k]
+                acc = d[bs : bs + nk]
+                if dk <= _UNROLL_MAX:
+                    for j in range(dk):
+                        acc = jnp.minimum(
+                            acc,
+                            jnp.minimum(
+                                dt[nbr_k[:, j]] + wg_k[:, j][:, None], INF
+                            ),
+                        )
+                else:
+
+                    def j_step(j, a, nbr_k=nbr_k, wg_k=wg_k):
+                        ids = jax.lax.dynamic_index_in_dim(
+                            nbr_k, j, axis=1, keepdims=False
+                        )
+                        wj = jax.lax.dynamic_index_in_dim(
+                            wg_k, j, axis=1, keepdims=False
+                        )
+                        return jnp.minimum(
+                            a, jnp.minimum(dt[ids] + wj[:, None], INF)
+                        )
+
+                    acc = jax.lax.fori_loop(0, dk, j_step, acc)
+                parts.append(acc)
+                end = bs + nk
+            if end < n:
+                parts.append(d[end:])  # array-padding rows never change
+            new_d = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+            return new_d, jnp.any(new_d != d), it + 1
+
+        def cond(state):
+            _, changed, it = state
+            return changed & (it < n)
+
+        d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+        return d.T
+
+    return solve
+
+
+@functools.lru_cache(maxsize=64)
+def _sell_solver(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
+    """Jitted single-device form of _sell_solver_raw."""
+    return jax.jit(_sell_solver_raw(zero_end, starts, shapes))
+
+
+def sell_fixpoint(
+    sell,  # ops.graph.SlicedEll
+    sources,  # int32 [S] device or host
+    wgs,  # tuple of [nk, dk] weight arrays (device or host)
+    overloaded,  # bool [n_pad]
 ) -> jnp.ndarray:
-    """Distance matrix D [S, N] via the "pull" relaxation: each round is
-    max-in-degree row-gathers + vector mins over a destination-major [N, S]
-    matrix — no scatter, all accesses row-contiguous. Measured ~6x faster
-    per round than the edge-list gather/segment-min form on TPU for
-    degree-4 grids; selected automatically for bounded-degree graphs."""
-    n, md = wg.shape
-    s = sources.shape[0]
-    node_ids = jnp.arange(n, dtype=jnp.int32)
-
-    d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
-    d0 = d0.at[sources, jnp.arange(s)].set(0)
-    # transit allowed through u for source column j unless u is overloaded
-    # and u is not the source itself
-    allow = (~overloaded)[:, None] | (node_ids[:, None] == sources[None, :])
-
-    def body(state):
-        d, _, it = state
-        dt = jnp.where(allow, d, INF)
-
-        def k_step(k, acc):
-            relaxed = jnp.minimum(dt[nbr[:, k]] + wg[:, k][:, None], INF)
-            return jnp.minimum(acc, relaxed)
-
-        new_d = jax.lax.fori_loop(0, md, k_step, d)
-        return new_d, jnp.any(new_d != d), it + 1
-
-    def cond(state):
-        _, changed, it = state
-        return changed & (it < n)
-
-    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
-    return d.T
+    """Distance matrix D [S, N] via the sliced-ELL pull relaxation."""
+    key = sell.shape_key()
+    fn = _sell_solver(key[0], key[1], key)
+    return fn(
+        jnp.asarray(sources, dtype=jnp.int32),
+        tuple(jnp.asarray(a) for a in sell.nbr),
+        tuple(jnp.asarray(a) for a in wgs),
+        jnp.asarray(overloaded),
+    )
 
 
 def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
     """Run the batched solve for the given source node indices.
 
-    Dispatches to the ELL pull kernel when the graph's degree profile
-    qualifies (ops.graph._build_ell), else the edge-list segment-min form.
+    Dispatches to the sliced-ELL pull kernel when the graph's degree
+    profile qualifies (ops.graph._build_sell), else the edge-list
+    segment-min form.
     """
-    if graph.nbr is not None:
-        return _bf_fixpoint_ell(
-            jnp.asarray(source_rows, dtype=jnp.int32),
-            jnp.asarray(graph.nbr),
-            jnp.asarray(graph.wg),
-            jnp.asarray(graph.overloaded),
+    if graph.sell is not None:
+        return sell_fixpoint(
+            graph.sell, source_rows, graph.sell.wg, graph.overloaded
         )
     return _bf_fixpoint(
         jnp.asarray(source_rows, dtype=jnp.int32),

@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from openr_tpu.ops.graph import CompiledGraph
-from openr_tpu.ops.spf import _bf_fixpoint, _bf_fixpoint_ell, _ecmp_dag
+from openr_tpu.ops.spf import _bf_fixpoint, _ecmp_dag, _sell_solver_raw
 
 
 def make_mesh(
@@ -56,10 +56,10 @@ def sharded_batched_spf(
 ) -> jnp.ndarray:
     """Batched SPF with the sources axis sharded over mesh axis 'batch'.
 
-    Uses the ELL pull kernel when the graph qualifies (dest-major [N, S]
-    matrix: the source axis is the minor dim, still sharded over 'batch'
-    since the kernel returns D transposed). Returns D [S_padded, n_pad]
-    sharded P('batch', None).
+    Uses the sliced-ELL pull kernel when the graph qualifies (dest-major
+    [N, S] matrix: the source axis is the minor dim, still sharded over
+    'batch' since the kernel returns D transposed). Returns D
+    [S_padded, n_pad] sharded P('batch', None).
     """
     batch = mesh.shape["batch"]
     sources = _pad_sources(source_rows, batch)
@@ -67,16 +67,27 @@ def sharded_batched_spf(
     row_sharded = NamedSharding(mesh, P("batch"))
     replicated = NamedSharding(mesh, P())
     out_sharding = NamedSharding(mesh, P("batch", None))
-    if graph.nbr is not None:
+    if graph.sell is not None:
+        sell = graph.sell
+        key = sell.shape_key()
         fn = jax.jit(
-            _bf_fixpoint_ell,
-            in_shardings=(row_sharded, replicated, replicated, replicated),
+            _sell_solver_raw(key[0], key[1], key),
+            in_shardings=(
+                row_sharded,
+                replicated,  # prefix pytree: every nbr/wg leaf replicated
+                replicated,
+                replicated,
+            ),
             out_shardings=out_sharding,
         )
         return fn(
             jax.device_put(jnp.asarray(sources), row_sharded),
-            jax.device_put(jnp.asarray(graph.nbr), replicated),
-            jax.device_put(jnp.asarray(graph.wg), replicated),
+            tuple(
+                jax.device_put(jnp.asarray(a), replicated) for a in sell.nbr
+            ),
+            tuple(
+                jax.device_put(jnp.asarray(a), replicated) for a in sell.wg
+            ),
             jax.device_put(jnp.asarray(graph.overloaded), replicated),
         )
     fn = jax.jit(

@@ -42,8 +42,11 @@ def _load() -> Optional[ctypes.CDLL]:
     _load_attempted = True
     try:
         if not os.path.exists(_SO_PATH):
+            # build only the SPF library: a failure in an unrelated native
+            # component (e.g. netlink, needing linux headers) must not
+            # disable the SPF baseline
             subprocess.run(
-                ["make", "-C", _MAKE_DIR],
+                ["make", "-C", _MAKE_DIR, "../openr_tpu/_native/libopenr_spf.so"],
                 check=True,
                 capture_output=True,
                 timeout=120,

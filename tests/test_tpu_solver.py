@@ -142,8 +142,8 @@ class TestBatchedSpf:
         for n in (5, 7):
             all_pairs_distance_check(build_ls(ring_edges(n)))
 
-    def test_ell_and_edge_list_kernels_agree(self):
-        from openr_tpu.ops.spf import _bf_fixpoint, _bf_fixpoint_ell
+    def test_sliced_and_edge_list_kernels_agree(self):
+        from openr_tpu.ops.spf import _bf_fixpoint, sell_fixpoint
 
         rng = random.Random(5)
         for trial in range(5):
@@ -156,23 +156,39 @@ class TestBatchedSpf:
             overloaded = {nodes[i] for i in range(1, n) if rng.random() < 0.2}
             ls = build_ls(edges, overloaded_nodes=overloaded)
             g = compile_graph(ls)
-            assert g.nbr is not None  # small bounded-degree: ELL selected
+            assert g.sell is not None  # small bounded-degree: sliced layout
             rows = np.arange(g.n_pad, dtype=np.int32)
-            d_ell = np.asarray(
-                _bf_fixpoint_ell(rows, g.nbr, g.wg, g.overloaded)
+            d_sell = np.asarray(
+                sell_fixpoint(g.sell, rows, g.sell.wg, g.overloaded)
             )
             d_edge = np.asarray(
                 _bf_fixpoint(rows, g.src, g.dst, g.w, g.overloaded)
             )
-            np.testing.assert_array_equal(d_ell, d_edge)
+            np.testing.assert_array_equal(d_sell, d_edge)
 
-    def test_high_degree_falls_back_to_edge_list(self):
-        # star: hub in-degree exceeds the ELL cap -> edge-list path
-        edges = [("hub", f"leaf{i}", 1) for i in range(150)]
+    def test_star_hub_uses_fori_bucket(self):
+        # hub in-degree beyond the unroll threshold exercises the
+        # fori_loop bucket path; distances must still match the oracle
+        edges = [("hub", f"leaf{i:03d}", 1 + i % 5) for i in range(40)]
         ls = build_ls(edges)
         g = compile_graph(ls)
-        assert g.nbr is None
+        assert g.sell is not None
+        assert any(a.shape[1] > 32 for a in g.sell.nbr)  # fat bucket
         all_pairs_distance_check(ls)
+
+    def test_extreme_degree_falls_back_to_edge_list(self):
+        # unroll cap exceeded (hub in-degree > _SELL_UNROLL_CAP):
+        # edge-list segment-min path takes over
+        edges = [("hub", f"leaf{i:04d}", 1) for i in range(1100)]
+        ls = build_ls(edges)
+        g = compile_graph(ls)
+        assert g.sell is None
+        d = np.asarray(batched_spf(graph=g, source_rows=np.arange(g.n_pad)))
+        hub = g.node_index["hub"]
+        leaf = g.node_index["leaf0000"]
+        assert d[hub, leaf] == 1 and d[leaf, hub] == 1
+        other = g.node_index["leaf0001"]
+        assert d[leaf, other] == 2  # via hub
 
 
 class TestIncrementalRefresh:
@@ -192,11 +208,16 @@ class TestIncrementalRefresh:
         g2 = refresh_graph(g1, ls)
         assert g2.src is g1.src and g2.dst is g1.dst  # no rebuild
         assert g2.version == ls.version
-        # ELL weights patched consistently with the edge weights
-        assert g2.wg is not None
-        np.testing.assert_array_equal(
-            g2.wg[g2.ell_row, g2.ell_slot], g2.w[: g2.e]
-        )
+        # sliced-layout weights patched consistently with the edge weights
+        sell = g2.sell
+        assert sell is not None
+        for p in range(g2.e):
+            assert (
+                sell.wg[sell.edge_bucket[p]][
+                    sell.edge_row[p], sell.edge_slot[p]
+                ]
+                == g2.w[p]
+            )
         all_pairs_distance_check_graph(ls, g2)
 
     def test_node_overload_patches_in_place(self):
