@@ -94,7 +94,7 @@ def _bf_fixpoint(
 
 
 @functools.lru_cache(maxsize=64)
-def _sell_solver_raw(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
+def _sell_solver_raw(key: Tuple):
     """Unjitted sliced-ELL fixpoint for one bucket structure (SlicedEll
     .shape_key()) — callers jit it themselves (with shardings for the mesh
     path). Weight patches keep the structure, so per-structure executables
@@ -110,6 +110,7 @@ def _sell_solver_raw(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
     # bound trace-time unrolling for fat buckets (Clos spines etc.); the
     # fori_loop body indexes nbr/wg columns dynamically instead
     _UNROLL_MAX = 32
+    zero_end, starts, shapes = key
 
     def solve(sources, nbrs, wgs, overloaded):
         (n,) = overloaded.shape
@@ -130,7 +131,7 @@ def _sell_solver_raw(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
             parts = [d[:zero_end]] if zero_end else []
             end = zero_end
             for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
-                nk, dk = shapes[2][k]
+                nk, dk = shapes[k]
                 bs = starts[k]
                 acc = d[bs : bs + nk]
                 if dk <= _UNROLL_MAX:
@@ -173,9 +174,9 @@ def _sell_solver_raw(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
 
 
 @functools.lru_cache(maxsize=64)
-def _sell_solver(zero_end: int, starts: Tuple[int, ...], shapes: Tuple):
+def _sell_solver(key: Tuple):
     """Jitted single-device form of _sell_solver_raw."""
-    return jax.jit(_sell_solver_raw(zero_end, starts, shapes))
+    return jax.jit(_sell_solver_raw(key))
 
 
 def sell_fixpoint(
@@ -185,8 +186,7 @@ def sell_fixpoint(
     overloaded,  # bool [n_pad]
 ) -> jnp.ndarray:
     """Distance matrix D [S, N] via the sliced-ELL pull relaxation."""
-    key = sell.shape_key()
-    fn = _sell_solver(key[0], key[1], key)
+    fn = _sell_solver(sell.shape_key())
     return fn(
         jnp.asarray(sources, dtype=jnp.int32),
         tuple(jnp.asarray(a) for a in sell.nbr),

@@ -69,9 +69,8 @@ def sharded_batched_spf(
     out_sharding = NamedSharding(mesh, P("batch", None))
     if graph.sell is not None:
         sell = graph.sell
-        key = sell.shape_key()
         fn = jax.jit(
-            _sell_solver_raw(key[0], key[1], key),
+            _sell_solver_raw(sell.shape_key()),
             in_shardings=(
                 row_sharded,
                 replicated,  # prefix pytree: every nbr/wg leaf replicated

@@ -131,6 +131,11 @@ class _AreaSolve:
         self.graph: CompiledGraph = compile_graph(link_state)
         self.device_solves = 0
         self.ksp_device_batches = 0
+        # persistent device buffers (SURVEY.md §7: the <100ms convergence
+        # budget leaves no room to re-upload the LSDB per event): sell
+        # nbr/wg/overloaded live on device across events; weight patches
+        # upload only the changed slots
+        self._dev: Optional[dict] = None
         self._solve()
 
     def _solve(self) -> None:
@@ -154,13 +159,66 @@ class _AreaSolve:
             [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
         )
         # one device call for the whole batch; copy back once
-        self.d = np.asarray(batched_spf(self.graph, rows))
+        if self.graph.sell is not None:
+            self.d = np.asarray(self._sell_solve_resident(rows))
+        else:
+            self.d = np.asarray(batched_spf(self.graph, rows))
         self.device_solves += 1
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
         # reset with the snapshot, so topology changes invalidate it for free
         self._ksp: Dict[Tuple[str, int], List[Path]] = {}
         self._nh_links: Optional[List[str]] = None
         self._nh_mask: Optional[np.ndarray] = None
+
+    def _sell_solve_resident(self, rows: np.ndarray):
+        """Sliced-ELL solve against persistent device buffers.
+
+        The first call (or any structural rebuild, detected by src array
+        identity) uploads the full layout; subsequent events diff the host
+        weight/overload arrays against the device snapshot and upload only
+        the changed slots (`.at[].set` with tiny index arrays) — a link
+        flap moves a handful of ints over the host-device link instead of
+        the whole LSDB."""
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.spf import _sell_solver
+
+        g = self.graph
+        sell = g.sell
+        st = self._dev
+        if st is None or st["src_ref"] is not g.src:
+            st = self._dev = {
+                "src_ref": g.src,
+                "nbrs": tuple(jnp.asarray(a) for a in sell.nbr),
+                "wgs": tuple(jnp.asarray(a) for a in sell.wg),
+                "ov": jnp.asarray(g.overloaded),
+                "w_host": g.w.copy(),
+                "ov_host": g.overloaded.copy(),
+            }
+        else:
+            changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            if len(changed):
+                wgs = list(st["wgs"])
+                for k in np.unique(sell.edge_bucket[changed]):
+                    sel = changed[sell.edge_bucket[changed] == k]
+                    wgs[k] = (
+                        wgs[k]
+                        .at[sell.edge_row[sel], sell.edge_slot[sel]]
+                        .set(jnp.asarray(g.w[sel]))
+                    )
+                st["wgs"] = tuple(wgs)
+                st["w_host"] = g.w.copy()
+            if not np.array_equal(st["ov_host"], g.overloaded):
+                st["ov"] = jnp.asarray(g.overloaded)
+                st["ov_host"] = g.overloaded.copy()
+
+        fn = _sell_solver(sell.shape_key())
+        return fn(
+            jnp.asarray(rows, dtype=jnp.int32),
+            st["nbrs"],
+            st["wgs"],
+            st["ov"],
+        )
 
     def nh_mask(self) -> Tuple[List[str], np.ndarray]:
         """(neighbor names, [L, n_pad] bool): entry [i, t] is True iff the
