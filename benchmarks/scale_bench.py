@@ -101,9 +101,13 @@ def bench_clos_flap(pods: int, events: int = 8) -> None:
     )
     nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
     ov = jnp.asarray(g.overloaded)
+    from openr_tpu.ops.graph import _next_bucket
+
+    rows_np = np.array([g.node_index[s] for s in area.sources], np.int32)
+    s_pad = _next_bucket(len(rows_np), minimum=8)  # match _AreaSolve._solve
     rows = jnp.asarray(
-        np.resize(
-            np.array([g.node_index[s] for s in area.sources], np.int32), 16
+        np.concatenate(
+            [rows_np, np.full(s_pad - len(rows_np), rows_np[0], np.int32)]
         )
     )
     solve = _sell_solver_raw(sell.shape_key())
@@ -304,15 +308,18 @@ def bench_wan_ksp(n: int, k_dests: int) -> None:
     import jax
     import jax.numpy as jnp
 
-    from openr_tpu.ops.spf import _bf_fixpoint_vw
+    from openr_tpu.ops.graph import compile_edges as graph_compile_edges
+    from openr_tpu.ops.spf import _sell_solver_vw
     from openr_tpu.topology import wan_edges
 
-    edges = wan_edges(n, degree=4, seed=5)
-    src, dst, w, overloaded, node_index = compile_edges(edges)
-    e_pad = len(src)
+    graph = graph_compile_edges(wan_edges(n, degree=4, seed=5))
+    sell = graph.sell
+    assert sell is not None
+    src, dst, w = graph.src, graph.dst, graph.w
+    e_pad = graph.e_pad
     note(f"ksp wan: n={n} e_pad={e_pad}")
 
-    me = 0
+    me = graph.node_index["w0"]
     rng = np.random.default_rng(11)
     # my up-edges; their far ends are the neighbor rows for the first-hop mask
     mine = np.nonzero((src == me) & (w < INF))[0]
@@ -320,7 +327,8 @@ def bench_wan_ksp(n: int, k_dests: int) -> None:
     deg = len(neighbors)
 
     # batch = [me] + neighbors (base weights) + K penalized me rows, each
-    # masking a few edges (the links of a previously traced path set) to INF
+    # masking a few edges (the links of a previously traced path set) to
+    # INF via the device-side per-bucket masks
     s = 1 + deg + k_dests
     sources = np.concatenate(
         [
@@ -329,22 +337,35 @@ def bench_wan_ksp(n: int, k_dests: int) -> None:
             np.full(k_dests, me, dtype=np.int32),
         ]
     )
-    w_rows = np.tile(w, (s, 1))
+    per_bucket = [[] for _ in range(len(sell.nbr))]
     for row in range(1 + deg, s):
-        masked = rng.choice(e_pad, size=8, replace=False)
-        w_rows[row, masked] = INF
+        for p in rng.choice(graph.e, size=8, replace=False):
+            per_bucket[sell.edge_bucket[p]].append(
+                (sell.edge_row[p], sell.edge_slot[p], row)
+            )
+    masks = tuple(
+        jnp.asarray(
+            np.asarray(entries, dtype=np.int32)
+            if entries
+            else np.full((1, 3), 1 << 30, dtype=np.int32)
+        )
+        for entries in per_bucket
+    )
 
     my_w = jnp.asarray(w[mine])
     sources_d = jnp.asarray(sources)
-    src_d = jnp.asarray(src)
-    dst_d = jnp.asarray(dst)
-    w_rows_d = jnp.asarray(w_rows)
-    ov_d = jnp.asarray(overloaded)
+    nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
+    wgs = tuple(jnp.asarray(a) for a in sell.wg)
+    ov_d = jnp.asarray(graph.overloaded)
+    solve_vw = _sell_solver_vw(sell.shape_key())
 
     @partial(jax.jit, static_argnames=("reps",))
     def chained(reps):
         def body(carry, k):
-            d = _bf_fixpoint_vw(sources_d, src_d, dst_d, w_rows_d + k, ov_d)
+            wgs_k = tuple(
+                jnp.where(a < INF, (a + k) % 100 + 1, a) for a in wgs
+            )
+            d = solve_vw(sources_d, nbrs, wgs_k, masks, ov_d)
             # ECMP first-hop mask fused: edge (me -> v) is a first hop for
             # dest t iff w(me,v) + D[v, t] == D[me, t]
             fh = (my_w[:, None] + d[1 : 1 + deg, :] == d[0][None, :]).sum()
@@ -357,21 +378,36 @@ def bench_wan_ksp(n: int, k_dests: int) -> None:
 
     marginal = time_marginal(lambda r: int(chained(r)), 1, 4)
 
-    # measured baseline: the same s solves executed one row at a time
-    # (the reference's sequential per-destination re-run structure)
+    # measured baseline: the same s solves executed one row at a time with
+    # each row's own penalty mask (the reference's sequential
+    # per-destination re-run structure). Masks are stacked per batch row
+    # and sliced by the loop index so no iteration is loop-invariant (XLA
+    # must not be able to hoist the solve).
     one_src = sources_d[:1]
+    per_row_bucket = [
+        np.full((s, 8, 3), 1 << 30, dtype=np.int32) for _ in sell.nbr
+    ]
+    for k, entries in enumerate(per_bucket):
+        counts = {}
+        for r, sl, row in entries:
+            j = counts.get(row, 0)
+            per_row_bucket[k][row, j] = (r, sl, 0)  # col 0: single-row solve
+            counts[row] = j + 1
+    masks_rows = tuple(jnp.asarray(a) for a in per_row_bucket)
 
     @partial(jax.jit, static_argnames=("reps",))
     def chained_seq(reps):
         def body(carry, k):
+            wgs_k = tuple(
+                jnp.where(a < INF, (a + k) % 100 + 1, a) for a in wgs
+            )
+
             def one(i, acc):
-                d = _bf_fixpoint_vw(
-                    one_src,
-                    src_d,
-                    dst_d,
-                    jax.lax.dynamic_slice_in_dim(w_rows_d, i, 1, axis=0) + k,
-                    ov_d,
+                masks_i = tuple(
+                    jax.lax.dynamic_index_in_dim(m, i, axis=0, keepdims=False)
+                    for m in masks_rows
                 )
+                d = solve_vw(one_src, nbrs, wgs_k, masks_i, ov_d)
                 return acc ^ d[0, -1]
 
             acc = jax.lax.fori_loop(0, s, one, carry)

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from openr_tpu.ops.graph import INF, CompiledGraph
+from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 
 
 @jax.jit
@@ -107,76 +107,167 @@ def _sell_solver_raw(key: Tuple):
     [E, S] contribution materialization, which is what makes this ~1.7x
     faster than the edge-list segment-min form at 100k nodes."""
 
-    # bound trace-time unrolling for fat buckets (Clos spines etc.); the
-    # fori_loop body indexes nbr/wg columns dynamically instead
-    _UNROLL_MAX = 32
     zero_end, starts, shapes = key
 
     def solve(sources, nbrs, wgs, overloaded):
-        (n,) = overloaded.shape
-        s = sources.shape[0]
-        node_ids = jnp.arange(n, dtype=jnp.int32)
-
-        d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
-        d0 = d0.at[sources, jnp.arange(s)].set(0)
-        # transit allowed through u for source column j unless u is
-        # overloaded and u is not the source itself
-        allow = (~overloaded)[:, None] | (
-            node_ids[:, None] == sources[None, :]
+        return _sell_fixpoint_core(
+            sources, nbrs, wgs, overloaded, zero_end, starts, shapes
         )
 
-        def body(state):
-            d, _, it = state
-            dt = jnp.where(allow, d, INF)
-            parts = [d[:zero_end]] if zero_end else []
-            end = zero_end
-            for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
-                nk, dk = shapes[k]
-                bs = starts[k]
-                acc = d[bs : bs + nk]
-                if dk <= _UNROLL_MAX:
-                    for j in range(dk):
-                        acc = jnp.minimum(
-                            acc,
-                            jnp.minimum(
-                                dt[nbr_k[:, j]] + wg_k[:, j][:, None], INF
-                            ),
-                        )
-                else:
-
-                    def j_step(j, a, nbr_k=nbr_k, wg_k=wg_k):
-                        ids = jax.lax.dynamic_index_in_dim(
-                            nbr_k, j, axis=1, keepdims=False
-                        )
-                        wj = jax.lax.dynamic_index_in_dim(
-                            wg_k, j, axis=1, keepdims=False
-                        )
-                        return jnp.minimum(
-                            a, jnp.minimum(dt[ids] + wj[:, None], INF)
-                        )
-
-                    acc = jax.lax.fori_loop(0, dk, j_step, acc)
-                parts.append(acc)
-                end = bs + nk
-            if end < n:
-                parts.append(d[end:])  # array-padding rows never change
-            new_d = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
-            return new_d, jnp.any(new_d != d), it + 1
-
-        def cond(state):
-            _, changed, it = state
-            return changed & (it < n)
-
-        d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
-        return d.T
-
     return solve
+
+
+# bound trace-time unrolling for fat buckets (Clos spines etc.); the
+# fori_loop body indexes nbr/wg columns dynamically instead
+_UNROLL_MAX = 32
+
+
+def _sell_fixpoint_core(
+    sources, nbrs, wgs, overloaded, zero_end, starts, shapes
+):
+    """Shared fixpoint body for the plain and per-row-weights solvers.
+
+    wgs leaves are [nk, dk] (shared across the batch) or [nk, dk, S]
+    (per-batch-row weights, the penalized-re-solve form); broadcasting
+    handles both in one implementation so the two paths cannot diverge."""
+    (n,) = overloaded.shape
+    s = sources.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+
+    d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
+    d0 = d0.at[sources, jnp.arange(s)].set(0)
+    # transit allowed through u for source column j unless u is overloaded
+    # and u is not the source itself
+    allow = (~overloaded)[:, None] | (node_ids[:, None] == sources[None, :])
+
+    def body(state):
+        d, _, it = state
+        dt = jnp.where(allow, d, INF)
+        parts = [d[:zero_end]] if zero_end else []
+        end = zero_end
+        for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
+            nk, dk = shapes[k]
+            bs = starts[k]
+            acc = d[bs : bs + nk]
+            if dk <= _UNROLL_MAX:
+                for j in range(dk):
+                    wj = (
+                        wg_k[:, j][:, None]
+                        if wg_k.ndim == 2
+                        else wg_k[:, j, :]
+                    )
+                    acc = jnp.minimum(
+                        acc, jnp.minimum(dt[nbr_k[:, j]] + wj, INF)
+                    )
+            else:
+
+                def j_step(j, a, nbr_k=nbr_k, wg_k=wg_k):
+                    ids = jax.lax.dynamic_index_in_dim(
+                        nbr_k, j, axis=1, keepdims=False
+                    )
+                    wj = jax.lax.dynamic_index_in_dim(
+                        wg_k, j, axis=1, keepdims=False
+                    )
+                    if wg_k.ndim == 2:
+                        wj = wj[:, None]
+                    return jnp.minimum(
+                        a, jnp.minimum(dt[ids] + wj, INF)
+                    )
+
+                acc = jax.lax.fori_loop(0, dk, j_step, acc)
+            parts.append(acc)
+            end = bs + nk
+        if end < n:
+            parts.append(d[end:])  # array-padding rows never change
+        new_d = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+        return new_d, jnp.any(new_d != d), it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n)
+
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    return d.T
 
 
 @functools.lru_cache(maxsize=64)
 def _sell_solver(key: Tuple):
     """Jitted single-device form of _sell_solver_raw."""
     return jax.jit(_sell_solver_raw(key))
+
+
+@functools.lru_cache(maxsize=64)
+def _sell_solver_vw(key: Tuple):
+    """Per-row-weights sliced-ELL fixpoint (jitted): the device form of the
+    reference's penalized re-solves — KSP's link-ignore runSpf
+    (LinkState.cpp:760-789) — on the sliced layout.
+
+    Instead of materializing per-row edge weights host-side ([S, E] ints
+    uploaded per call), callers pass the shared bucket weights plus per-
+    bucket mask index arrays [Mk, 3] of (row-in-bucket, slot, batch-col)
+    positions to pin to INF; out-of-range rows (padding) are dropped. The
+    [nk, dk, S] expanded weights are built on device.
+    """
+    zero_end, starts, shapes = key
+
+    def solve(sources, nbrs, wgs, masks, overloaded):
+        s = sources.shape[0]
+        wgv = []
+        for k, wg_k in enumerate(wgs):
+            nk, dk = shapes[k]
+            full = jnp.broadcast_to(wg_k[:, :, None], (nk, dk, s))
+            m = masks[k]
+            full = full.at[m[:, 0], m[:, 1], m[:, 2]].set(INF, mode="drop")
+            wgv.append(full)
+        return _sell_fixpoint_core(
+            sources, nbrs, tuple(wgv), overloaded, zero_end, starts, shapes
+        )
+
+    return jax.jit(solve)
+
+
+def sell_fixpoint_masked(
+    sell,  # ops.graph.SlicedEll
+    sources,  # int32 [S]
+    overloaded,  # bool [n_pad]
+    mask_positions,  # per batch row: list of edge positions to pin to INF
+    device_arrays=None,  # optional (nbrs, wgs, ov) already on device
+) -> jnp.ndarray:
+    """Per-row link-ignore solve on the sliced layout.
+
+    mask_positions[i] is an iterable of edge positions (dst-sorted edge
+    array indices, e.g. from CompiledGraph.link_edges) whose weight becomes
+    INF for batch row i only. Mask arrays are bucket-padded so repeated
+    calls with similar mask counts share jitted executables. Pass
+    device_arrays (e.g. an _AreaSolve's persistent buffers) to avoid
+    re-uploading the layout per call.
+    """
+    nb = len(sell.nbr)
+    per_bucket: list = [[] for _ in range(nb)]
+    for col, positions in enumerate(mask_positions):
+        for p in positions:
+            per_bucket[sell.edge_bucket[p]].append(
+                (sell.edge_row[p], sell.edge_slot[p], col)
+            )
+    masks = []
+    for k in range(nb):
+        entries = per_bucket[k]
+        m_pad = _next_bucket(max(len(entries), 1))
+        arr = np.full((m_pad, 3), 1 << 30, dtype=np.int32)  # dropped rows
+        if entries:
+            arr[: len(entries)] = np.asarray(entries, dtype=np.int32)
+        masks.append(jnp.asarray(arr))
+    if device_arrays is not None:
+        nbrs, wgs, ov = device_arrays
+    else:
+        nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
+        wgs = tuple(jnp.asarray(a) for a in sell.wg)
+        ov = jnp.asarray(overloaded)
+    fn = _sell_solver_vw(sell.shape_key())
+    return fn(
+        jnp.asarray(sources, dtype=jnp.int32), nbrs, wgs, tuple(masks), ov
+    )
+
 
 
 def sell_fixpoint(

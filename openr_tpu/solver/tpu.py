@@ -40,7 +40,11 @@ from openr_tpu.ops.graph import (
     compile_graph,
     refresh_graph,
 )
-from openr_tpu.ops.spf import batched_spf, batched_spf_vw
+from openr_tpu.ops.spf import (
+    batched_spf,
+    batched_spf_vw,
+    sell_fixpoint_masked,
+)
 from openr_tpu.solver.cpu import Metric, SpfSolver
 
 
@@ -321,15 +325,41 @@ class _AreaSolve:
         # size in a bucket shares one jitted executable (same convention as
         # n_pad/e_pad in compile_graph); filler rows re-solve unpenalized
         s_pad = _next_bucket(len(todo), minimum=1)
-        w_rows = np.tile(self.graph.w, (s_pad, 1))
-        for row, ig in enumerate(ignores):
-            for link in ig:
-                fwd, rev = self.graph.link_edges[link]
-                w_rows[row, fwd] = INF
-                w_rows[row, rev] = INF
         me_row = idx[self.me]
         sources = np.full(s_pad, me_row, dtype=np.int32)
-        d_rows = np.asarray(batched_spf_vw(self.graph, sources, w_rows))
+        if self.graph.sell is not None:
+            # sliced layout: per-row ignores become device-side INF masks —
+            # no [S, E] host tile, no bulk upload
+            mask_positions: List[List[int]] = []
+            for ig in ignores:
+                pos: List[int] = []
+                for link in ig:
+                    fwd, rev = self.graph.link_edges[link]
+                    pos.extend((fwd, rev))
+                mask_positions.append(pos)
+            mask_positions.extend([[] for _ in range(s_pad - len(todo))])
+            dev = self._dev  # persistent buffers, synced by _solve()
+            d_rows = np.asarray(
+                sell_fixpoint_masked(
+                    self.graph.sell,
+                    sources,
+                    self.graph.overloaded,
+                    mask_positions,
+                    device_arrays=(
+                        (dev["nbrs"], dev["wgs"], dev["ov"])
+                        if dev is not None
+                        else None
+                    ),
+                )
+            )
+        else:
+            w_rows = np.tile(self.graph.w, (s_pad, 1))
+            for row, ig in enumerate(ignores):
+                for link in ig:
+                    fwd, rev = self.graph.link_edges[link]
+                    w_rows[row, fwd] = INF
+                    w_rows[row, rev] = INF
+            d_rows = np.asarray(batched_spf_vw(self.graph, sources, w_rows))
         self.ksp_device_batches += 1
 
         for row, (dest, ig) in enumerate(zip(todo, ignores)):

@@ -176,6 +176,41 @@ class TestBatchedSpf:
         assert any(a.shape[1] > 32 for a in g.sell.nbr)  # fat bucket
         all_pairs_distance_check(ls)
 
+    def test_masked_solver_matches_link_ignore_spf(self):
+        # per-row INF masks == the oracle's links_to_ignore re-solve
+        from openr_tpu.ops.spf import sell_fixpoint_masked
+
+        rng = random.Random(9)
+        ls = build_ls(grid_edges(4))
+        g = compile_graph(ls)
+        links = sorted(g.link_edges)
+        ignore_sets = [
+            set(),
+            {links[0]},
+            {links[1], links[5]},
+            set(rng.sample(links, 4)),
+        ]
+        me = "g0_0"
+        row = g.node_index[me]
+        mask_positions = [
+            [p for link in ig for p in g.link_edges[link]]
+            for ig in ignore_sets
+        ]
+        d = np.asarray(
+            sell_fixpoint_masked(
+                g.sell,
+                np.full(len(ignore_sets), row, dtype=np.int32),
+                g.overloaded,
+                mask_positions,
+            )
+        )
+        for i, ig in enumerate(ignore_sets):
+            res = ls.run_spf(me, True, ig)
+            for node in g.names:
+                col = g.node_index[node]
+                want = res[node].metric if node in res else INF
+                assert d[i, col] == want, (i, node)
+
     def test_extreme_degree_falls_back_to_edge_list(self):
         # unroll cap exceeded (hub in-degree > _SELL_UNROLL_CAP):
         # edge-list segment-min path takes over
