@@ -1,0 +1,64 @@
+"""Daemon entrypoint: `python -m openr_tpu [flags | --config file]`.
+
+The openr_bin equivalent (openr/Main.cpp:154): parses the legacy flag set
+or a thrift-JSON config file (openr_tpu/config/flags.py), wires the real
+transports — UDP multicast discovery for Spark, TCP peering for KvStore —
+and runs the daemon until SIGINT/SIGTERM, shutting modules down in reverse
+order (Main.cpp:597-654 semantics, OpenrDaemon.stop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    from openr_tpu.config.flags import parse_flags
+    from openr_tpu.openr import OpenrDaemon
+    from openr_tpu.spark.io_provider import UdpIoProvider
+    from openr_tpu.kvstore import TcpTransport
+    from openr_tpu.utils.build_info import get_build_info
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+    )
+    config, args = parse_flags(argv)
+    info = get_build_info()
+    logging.info(
+        "starting %s %s node=%s",
+        info["build_package_name"],
+        info["build_package_version"],
+        config.node_name,
+    )
+
+    async def run() -> int:
+        c = config.config
+        daemon = OpenrDaemon(
+            config,
+            io_provider=UdpIoProvider(
+                port=c.spark_config.neighbor_discovery_port
+            ),
+            kv_transport=TcpTransport(),
+            config_store_path=args.config_store_filepath,
+            ctrl_port=c.openr_ctrl_port,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await daemon.start()
+        logging.info("all modules up; ctrl port %d", c.openr_ctrl_port)
+        await stop.wait()
+        logging.info("shutting down")
+        await daemon.stop()
+        return 0
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

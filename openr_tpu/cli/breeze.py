@@ -40,7 +40,10 @@ from openr_tpu.ctrl.client import (
     encode_obj,
 )
 
-VERSION = "openr-tpu 1.0 (Open/R protocol compatible rebuild)"
+from openr_tpu.utils.build_info import PACKAGE as _PKG
+from openr_tpu.utils.build_info import VERSION as _PKG_VERSION
+
+VERSION = f"{_PKG} {_PKG_VERSION} (Open/R protocol compatible rebuild)"
 
 
 def _print_json(data: Any) -> None:
@@ -405,6 +408,8 @@ def cmd_openr(client: BlockingCtrlClient, args) -> None:
     if args.cmd == "version":
         print(VERSION)
         print("node:", client.call("getMyNodeName"))
+        for k, v in sorted(client.call("getBuildInfo").items()):
+            print(f"{k}: {v}")
     elif args.cmd == "config":
         _print_json(client.call("getRunningConfig"))
 
@@ -517,6 +522,22 @@ def main(argv=None) -> int:
     except ConnectionRefusedError:
         print(
             f"cannot connect to openr-tpu at {args.host}:{args.port}",
+            file=sys.stderr,
+        )
+        return 1
+    except BrokenPipeError:
+        # distinguish a closed stdout (pager/head quit: quiet success) from
+        # a broken daemon socket (real RPC failure: report it)
+        try:
+            sys.stdout.flush()
+        except (BrokenPipeError, ValueError):
+            try:
+                sys.stdout.close()
+            except Exception:
+                pass
+            return 0
+        print(
+            f"connection to openr-tpu at {args.host}:{args.port} broke",
             file=sys.stderr,
         )
         return 1

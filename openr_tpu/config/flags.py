@@ -1,0 +1,169 @@
+"""Legacy command-line flag bridge.
+
+Equivalent of the reference's gflags layer (openr/common/Flags.cpp, 111
+gflags) and its translator GflagConfig::createConfigFromGflag
+(openr/config/GflagConfig.h): a daemon invoked with legacy-style flags gets
+a full OpenrConfig built from them, while `--config <file>` short-circuits
+to the thrift-JSON config file exactly like Main.cpp:199-207 (file wins;
+flags are the fallback path).
+
+Only the flags with behavior in this rebuild are bridged; each maps onto
+the OpenrConfig field that GflagConfig targets. Unknown flags fail fast
+(argparse) rather than being silently dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Sequence
+
+from openr_tpu.config.config import (
+    AreaConfig,
+    Config,
+    OpenrConfig,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="openr_tpu",
+        description="Open/R-compatible routing daemon (TPU-native rebuild)",
+    )
+    p.add_argument("--config", default=None, help="thrift-JSON config file; overrides all other flags (Main.cpp:199)")
+    # identity / areas (Flags.cpp: node_name, domain, areas)
+    p.add_argument("--node_name", default="")
+    p.add_argument("--domain", default="openr")
+    p.add_argument("--areas", default="", help="comma-separated area ids")
+    # ports (Flags.cpp: openr_ctrl_port, fib_handler_port, spark_mcast_port)
+    p.add_argument("--openr_ctrl_port", type=int, default=2018)
+    p.add_argument("--fib_handler_port", type=int, default=60100)
+    p.add_argument("--spark_mcast_port", type=int, default=6666)
+    # interface selection (Flags.cpp: iface_regex_include/exclude,
+    # redistribute_ifaces)
+    p.add_argument("--iface_regex_include", default="")
+    p.add_argument("--iface_regex_exclude", default="")
+    p.add_argument("--redistribute_ifaces", default="")
+    # spark timers (Flags.cpp/OpenrConfig.thrift:52-63)
+    p.add_argument("--spark_hold_time_s", type=float, default=10.0)
+    p.add_argument("--spark_keepalive_time_s", type=float, default=2.0)
+    p.add_argument("--spark_hello_time_s", type=float, default=20.0)
+    p.add_argument("--spark_fastinit_hello_time_ms", type=float, default=500.0)
+    p.add_argument("--spark_gr_hold_time_s", type=float, default=30.0)
+    # kvstore (Flags.cpp: kvstore_key_ttl_ms, kvstore_sync_interval_s,
+    # enable_flood_optimization, is_flood_root)
+    p.add_argument("--kvstore_key_ttl_ms", type=int, default=300_000)
+    p.add_argument("--kvstore_sync_interval_s", type=int, default=60)
+    p.add_argument("--enable_flood_optimization", action="store_true")
+    p.add_argument("--is_flood_root", action="store_true")
+    # decision (Runbook.md:425-435 debounce; rebuild's backend selector)
+    p.add_argument("--decision_debounce_min_ms", type=float, default=10.0)
+    p.add_argument("--decision_debounce_max_ms", type=float, default=250.0)
+    p.add_argument("--enable_lfa", action="store_true")
+    p.add_argument("--decision_solver_backend", choices=("cpu", "tpu"), default="cpu")
+    # link monitor dampening (OpenrConfig.thrift:36-37)
+    p.add_argument("--link_flap_initial_backoff_ms", type=int, default=60_000)
+    p.add_argument("--link_flap_max_backoff_ms", type=int, default=300_000)
+    p.add_argument("--enable_rtt_metric", dest="enable_rtt_metric", action="store_true", default=True)
+    p.add_argument("--noenable_rtt_metric", dest="enable_rtt_metric", action="store_false")
+    # feature toggles (Flags.cpp enable_*)
+    p.add_argument("--dryrun", action="store_true")
+    p.add_argument("--enable_v4", dest="enable_v4", action="store_true", default=True)
+    p.add_argument("--noenable_v4", dest="enable_v4", action="store_false")
+    p.add_argument("--enable_netlink_fib_handler", action="store_true")
+    p.add_argument("--enable_fib_agent", action="store_true", help="program routes through the standalone native agent (platform_linux equivalent)")
+    p.add_argument("--enable_segment_routing", action="store_true")
+    p.add_argument("--enable_rib_policy", action="store_true")
+    p.add_argument("--enable_ordered_fib_programming", action="store_true")
+    p.add_argument("--enable_bgp_peering", action="store_true")
+    # prefix allocation (Flags.cpp: enable_prefix_alloc, seed_prefix,
+    # alloc_prefix_len, set/override_loopback_addr, loopback_iface)
+    p.add_argument("--enable_prefix_alloc", action="store_true")
+    p.add_argument("--seed_prefix", default=None)
+    p.add_argument("--alloc_prefix_len", type=int, default=None)
+    p.add_argument("--set_loopback_address", action="store_true")
+    p.add_argument("--override_loopback_addr", action="store_true")
+    p.add_argument("--loopback_iface", default="lo")
+    # watchdog (OpenrConfig.thrift:65-69)
+    p.add_argument("--enable_watchdog", dest="enable_watchdog", action="store_true", default=True)
+    p.add_argument("--noenable_watchdog", dest="enable_watchdog", action="store_false")
+    p.add_argument("--watchdog_interval_s", type=int, default=20)
+    p.add_argument("--watchdog_threshold_s", type=int, default=300)
+    p.add_argument("--memory_limit_mb", type=int, default=800)
+    # eor / cold start (Main.cpp:233-235)
+    p.add_argument("--eor_time_s", type=int, default=None)
+    # persistent store (Flags.cpp: config_store_filepath)
+    p.add_argument("--config_store_filepath", default="/tmp/openr_persistent_config_store.bin")
+    return p
+
+
+def _csv(value: str) -> List[str]:
+    return [v for v in (s.strip() for s in value.split(",")) if v]
+
+
+def config_from_flags(args: argparse.Namespace) -> Config:
+    """GflagConfig::createConfigFromGflag equivalent: flags -> OpenrConfig."""
+    if args.config:
+        return Config.load_file(args.config)
+    cfg = OpenrConfig(node_name=args.node_name, domain=args.domain)
+    cfg.areas = [AreaConfig(a) for a in _csv(args.areas)]
+    cfg.openr_ctrl_port = args.openr_ctrl_port
+    cfg.fib_port = args.fib_handler_port
+    cfg.dryrun = args.dryrun
+    cfg.enable_v4 = args.enable_v4
+    cfg.enable_netlink_fib_handler = args.enable_netlink_fib_handler
+    cfg.enable_fib_agent = args.enable_fib_agent
+    cfg.enable_segment_routing = args.enable_segment_routing
+    cfg.enable_rib_policy = args.enable_rib_policy
+    cfg.enable_ordered_fib_programming = args.enable_ordered_fib_programming
+    cfg.enable_bgp_peering = args.enable_bgp_peering
+    cfg.eor_time_s = args.eor_time_s
+
+    sp = cfg.spark_config
+    sp.neighbor_discovery_port = args.spark_mcast_port
+    sp.hello_time_s = args.spark_hello_time_s
+    sp.fastinit_hello_time_ms = args.spark_fastinit_hello_time_ms
+    sp.keepalive_time_s = args.spark_keepalive_time_s
+    sp.hold_time_s = args.spark_hold_time_s
+    sp.graceful_restart_time_s = args.spark_gr_hold_time_s
+
+    kv = cfg.kvstore_config
+    kv.key_ttl_ms = args.kvstore_key_ttl_ms
+    kv.sync_interval_s = args.kvstore_sync_interval_s
+    kv.enable_flood_optimization = args.enable_flood_optimization
+    kv.is_flood_root = args.is_flood_root
+
+    dc = cfg.decision_config
+    dc.debounce_min_ms = args.decision_debounce_min_ms
+    dc.debounce_max_ms = args.decision_debounce_max_ms
+    dc.compute_lfa_paths = args.enable_lfa
+    dc.solver_backend = args.decision_solver_backend
+
+    lm = cfg.link_monitor_config
+    lm.linkflap_initial_backoff_ms = args.link_flap_initial_backoff_ms
+    lm.linkflap_max_backoff_ms = args.link_flap_max_backoff_ms
+    lm.use_rtt_metric = args.enable_rtt_metric
+    lm.include_interface_regexes = _csv(args.iface_regex_include)
+    lm.exclude_interface_regexes = _csv(args.iface_regex_exclude)
+    lm.redistribute_interface_regexes = _csv(args.redistribute_ifaces)
+
+    cfg.enable_prefix_allocation = args.enable_prefix_alloc
+    pa = cfg.prefix_allocation_config
+    pa.seed_prefix = args.seed_prefix
+    pa.allocate_prefix_len = args.alloc_prefix_len
+    pa.set_loopback_addr = args.set_loopback_address
+    pa.override_loopback_addr = args.override_loopback_addr
+    pa.loopback_interface = args.loopback_iface
+
+    cfg.enable_watchdog = args.enable_watchdog
+    wd = cfg.watchdog_config
+    wd.interval_s = args.watchdog_interval_s
+    wd.thread_timeout_s = args.watchdog_threshold_s
+    wd.max_memory_mb = args.memory_limit_mb
+
+    return Config(cfg)
+
+
+def parse_flags(argv: Optional[Sequence[str]] = None):
+    """(Config, parsed args) from argv — the daemon entry's front door."""
+    args = build_parser().parse_args(argv)
+    return config_from_flags(args), args

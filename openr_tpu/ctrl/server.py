@@ -194,6 +194,12 @@ class CtrlServer:
     def m_getMyNodeName(self, params) -> str:
         return self.node_name
 
+    def m_getBuildInfo(self, params) -> Dict[str, str]:
+        """fb303 getBuildInfo equivalent (common/BuildInfo exportBuildInfo)."""
+        from openr_tpu.utils.build_info import get_build_info
+
+        return get_build_info()
+
     def m_getRunningConfig(self, params) -> Optional[dict]:
         if self.config is None:
             return None

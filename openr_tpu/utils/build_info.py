@@ -1,0 +1,27 @@
+"""Build metadata, the common/BuildInfo equivalent.
+
+The reference exposes build user/time/package through fb303's getBuildInfo
+(openr/common/BuildInfo.h via exportBuildInfo); here the same shape is
+assembled from the package itself so `breeze openr version` and the ctrl
+API report something meaningful in a from-source deployment.
+"""
+
+from __future__ import annotations
+
+import platform
+import sys
+from typing import Dict
+
+VERSION = "1.0.0"  # single source of truth; breeze derives its banner from it
+PACKAGE = "openr-tpu"
+
+
+def get_build_info() -> Dict[str, str]:
+    return {
+        "build_package_name": PACKAGE,
+        "build_package_version": VERSION,
+        "build_mode": "opt",
+        "build_platform": platform.platform(),
+        "build_python": sys.version.split()[0],
+        "build_rule": "openr_tpu",
+    }

@@ -1,0 +1,88 @@
+"""Legacy flag bridge (config/flags.py — the GflagConfig equivalent,
+openr/config/GflagConfig.h + common/Flags.cpp) and build info."""
+
+import json
+
+import pytest
+
+from openr_tpu.config.flags import build_parser, config_from_flags, parse_flags
+from openr_tpu.utils.build_info import get_build_info
+
+
+def cfg_of(*argv):
+    return config_from_flags(build_parser().parse_args(list(argv)))
+
+
+def test_defaults_match_reference_timers():
+    c = cfg_of("--node_name", "n1").config
+    assert c.spark_config.hello_time_s == 20.0
+    assert c.spark_config.fastinit_hello_time_ms == 500.0
+    assert c.spark_config.keepalive_time_s == 2.0
+    assert c.spark_config.hold_time_s == 10.0
+    assert c.spark_config.graceful_restart_time_s == 30.0
+    assert c.kvstore_config.key_ttl_ms == 300_000
+    assert c.kvstore_config.sync_interval_s == 60
+    assert c.decision_config.debounce_min_ms == 10.0
+    assert c.decision_config.debounce_max_ms == 250.0
+    assert c.openr_ctrl_port == 2018
+
+
+def test_flags_map_onto_config_fields():
+    c = cfg_of(
+        "--node_name", "r1",
+        "--areas", "pod1,pod2",
+        "--openr_ctrl_port", "3018",
+        "--spark_hold_time_s", "30",
+        "--kvstore_key_ttl_ms", "60000",
+        "--decision_solver_backend", "tpu",
+        "--enable_lfa",
+        "--iface_regex_include", "eth.*,po.*",
+        "--redistribute_ifaces", "lo",
+        "--enable_prefix_alloc",
+        "--seed_prefix", "face:b00c::/56",
+        "--alloc_prefix_len", "64",
+        "--dryrun",
+        "--enable_flood_optimization",
+        "--is_flood_root",
+        "--noenable_v4",
+        "--memory_limit_mb", "1200",
+    ).config
+    assert c.node_name == "r1"
+    assert [a.area_id for a in c.areas] == ["pod1", "pod2"]
+    assert c.openr_ctrl_port == 3018
+    assert c.spark_config.hold_time_s == 30.0
+    assert c.kvstore_config.key_ttl_ms == 60_000
+    assert c.decision_config.solver_backend == "tpu"
+    assert c.decision_config.compute_lfa_paths
+    assert c.link_monitor_config.include_interface_regexes == ["eth.*", "po.*"]
+    assert c.link_monitor_config.redistribute_interface_regexes == ["lo"]
+    assert c.enable_prefix_allocation
+    assert c.prefix_allocation_config.seed_prefix == "face:b00c::/56"
+    assert c.prefix_allocation_config.allocate_prefix_len == 64
+    assert c.dryrun
+    assert c.kvstore_config.enable_flood_optimization
+    assert c.kvstore_config.is_flood_root
+    assert not c.enable_v4
+    assert c.watchdog_config.max_memory_mb == 1200
+
+
+def test_config_file_overrides_flags(tmp_path):
+    path = tmp_path / "openr.json"
+    path.write_text(json.dumps({"node_name": "from_file", "dryrun": True}))
+    config, args = parse_flags(
+        ["--config", str(path), "--node_name", "from_flags"]
+    )
+    assert config.node_name == "from_file"
+    assert config.is_dryrun()
+
+
+def test_missing_node_name_rejected():
+    with pytest.raises(ValueError):
+        cfg_of()
+
+
+def test_build_info_shape():
+    info = get_build_info()
+    assert info["build_package_name"] == "openr-tpu"
+    assert info["build_package_version"]
+    assert all(isinstance(v, str) for v in info.values())

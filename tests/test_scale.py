@@ -1,0 +1,165 @@
+"""Emulation-scale tests (openr/docs/Emulator.md:4-8: "at-least a 1000 node
+topology before code changes can be checked in").
+
+Two layers, mirroring how the reference splits the bar:
+  - a 1000+-node LSDB driven through the real Decision module (publication
+    stream -> debounce -> solver -> RouteDb delta), checked against the
+    CPU oracle route pipeline on both solver backends;
+  - a wider full-stack ring of OpenrWrapper nodes over the mock fabric
+    (discovery -> flood -> SPF -> FIB), bounded-time convergence.
+
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision, DecisionConfig
+from openr_tpu.lsdb import LinkState
+from openr_tpu.lsdb.prefix_state import PrefixState
+from openr_tpu.messaging import ReplicateQueue, RQueue, RWQueue
+from openr_tpu.solver import SpfSolver
+from openr_tpu.topology import build_adj_dbs, fabric_edges
+from openr_tpu.types import (
+    IpPrefix,
+    Publication,
+    PrefixDatabase,
+    PrefixEntry,
+    Value,
+    adj_key,
+    prefix_key,
+)
+from openr_tpu.utils import serializer
+
+
+
+
+def run(coro, timeout=300.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def clos_1000():
+    """3-tier fabric > 1000 nodes (pods sized to cross the bar)."""
+    edges = fabric_edges(18)  # 18 pods x (8 fsw + 48 rsw) + spines > 1000
+    dbs = build_adj_dbs(edges)
+    assert len(dbs) >= 1000, len(dbs)
+    return edges, dbs
+
+
+def prefix_db_of(i, node):
+    return PrefixDatabase(
+        node,
+        [PrefixEntry(IpPrefix(f"10.{i // 250}.{i % 250}.0/24"))],
+        area="0",
+    )
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_decision_converges_on_1000_node_lsdb(backend):
+    edges, dbs = clos_1000()
+    me = "rsw0_0"
+
+    async def body():
+        kv_q = RWQueue()
+        route_q = ReplicateQueue()
+        decision = Decision(
+            DecisionConfig(
+                my_node_name=me,
+                solver_backend=backend,
+                debounce_min=0.005,
+                debounce_max=0.05,
+            ),
+            RQueue(kv_q),
+            route_q,
+        )
+        reader = route_q.get_reader()
+        decision.start()
+
+        # one publication per node, as a KvStore full-sync would deliver
+        t0 = time.time()
+        for i, (node, db) in enumerate(sorted(dbs.items())):
+            pub = Publication(area="0")
+            pub.key_vals[adj_key(node)] = Value(
+                1, node, serializer.dumps(db)
+            )
+            pdb = prefix_db_of(i, node)
+            pub.key_vals[prefix_key(node)] = Value(
+                1, node, serializer.dumps(pdb)
+            )
+            kv_q.push(pub)
+
+        delta = await reader.get()
+        elapsed = time.time() - t0
+        # the debouncer may split the stream into a few batches; drain
+        # until the route table covers every other node's loopback
+        routes = {e.prefix: e for e in delta.unicast_routes_to_update}
+        deadline = time.time() + 240
+        while len(routes) < len(dbs) - 1 and time.time() < deadline:
+            try:
+                more = await asyncio.wait_for(reader.get(), 30)
+            except asyncio.TimeoutError:
+                break
+            routes.update(
+                {e.prefix: e for e in more.unicast_routes_to_update}
+            )
+            for pfx in more.unicast_routes_to_delete:
+                routes.pop(pfx, None)
+        assert len(routes) == len(dbs) - 1, (len(routes), len(dbs))
+
+        # spot-check against the oracle route pipeline
+        ls = LinkState("0")
+        for db in dbs.values():
+            ls.update_adjacency_database(db)
+        ps = PrefixState()
+        for i, node in enumerate(sorted(dbs)):
+            ps.update_prefix_database(prefix_db_of(i, node))
+        oracle = SpfSolver(me).build_route_db(me, {"0": ls}, ps)
+        assert set(routes) == set(oracle.unicast_entries)
+        for pfx in list(oracle.unicast_entries)[:50]:
+            assert routes[pfx] == oracle.unicast_entries[pfx], pfx
+
+        decision.stop()
+        return elapsed
+
+    elapsed = run(body())
+    # generous bound: first full-sync ingest of 1000+ nodes end-to-end
+    assert elapsed < 240, elapsed
+
+
+def test_full_stack_ring_convergence_at_width():
+    """24 full protocol nodes (Spark+KvStore+Decision+Fib each) converge
+    end-to-end over the mock fabric."""
+    from openr_tpu.testing import VirtualNetwork
+    from openr_tpu.testing.wrapper import wait_until
+
+    n = 24
+
+    async def body():
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(f"node-{i}", loopback_prefix=f"10.{i}.0.0/24")
+        for i in range(n):
+            j = (i + 1) % n
+            net.connect(f"node-{i}", f"if-{i}-{j}", f"node-{j}", f"if-{j}-{i}")
+        await net.start_all()
+
+        def converged():
+            for i in range(n):
+                w = net.wrappers[f"node-{i}"]
+                if len(w.adjacent_nodes()) != 2:
+                    return False
+                if len(w.programmed_prefixes()) < n - 1:
+                    return False
+            return True
+
+        await wait_until(converged, timeout=180)
+        # ring shortest paths: node-0 reaches node-12's loopback
+        w0 = net.wrappers["node-0"]
+        assert f"10.{n // 2}.0.0/24" in w0.programmed_prefixes()
+        await net.stop_all()
+
+    run(body())
