@@ -105,7 +105,13 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     if args.config:
         return Config.load_file(args.config)
     cfg = OpenrConfig(node_name=args.node_name, domain=args.domain)
-    cfg.areas = [AreaConfig(a) for a in _csv(args.areas)]
+    # flag-configured areas match everything, as the reference's
+    # GflagConfig does (openr/config/GflagConfig.h:57-63); per-area regex
+    # scoping needs the config-file path
+    cfg.areas = [
+        AreaConfig(a, interface_regexes=[".*"], neighbor_regexes=[".*"])
+        for a in _csv(args.areas)
+    ]
     cfg.openr_ctrl_port = args.openr_ctrl_port
     cfg.fib_port = args.fib_handler_port
     cfg.dryrun = args.dryrun

@@ -197,6 +197,29 @@ def _sell_solver(key: Tuple):
 
 
 @functools.lru_cache(maxsize=64)
+def _sell_solver_patched(key: Tuple):
+    """Patch-and-solve in one dispatch: applies per-bucket weight patches
+    (idx [Pk, 2] of (row, slot), vals [Pk]; out-of-range rows dropped) to
+    the persistent wg buffers, solves, and returns (D, new_wgs) so the
+    caller can keep the patched buffers device-resident. One device
+    dispatch per LSDB event instead of scatter + solve — the host-side
+    share of a flap event is mostly dispatch latency."""
+    zero_end, starts, shapes = key
+
+    def solve(sources, nbrs, wgs, overloaded, patch_idx, patch_vals):
+        new_wgs = tuple(
+            wg_k.at[idx_k[:, 0], idx_k[:, 1]].set(vals_k, mode="drop")
+            for wg_k, idx_k, vals_k in zip(wgs, patch_idx, patch_vals)
+        )
+        d = _sell_fixpoint_core(
+            sources, nbrs, new_wgs, overloaded, zero_end, starts, shapes
+        )
+        return d, new_wgs
+
+    return jax.jit(solve)
+
+
+@functools.lru_cache(maxsize=64)
 def _sell_solver_vw(key: Tuple):
     """Per-row-weights sliced-ELL fixpoint (jitted): the device form of the
     reference's penalized re-solves — KSP's link-ignore runSpf

@@ -48,6 +48,11 @@ from openr_tpu.ops.spf import (
 from openr_tpu.solver.cpu import Metric, SpfSolver
 
 
+# fixed per-bucket patch width for the fused patch+solve executable; events
+# changing more slots per bucket fall back to standalone scatters
+_PATCH_SLOTS = 64
+
+
 class _NodeView:
     """NodeSpfResult-compatible view over the device distance matrix."""
 
@@ -185,7 +190,7 @@ class _AreaSolve:
         the whole LSDB."""
         import jax.numpy as jnp
 
-        from openr_tpu.ops.spf import _sell_solver
+        from openr_tpu.ops.spf import _sell_solver, _sell_solver_patched
 
         g = self.graph
         sell = g.sell
@@ -200,21 +205,58 @@ class _AreaSolve:
                 "ov_host": g.overloaded.copy(),
             }
         else:
-            changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
-            if len(changed):
-                wgs = list(st["wgs"])
-                for k in np.unique(sell.edge_bucket[changed]):
-                    sel = changed[sell.edge_bucket[changed] == k]
-                    wgs[k] = (
-                        wgs[k]
-                        .at[sell.edge_row[sel], sell.edge_slot[sel]]
-                        .set(jnp.asarray(g.w[sel]))
-                    )
-                st["wgs"] = tuple(wgs)
-                st["w_host"] = g.w.copy()
             if not np.array_equal(st["ov_host"], g.overloaded):
                 st["ov"] = jnp.asarray(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
+            changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            if len(changed):
+                st["w_host"] = g.w.copy()
+                # fused patch+solve: one dispatch carries the changed slots
+                # and returns the distances plus the patched buffers, which
+                # stay device-resident for the next event. The patch shape
+                # is FIXED (_PATCH_SLOTS per bucket) so every event shares
+                # one executable — a varying pad would recompile the whole
+                # fixpoint per new event size. Oversized events (SRLG-style
+                # bulk changes) fall back to standalone scatters + plain
+                # solve, whose small ops are cheap to compile per shape.
+                per_bucket = [
+                    changed[sell.edge_bucket[changed] == k]
+                    for k in range(len(sell.nbr))
+                ]
+                if all(len(s_) <= _PATCH_SLOTS for s_ in per_bucket):
+                    idx = []
+                    vals = []
+                    for sel in per_bucket:
+                        a = np.full(
+                            (_PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
+                        )
+                        v = np.zeros(_PATCH_SLOTS, dtype=np.int32)
+                        if len(sel):
+                            a[: len(sel), 0] = sell.edge_row[sel]
+                            a[: len(sel), 1] = sell.edge_slot[sel]
+                            v[: len(sel)] = g.w[sel]
+                        idx.append(jnp.asarray(a))
+                        vals.append(jnp.asarray(v))
+                    fn = _sell_solver_patched(sell.shape_key())
+                    d, new_wgs = fn(
+                        jnp.asarray(rows, dtype=jnp.int32),
+                        st["nbrs"],
+                        st["wgs"],
+                        st["ov"],
+                        tuple(idx),
+                        tuple(vals),
+                    )
+                    st["wgs"] = new_wgs
+                    return d
+                wgs = list(st["wgs"])
+                for k, sel in enumerate(per_bucket):
+                    if len(sel):
+                        wgs[k] = (
+                            wgs[k]
+                            .at[sell.edge_row[sel], sell.edge_slot[sel]]
+                            .set(jnp.asarray(g.w[sel]))
+                        )
+                st["wgs"] = tuple(wgs)
 
         fn = _sell_solver(sell.shape_key())
         return fn(

@@ -36,10 +36,7 @@ from openr_tpu.utils import serializer
 
 
 def run(coro, timeout=300.0):
-    async def body():
-        return await asyncio.wait_for(coro, timeout)
-
-    return asyncio.new_event_loop().run_until_complete(body())
+    return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
 def clos_1000():
