@@ -207,16 +207,23 @@ def _sell_solver_patched(key: Tuple):
     zero_end, starts, shapes = key
 
     def solve(sources, nbrs, wgs, overloaded, patch_idx, patch_vals):
+        # patch_idx [B, P, 2] / patch_vals [B, P]: one upload each, sliced
+        # per bucket at trace time (B is fixed by the shape key)
         new_wgs = tuple(
-            wg_k.at[idx_k[:, 0], idx_k[:, 1]].set(vals_k, mode="drop")
-            for wg_k, idx_k, vals_k in zip(wgs, patch_idx, patch_vals)
+            wg_k.at[patch_idx[k, :, 0], patch_idx[k, :, 1]].set(
+                patch_vals[k], mode="drop"
+            )
+            for k, wg_k in enumerate(wgs)
         )
         d = _sell_fixpoint_core(
             sources, nbrs, new_wgs, overloaded, zero_end, starts, shapes
         )
         return d, new_wgs
 
-    return jax.jit(solve)
+    # donate the replaced weight buffers: the caller always overwrites its
+    # handle with new_wgs, so XLA may update in place instead of allocating
+    # a second full set of buckets per event
+    return jax.jit(solve, donate_argnums=(2,))
 
 
 @functools.lru_cache(maxsize=64)

@@ -224,27 +224,24 @@ class _AreaSolve:
                     for k in range(len(sell.nbr))
                 ]
                 if all(len(s_) <= _PATCH_SLOTS for s_ in per_bucket):
-                    idx = []
-                    vals = []
-                    for sel in per_bucket:
-                        a = np.full(
-                            (_PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
-                        )
-                        v = np.zeros(_PATCH_SLOTS, dtype=np.int32)
+                    nb = len(sell.nbr)
+                    idx = np.full(
+                        (nb, _PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
+                    )
+                    vals = np.zeros((nb, _PATCH_SLOTS), dtype=np.int32)
+                    for k, sel in enumerate(per_bucket):
                         if len(sel):
-                            a[: len(sel), 0] = sell.edge_row[sel]
-                            a[: len(sel), 1] = sell.edge_slot[sel]
-                            v[: len(sel)] = g.w[sel]
-                        idx.append(jnp.asarray(a))
-                        vals.append(jnp.asarray(v))
+                            idx[k, : len(sel), 0] = sell.edge_row[sel]
+                            idx[k, : len(sel), 1] = sell.edge_slot[sel]
+                            vals[k, : len(sel)] = g.w[sel]
                     fn = _sell_solver_patched(sell.shape_key())
                     d, new_wgs = fn(
                         jnp.asarray(rows, dtype=jnp.int32),
                         st["nbrs"],
                         st["wgs"],
                         st["ov"],
-                        tuple(idx),
-                        tuple(vals),
+                        jnp.asarray(idx),
+                        jnp.asarray(vals),
                     )
                     st["wgs"] = new_wgs
                     return d
