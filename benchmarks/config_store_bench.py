@@ -1,0 +1,87 @@
+"""PersistentStore benchmark — the reference's `config_store_benchmark`
+(CMakeLists.txt:782-833): store/load/flush throughput of the write-behind
+disk kv used for drain state, link-metric overrides, and allocated
+prefixes.
+
+Env knobs: CS_KEYS (default 1000), CS_VALUE_BYTES (default 1024).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import List
+
+from benchmarks.common import emit, note
+
+
+def bench_config_store(n_keys: int, value_bytes: int) -> None:
+    """Writes run inside an asyncio loop — the daemon's mode, where flushes
+    are write-behind debounced (PersistentStore docstring); without a loop
+    every store() snapshots immediately (the tool mode), which measures
+    fsync throughput rather than the store."""
+    import asyncio
+
+    from openr_tpu.configstore import PersistentStore
+
+    payload = bytes(value_bytes)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "store.bin")
+
+        async def write_phase() -> float:
+            store = PersistentStore(path)
+            store.store("warm", payload)
+            t0 = time.time()
+            for i in range(n_keys):
+                store.store(f"key-{i:06d}", payload)
+            store.flush()  # one explicit snapshot closes the batch
+            rate = n_keys / (time.time() - t0)
+            store.stop()
+            return rate
+
+        write_rate = asyncio.run(write_phase())
+
+        # cold load path: fresh store reads the snapshot back
+        t0 = time.time()
+        store2 = PersistentStore(path)
+        loaded = sum(
+            1
+            for i in range(n_keys)
+            if store2.load(f"key-{i:06d}") == payload
+        )
+        load_rate = n_keys / (time.time() - t0)
+        assert loaded == n_keys, loaded
+        store2.stop()
+
+    note(
+        f"config-store: {write_rate:,.0f} writes/s (flushed), "
+        f"{load_rate:,.0f} loads/s after reopen"
+    )
+    emit(
+        {
+            "metric": "config_store_writes_per_sec",
+            "value": round(write_rate, 1),
+            "unit": f"writes/s ({value_bytes}B values, snapshot flushed)",
+            "vs_baseline": 1.0,
+        }
+    )
+    emit(
+        {
+            "metric": "config_store_loads_per_sec",
+            "value": round(load_rate, 1),
+            "unit": f"loads/s ({value_bytes}B values, after reopen)",
+            "vs_baseline": 1.0,
+        }
+    )
+
+
+def main(argv: List[str] = ()) -> None:
+    bench_config_store(
+        int(os.environ.get("CS_KEYS", "1000")),
+        int(os.environ.get("CS_VALUE_BYTES", "1024")),
+    )
+
+
+if __name__ == "__main__":
+    main()

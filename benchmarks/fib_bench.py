@@ -1,0 +1,88 @@
+"""Fib programming benchmark.
+
+Equivalent of the reference's `fib_benchmark` binary
+(CMakeLists.txt:782-833): measures route-delta programming throughput
+through the Fib module against the mock agent — the pure module-path cost
+(delta bookkeeping, nexthop dedup, perf logging) that sits between
+Decision's RouteDb delta and the platform agent.
+
+Env knobs: FIB_ROUTES (default 10000), FIB_BATCH (default 500).
+Emits one JSON line per measurement (benchmarks/common.emit contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import List
+
+from benchmarks.common import emit, note
+
+
+def bench_fib_programming(n_routes: int, batch: int) -> None:
+    from openr_tpu.fib import Fib, FibConfig
+    from openr_tpu.messaging import RWQueue
+    from openr_tpu.platform import MockFibHandler
+    from openr_tpu.solver import DecisionRouteUpdate
+    from openr_tpu.solver.routes import RibUnicastEntry
+    from openr_tpu.types import IpPrefix, NextHop
+
+    async def body() -> float:
+        handler = MockFibHandler()
+        fib = Fib(
+            FibConfig(my_node_name="bench"),
+            handler,
+            RWQueue(),
+            RWQueue(),
+        )
+
+        def entry(i: int) -> RibUnicastEntry:
+            return RibUnicastEntry(
+                prefix=IpPrefix(f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}/32"),
+                nexthops={
+                    NextHop(address="fe80::1", iface="po1", metric=10),
+                    NextHop(address="fe80::2", iface="po2", metric=10),
+                },
+            )
+
+        # need >= 2 batches: one warm, rest timed
+        b = batch if n_routes > batch else max(1, n_routes // 4)
+        deltas: List[DecisionRouteUpdate] = []
+        for start in range(0, n_routes, b):
+            deltas.append(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        entry(i)
+                        for i in range(start, min(start + b, n_routes))
+                    ]
+                )
+            )
+        # warm one batch (route-state dict setup)
+        await fib.process_route_updates(deltas[0])
+        t0 = time.time()
+        for delta in deltas[1:]:
+            await fib.process_route_updates(delta)
+        elapsed = time.time() - t0
+        return (n_routes - len(deltas[0].unicast_routes_to_update)) / elapsed, b
+
+    rate, batch = asyncio.run(body())
+    note(f"fib: programmed at {rate:,.0f} routes/s (batch {batch})")
+    emit(
+        {
+            "metric": "fib_program_routes_per_sec",
+            "value": round(rate, 1),
+            "unit": f"routes/s (batches of {batch}, mock agent)",
+            "vs_baseline": 1.0,
+        }
+    )
+
+
+def main(argv: List[str] = ()) -> None:
+    n_routes = int(os.environ.get("FIB_ROUTES", "10000"))
+    batch = int(os.environ.get("FIB_BATCH", "500"))
+    bench_fib_programming(n_routes, batch)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,3 +69,21 @@ def test_scale_bench(capsys, monkeypatch):
         monkeypatch,
     )
     assert len(results) == 4
+
+
+def test_fib_bench(capsys, monkeypatch):
+    from benchmarks.fib_bench import main
+
+    results = run_and_parse(
+        capsys, main, {"FIB_ROUTES": "400", "FIB_BATCH": "100"}, monkeypatch
+    )
+    assert results[0]["metric"] == "fib_program_routes_per_sec"
+
+
+def test_config_store_bench(capsys, monkeypatch):
+    from benchmarks.config_store_bench import main
+
+    results = run_and_parse(
+        capsys, main, {"CS_KEYS": "50", "CS_VALUE_BYTES": "64"}, monkeypatch
+    )
+    assert results[0]["metric"] == "config_store_writes_per_sec"
