@@ -63,7 +63,7 @@ def bench_config_store(n_keys: int, value_bytes: int) -> None:
             "metric": "config_store_writes_per_sec",
             "value": round(write_rate, 1),
             "unit": f"writes/s ({value_bytes}B values, snapshot flushed)",
-            "vs_baseline": 1.0,
+            "vs_baseline": 0.0,  # no reference binary run to compare against
         }
     )
     emit(
@@ -71,7 +71,7 @@ def bench_config_store(n_keys: int, value_bytes: int) -> None:
             "metric": "config_store_loads_per_sec",
             "value": round(load_rate, 1),
             "unit": f"loads/s ({value_bytes}B values, after reopen)",
-            "vs_baseline": 1.0,
+            "vs_baseline": 0.0,  # no reference binary run to compare against
         }
     )
 

@@ -28,7 +28,7 @@ def bench_fib_programming(n_routes: int, batch: int) -> None:
     from openr_tpu.solver.routes import RibUnicastEntry
     from openr_tpu.types import IpPrefix, NextHop
 
-    async def body() -> float:
+    async def body():
         handler = MockFibHandler()
         fib = Fib(
             FibConfig(my_node_name="bench"),
@@ -58,12 +58,21 @@ def bench_fib_programming(n_routes: int, batch: int) -> None:
                     ]
                 )
             )
-        # warm one batch (route-state dict setup)
+        # warm one batch, then complete the initial full sync so the timed
+        # deltas take the incremental agent-programming path instead of the
+        # pre-sync early return (fib/fib.py:374-378)
         await fib.process_route_updates(deltas[0])
+        assert await fib.sync_route_db()
+        fib.has_synced_fib = True  # _run_sync sets this in the daemon path
+        fib._sync_scheduled = False
+        calls_before = handler.counters.get("add_unicast_routes", 0)
         t0 = time.time()
         for delta in deltas[1:]:
             await fib.process_route_updates(delta)
         elapsed = time.time() - t0
+        # the agent must actually have been programmed per delta
+        programmed = handler.counters.get("add_unicast_routes", 0) - calls_before
+        assert programmed == len(deltas) - 1, (programmed, len(deltas) - 1)
         return (n_routes - len(deltas[0].unicast_routes_to_update)) / elapsed, b
 
     rate, batch = asyncio.run(body())
@@ -73,7 +82,7 @@ def bench_fib_programming(n_routes: int, batch: int) -> None:
             "metric": "fib_program_routes_per_sec",
             "value": round(rate, 1),
             "unit": f"routes/s (batches of {batch}, mock agent)",
-            "vs_baseline": 1.0,
+            "vs_baseline": 0.0,  # no reference binary run to compare against
         }
     )
 

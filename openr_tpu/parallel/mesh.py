@@ -51,6 +51,23 @@ def _pad_sources(source_rows: np.ndarray, multiple: int) -> np.ndarray:
     )
 
 
+def _sell_operands(sell, sources, overloaded, mesh: Mesh):
+    """Device-placed sliced-ELL solve operands shared by the sharded entry
+    points: sources batch-sharded, layout leaves + overload mask
+    replicated. Returns (args, in_shardings) aligned with
+    _sell_solver_raw's (sources, nbrs, wgs, overloaded) signature."""
+    row_sharded = NamedSharding(mesh, P("batch"))
+    replicated = NamedSharding(mesh, P())
+    args = (
+        jax.device_put(jnp.asarray(sources), row_sharded),
+        tuple(jax.device_put(jnp.asarray(a), replicated) for a in sell.nbr),
+        tuple(jax.device_put(jnp.asarray(a), replicated) for a in sell.wg),
+        jax.device_put(jnp.asarray(overloaded), replicated),
+    )
+    shardings = (row_sharded, replicated, replicated, replicated)
+    return args, shardings
+
+
 def sharded_batched_spf(
     graph: CompiledGraph, source_rows: np.ndarray, mesh: Mesh
 ) -> jnp.ndarray:
@@ -68,27 +85,15 @@ def sharded_batched_spf(
     replicated = NamedSharding(mesh, P())
     out_sharding = NamedSharding(mesh, P("batch", None))
     if graph.sell is not None:
-        sell = graph.sell
+        args, shardings = _sell_operands(
+            graph.sell, sources, graph.overloaded, mesh
+        )
         fn = jax.jit(
-            _sell_solver_raw(sell.shape_key()),
-            in_shardings=(
-                row_sharded,
-                replicated,  # prefix pytree: every nbr/wg leaf replicated
-                replicated,
-                replicated,
-            ),
+            _sell_solver_raw(graph.sell.shape_key()),
+            in_shardings=shardings,
             out_shardings=out_sharding,
         )
-        return fn(
-            jax.device_put(jnp.asarray(sources), row_sharded),
-            tuple(
-                jax.device_put(jnp.asarray(a), replicated) for a in sell.nbr
-            ),
-            tuple(
-                jax.device_put(jnp.asarray(a), replicated) for a in sell.wg
-            ),
-            jax.device_put(jnp.asarray(graph.overloaded), replicated),
-        )
+        return fn(*args)
     fn = jax.jit(
         _bf_fixpoint,
         in_shardings=(row_sharded, replicated, replicated, replicated, replicated),
@@ -119,6 +124,35 @@ def sharded_spf_step(
     row_sharded = NamedSharding(mesh, P("batch"))
     edge_sharded = NamedSharding(mesh, P("graph"))
     replicated = NamedSharding(mesh, P())
+
+    if graph.sell is not None:
+        # flagship path: sliced-ELL solve (sources batch-sharded, layout
+        # replicated) feeding the edge-sharded ECMP DAG extraction
+        solve = _sell_solver_raw(graph.sell.shape_key())
+        sell_args, sell_shardings = _sell_operands(
+            graph.sell, sources, graph.overloaded, mesh
+        )
+
+        def step(sources_a, nbrs, wgs, overloaded, src_e, dst_e, w_e):
+            d = solve(sources_a, nbrs, wgs, overloaded)
+            dag = _ecmp_dag(d, src_e, dst_e, w_e, overloaded)
+            return d, dag
+
+        fn = jax.jit(
+            step,
+            in_shardings=sell_shardings
+            + (edge_sharded, edge_sharded, edge_sharded),
+            out_shardings=(
+                NamedSharding(mesh, P("batch", None)),
+                NamedSharding(mesh, P("graph", None)),
+            ),
+        )
+        return fn(
+            *sell_args,
+            jax.device_put(jnp.asarray(graph.src), edge_sharded),
+            jax.device_put(jnp.asarray(graph.dst), edge_sharded),
+            jax.device_put(jnp.asarray(graph.w), edge_sharded),
+        )
 
     def step(sources_a, src_e, dst_e, w_e, overloaded):
         d = _bf_fixpoint(sources_a, src_e, dst_e, w_e, overloaded)
