@@ -62,9 +62,13 @@ def bench_fib_programming(n_routes: int, batch: int) -> None:
         # deltas take the incremental agent-programming path instead of the
         # pre-sync early return (fib/fib.py:374-378)
         await fib.process_route_updates(deltas[0])
-        assert await fib.sync_route_db()
+        synced = await fib.sync_route_db()
+        assert synced
         fib.has_synced_fib = True  # _run_sync sets this in the daemon path
         fib._sync_scheduled = False
+        if fib._sync_handle is not None:  # cancel the warm-up's pending sync
+            fib._sync_handle.cancel()
+            fib._sync_handle = None
         calls_before = handler.counters.get("add_unicast_routes", 0)
         t0 = time.time()
         for delta in deltas[1:]:
@@ -81,7 +85,7 @@ def bench_fib_programming(n_routes: int, batch: int) -> None:
         {
             "metric": "fib_program_routes_per_sec",
             "value": round(rate, 1),
-            "unit": f"routes/s (batches of {batch}, mock agent)",
+            "unit": f"routes/s (batches of {batch}, programmed through the mock agent)",
             "vs_baseline": 0.0,  # no reference binary run to compare against
         }
     )

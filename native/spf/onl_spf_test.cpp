@@ -1,0 +1,122 @@
+// C++-side unit tests for the native SPF oracle (assert-based; the image
+// has no gtest). Exercises the Dijkstra semantics of
+// openr/decision/LinkState.cpp:806-880 directly against the C API. Run by
+// tests/test_native_sanitizers.py (also as the ASan/UBSan target).
+
+#include "onl_spf.h"
+
+#include <cassert>
+#include <cstdio>
+#include <vector>
+
+namespace {
+
+struct EdgeList {
+  std::vector<int32_t> src, dst, w;
+  void add(int32_t a, int32_t b, int32_t wt) {
+    src.push_back(a);
+    dst.push_back(b);
+    w.push_back(wt);
+    src.push_back(b);
+    dst.push_back(a);
+    w.push_back(wt);
+  }
+};
+
+void test_line_graph() {
+  // 0 -1- 1 -2- 2 -3- 3
+  EdgeList e;
+  e.add(0, 1, 1);
+  e.add(1, 2, 2);
+  e.add(2, 3, 3);
+  void* h = onl_spf_create(4, (int64_t)e.src.size(), e.src.data(),
+                           e.dst.data(), e.w.data(), nullptr);
+  assert(h);
+  int32_t dist[4];
+  assert(onl_spf_run(h, 0, dist, nullptr, 0) == 4);
+  assert(dist[0] == 0 && dist[1] == 1 && dist[2] == 3 && dist[3] == 6);
+  onl_spf_destroy(h);
+}
+
+void test_ecmp_union() {
+  // diamond: 0->1->3 and 0->2->3, all weight 1: two first hops toward 3
+  EdgeList e;
+  e.add(0, 1, 1);
+  e.add(0, 2, 1);
+  e.add(1, 3, 1);
+  e.add(2, 3, 1);
+  void* h = onl_spf_create(4, (int64_t)e.src.size(), e.src.data(),
+                           e.dst.data(), e.w.data(), nullptr);
+  int32_t dist[4];
+  uint64_t nh[4];
+  assert(onl_spf_run(h, 0, dist, nh, 1) == 4);
+  assert(dist[3] == 2);
+  // node 3's first-hop set has two bits (both out-edge slots of 0)
+  int bits = __builtin_popcountll(nh[3]);
+  assert(bits == 2);
+  assert(__builtin_popcountll(nh[1]) == 1);
+  onl_spf_destroy(h);
+}
+
+void test_overload_no_transit() {
+  // 0 - 1 - 2 with 1 overloaded: 2 unreachable from 0, 1 still reachable
+  EdgeList e;
+  e.add(0, 1, 1);
+  e.add(1, 2, 1);
+  std::vector<uint8_t> ov = {0, 1, 0};
+  void* h = onl_spf_create(3, (int64_t)e.src.size(), e.src.data(),
+                           e.dst.data(), e.w.data(), ov.data());
+  int32_t dist[3];
+  assert(onl_spf_run(h, 0, dist, nullptr, 0) == 2);
+  assert(dist[1] == 1 && dist[2] == ONL_SPF_INF);
+  // from the overloaded node itself, its own edges remain usable
+  assert(onl_spf_run(h, 1, dist, nullptr, 0) == 3);
+  assert(dist[0] == 1 && dist[2] == 1);
+  onl_spf_destroy(h);
+}
+
+void test_weight_patch() {
+  EdgeList e;
+  e.add(0, 1, 1);
+  e.add(1, 2, 1);
+  e.add(0, 2, 5);
+  void* h = onl_spf_create(3, (int64_t)e.src.size(), e.src.data(),
+                           e.dst.data(), e.w.data(), nullptr);
+  int32_t dist[3];
+  onl_spf_run(h, 0, dist, nullptr, 0);
+  assert(dist[2] == 2);
+  // take 1<->2 down (both directions): path flips to the direct edge
+  onl_spf_set_weight(h, 2, ONL_SPF_INF);
+  onl_spf_set_weight(h, 3, ONL_SPF_INF);
+  onl_spf_run(h, 0, dist, nullptr, 0);
+  assert(dist[2] == 5);
+  onl_spf_destroy(h);
+}
+
+void test_bad_inputs() {
+  EdgeList e;
+  e.add(0, 1, 1);
+  assert(onl_spf_create(0, 0, nullptr, nullptr, nullptr, nullptr) ==
+         nullptr);
+  int32_t bad_dst[] = {7};
+  int32_t one[] = {0};
+  assert(onl_spf_create(2, 1, one, bad_dst, one, nullptr) == nullptr);
+  void* h = onl_spf_create(2, (int64_t)e.src.size(), e.src.data(),
+                           e.dst.data(), e.w.data(), nullptr);
+  int32_t dist[2];
+  assert(onl_spf_run(h, -1, dist, nullptr, 0) == -1);
+  assert(onl_spf_run(h, 9, dist, nullptr, 0) == -1);
+  onl_spf_destroy(h);
+}
+
+}  // namespace
+
+int main() {
+  test_line_graph();
+  test_ecmp_union();
+  test_overload_no_transit();
+  test_weight_patch();
+  test_bad_inputs();
+  std::printf("onl_spf_test OK\n");
+  return 0;
+}

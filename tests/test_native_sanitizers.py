@@ -1,0 +1,59 @@
+"""Sanitizer pass over the native C++ components (SURVEY.md §5: the
+reference ships no TSAN/ASAN CI; the rebuild adds one). Builds the C++
+assert suites under AddressSanitizer+UBSan and runs them; any leak,
+overflow, or UB aborts the test."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+OUT = os.path.join(REPO, "openr_tpu", "_native")
+
+
+def _asan_supported() -> bool:
+    if shutil.which("g++") is None:
+        return False
+    probe = subprocess.run(
+        ["g++", "-fsanitize=address", "-x", "c++", "-", "-o", "/dev/null"],
+        input=b"int main(){return 0;}",
+        capture_output=True,
+    )
+    return probe.returncode == 0
+
+
+pytestmark = pytest.mark.skipif(
+    not _asan_supported(), reason="ASan toolchain unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def asan_binaries():
+    subprocess.run(
+        ["make", "-C", NATIVE, "asan"],
+        check=True,
+        capture_output=True,
+        timeout=180,
+    )
+    return OUT
+
+
+@pytest.mark.parametrize(
+    "binary", ["onl_kvstore_test_asan", "onl_spf_test_asan"]
+)
+def test_native_suite_clean_under_asan(asan_binaries, binary):
+    proc = subprocess.run(
+        [os.path.join(asan_binaries, binary)],
+        capture_output=True,
+        timeout=120,
+        env={
+            **os.environ,
+            "ASAN_OPTIONS": "detect_leaks=1:abort_on_error=1",
+            "UBSAN_OPTIONS": "halt_on_error=1",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert b"OK" in proc.stdout
