@@ -510,7 +510,9 @@ def bench_multi_metric(n: int, n_metrics: int, n_sources: int) -> None:
         return acc
 
     fn(*args).block_until_ready()  # keep the sharded executable validated
-    marginal = time_marginal(lambda r: int(chained_fused(r)), 1, 3)
+    # long chains: per-event time is ms-scale, so the delta must dwarf the
+    # tunneled link's sync jitter
+    marginal = time_marginal(lambda r: int(chained_fused(r)), 2, 50)
     rate = s / marginal
 
     # measured baseline: the reference structure — one metric plane (one
@@ -549,7 +551,9 @@ def bench_multi_metric(n: int, n_metrics: int, n_sources: int) -> None:
         )
         return acc
 
-    seq_marginal = time_marginal(lambda r: int(chained_planes(r)), 1, 3)
+    seq_marginal = time_marginal(
+        lambda r: int(chained_planes(r)), 2, 50
+    )
     note(
         f"multi-metric wan{n}: {n_metrics} metrics x {n_sources} sources "
         f"fused {marginal*1e3:.1f}ms vs plane-at-a-time "
