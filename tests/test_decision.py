@@ -403,3 +403,52 @@ class TestDecision:
         assert blob1 == blob2
         pdb = PrefixDatabase("a", [PrefixEntry(IpPrefix(PFX))])
         assert serializer.loads(serializer.dumps(pdb)) == pdb
+
+
+class TestOrderedFib:
+    def test_link_up_held_by_hop_distance_then_released(self):
+        """Ordered-FIB programming (Decision.cpp:1669-1679): a link coming
+        up is held for my hop-distance to the advertising node, so nodes
+        closer to the change program first; decrement ticks release it and
+        trigger a rebuild."""
+
+        async def body():
+            decision, kv_q, route_q = make_decision(enable_ordered_fib=True)
+            reader = route_q.get_reader()
+            decision.start()
+
+            # line a - b - c - d, with d's loopback advertised
+            edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+            dbs = build_adj_dbs(edges)
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[
+                        PrefixDatabase("d", [PrefixEntry(IpPrefix(PFX))])
+                    ],
+                )
+            )
+            delta = await reader.get()
+            routes = {e.prefix for e in delta.unicast_routes_to_update}
+            assert IpPrefix(PFX) in routes
+
+            # b raises its b->c metric (a "down"-direction change
+            # advertised by b): nodes closer to b than the farthest node
+            # hold back so remote nodes program first —
+            # hold_down(a) = max_hops_to(b) - hops(a,b) = 2 - 1 = 1 tick
+            dbs2 = build_adj_dbs([("a", "b", 1), ("b", "c", 5), ("c", "d", 1)])
+            kv_q.push(make_publication(adj_dbs=[dbs2["b"]], version=2))
+            # the held change must not produce an immediate route update
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(reader.get(), 0.15)
+
+            # one hold tick releases the change and triggers the rebuild
+            decision.decrement_ordered_fib_holds()
+            delta2 = await asyncio.wait_for(reader.get(), 5)
+            updated = {
+                e.prefix: e for e in delta2.unicast_routes_to_update
+            }
+            assert IpPrefix(PFX) in updated  # route metric moved 2 -> 6
+            decision.stop()
+
+        run(body())
