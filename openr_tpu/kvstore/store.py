@@ -889,6 +889,17 @@ class KvStore:
     def db(self, area: str = "0") -> KvStoreDb:
         return self.dbs[area]
 
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Merged per-area counters for the monitor registry (counters live
+        on the KvStoreDbs; without this the kvstore.* namespace would be
+        invisible to getCounters)."""
+        merged: Dict[str, int] = {}
+        for db in self.dbs.values():
+            for name, value in db.counters.items():
+                merged[name] = merged.get(name, 0) + value
+        return merged
+
     # -- local API (OpenrCtrl surface) ------------------------------------
 
     def set_key(

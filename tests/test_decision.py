@@ -448,7 +448,11 @@ class TestOrderedFib:
             updated = {
                 e.prefix: e for e in delta2.unicast_routes_to_update
             }
-            assert IpPrefix(PFX) in updated  # route metric moved 2 -> 6
+            assert IpPrefix(PFX) in updated
+            # the released change is reflected: path a-b-c-d now costs
+            # 1 + 5 + 1 = 7 through the raised b->c metric
+            nh = next(iter(updated[IpPrefix(PFX)].nexthops))
+            assert nh.metric == 7, nh
             decision.stop()
 
         run(body())
