@@ -38,6 +38,9 @@ class KvstoreConfig:
     key_originator_id_filters: List[str] = field(default_factory=list)
     enable_flood_optimization: bool = False
     is_flood_root: bool = False
+    # keep the key->Value table + CRDT merge in the native C++ engine
+    # (native/kvstore) when the library is available
+    enable_native_store: bool = True
 
 
 @dataclass

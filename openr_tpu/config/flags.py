@@ -54,6 +54,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kvstore_key_ttl_ms", type=int, default=300_000)
     p.add_argument("--kvstore_sync_interval_s", type=int, default=60)
     p.add_argument("--enable_flood_optimization", action="store_true")
+    p.add_argument("--noenable_native_kvstore", dest="enable_native_kvstore", action="store_false", default=True, help="disable the C++ KvStore engine even when built")
     p.add_argument("--is_flood_root", action="store_true")
     # decision (Runbook.md:425-435 debounce; rebuild's backend selector)
     p.add_argument("--decision_debounce_min_ms", type=float, default=10.0)
@@ -136,6 +137,7 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     kv.key_ttl_ms = args.kvstore_key_ttl_ms
     kv.sync_interval_s = args.kvstore_sync_interval_s
     kv.enable_flood_optimization = args.enable_flood_optimization
+    kv.enable_native_store = args.enable_native_kvstore
     kv.is_flood_root = args.is_flood_root
 
     dc = cfg.decision_config

@@ -118,6 +118,7 @@ class OpenrDaemon:
                     c.kvstore_config.enable_flood_optimization
                 ),
                 is_flood_root=c.kvstore_config.is_flood_root,
+                use_native_store=c.kvstore_config.enable_native_store,
             ),
             loop=loop,
         )

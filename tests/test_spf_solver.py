@@ -559,12 +559,11 @@ class TestMultiArea:
         assert {nh.neighbor_node for nh in rd.nexthops} == {"b"}
 
 
-class TestMultiArea:
-    """My node participates in two areas (DecisionTest.cpp:4503 fixture
-    shape): per-area SPF with cross-area best-announcer selection."""
+class TestMultiAreaBackends:
+    """TPU/CPU parity and degenerate cases for multi-area selection
+    (complements TestMultiArea's announcer/ECMP/area-label coverage)."""
 
-    def _two_area_network(self, m0=1, m1=1):
-        # area 0: a - b   (metric m0); area 1: a - c   (metric m1)
+    def _two_area_network(self, m0, m1):
         ls0 = LinkState("0")
         for db in build_adj_dbs([("a", "b", m0)], area="0").values():
             ls0.update_adjacency_database(db)
@@ -572,7 +571,6 @@ class TestMultiArea:
         for db in build_adj_dbs([("a", "c", m1)], area="1").values():
             ls1.update_adjacency_database(db)
         ps = PrefixState()
-        # the same prefix announced by b (area 0) and c (area 1)
         ps.update_prefix_database(
             PrefixDatabase("b", [PrefixEntry(IpPrefix(PFX_A))], area="0")
         )
@@ -581,28 +579,11 @@ class TestMultiArea:
         )
         return {"0": ls0, "1": ls1}, ps
 
-    def test_best_announcer_across_areas(self):
-        als, ps = self._two_area_network(m0=10, m1=2)
-        db = SpfSolver("a").build_route_db("a", als, ps)
-        entry = db.unicast_entries[IpPrefix(PFX_A)]
-        # the closer announcer (c in area 1, metric 2) wins
-        nhs = {nh.neighbor_node for nh in entry.nexthops}
-        assert nhs == {"c"}
-        assert next(iter(entry.nexthops)).metric == 2
-
-    def test_equal_metric_ecmp_across_areas(self):
-        als, ps = self._two_area_network(m0=3, m1=3)
-        db = SpfSolver("a").build_route_db("a", als, ps)
-        entry = db.unicast_entries[IpPrefix(PFX_A)]
-        nhs = {nh.neighbor_node for nh in entry.nexthops}
-        # equal-cost announcers in different areas form a cross-area ECMP
-        assert nhs == {"b", "c"}
-
     def test_tpu_backend_multi_area_parity(self):
         from openr_tpu.solver import TpuSpfSolver
 
         for m0, m1 in ((10, 2), (3, 3), (1, 9)):
-            als, ps = self._two_area_network(m0=m0, m1=m1)
+            als, ps = self._two_area_network(m0, m1)
             cpu = SpfSolver("a").build_route_db("a", als, ps)
             tpu = TpuSpfSolver("a").build_route_db("a", als, ps)
             assert cpu == tpu, (m0, m1)
@@ -622,7 +603,7 @@ class TestMultiArea:
         ps.update_prefix_database(
             PrefixDatabase("y", [PrefixEntry(IpPrefix(PFX_B))], area="1")
         )
-        db = SpfSolver("a").build_route_db("a", als := {"0": ls0, "1": ls1}, ps)
+        db = SpfSolver("a").build_route_db("a", {"0": ls0, "1": ls1}, ps)
         assert IpPrefix(PFX_A) in db.unicast_entries
         # unreachable area's prefix yields no route (no announcer reachable)
         assert IpPrefix(PFX_B) not in db.unicast_entries
