@@ -69,8 +69,10 @@ def bench_wan() -> dict:
     # 128 sources = one 128-lane int32 tile in the minor dim — measured the
     # sweet spot on v5e (2500 SPF/s vs ~1650 at 1024 sources)
     n_sources = int(os.environ.get("BENCH_WAN_SOURCES", "128"))
-    reps_small = int(os.environ.get("BENCH_REPS_SMALL", "1"))
-    reps_big = int(os.environ.get("BENCH_REPS_BIG", "3"))
+    # chains long enough that the measured delta dwarfs the tunneled
+    # link's sync jitter (~100ms): 8 extra events x ~50ms each
+    reps_small = int(os.environ.get("BENCH_REPS_SMALL", "2"))
+    reps_big = int(os.environ.get("BENCH_REPS_BIG", "10"))
     events = max(reps_big, reps_small)
 
     t0 = time.time()
@@ -150,7 +152,7 @@ def bench_wan() -> dict:
         solver.close()
         _note("sanity: device distances match native oracle")
         cpu_rate = _native_rate(
-            graph, int(os.environ.get("BENCH_CPU_SAMPLES", "16"))
+            graph, int(os.environ.get("BENCH_CPU_SAMPLES", "32"))
         )
         baseline = "native-c++"
     else:  # toolchain missing: no honest baseline to report

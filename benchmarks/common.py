@@ -37,13 +37,16 @@ def compile_edges(
 
 
 def time_marginal(run, reps_small: int, reps_big: int, rounds: int = 3) -> float:
-    """Best marginal seconds/rep between a short and a long chained run.
+    """Median marginal seconds/rep between a short and a long chained run.
 
-    `run(reps)` must block until the device is done.
+    `run(reps)` must block until the device is done. The median of the
+    positive per-round marginals is reported — taking the minimum would
+    systematically favor rounds where link-sync jitter happened to inflate
+    the short chain and deflate the long one.
     """
     run(reps_small)  # compile/warm
     run(reps_big)
-    best = float("inf")
+    marginals = []
     t_big = None
     for _ in range(rounds):
         t0 = time.time()
@@ -53,11 +56,11 @@ def time_marginal(run, reps_small: int, reps_big: int, rounds: int = 3) -> float
         run(reps_big)
         t_big = time.time() - t0
         marginal = (t_big - t_small) / (reps_big - reps_small)
-        if marginal > 0:  # noise guard
-            best = min(best, marginal)
-    if not np.isfinite(best):
-        best = t_big / reps_big
-    return best
+        if marginal > 0:  # noise guard: jitter can invert tiny pairs
+            marginals.append(marginal)
+    if not marginals:
+        return t_big / reps_big
+    return float(np.median(marginals))
 
 
 def emit(result: dict) -> None:
