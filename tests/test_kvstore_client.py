@@ -1,0 +1,108 @@
+"""KvStoreClient persist semantics (KvStoreClientInternal parity,
+openr/kvstore/KvStoreClientInternal.{h,cpp}): re-advertise on overwrite,
+ttl-version refresh, unset, and key subscriptions."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.kvstore import KvStore, KvStoreClient, KvStoreParams
+from openr_tpu.kvstore.transport import InProcessTransport
+from openr_tpu.types import TTL_INFINITY, Value
+
+
+def run(coro, timeout=10.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def wait_for(predicate, timeout=5.0, interval=0.01):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while not predicate():
+        if loop.time() >= deadline:
+            raise AssertionError("condition not reached")
+        await asyncio.sleep(interval)
+
+
+def make_store(node="n1"):
+    return KvStore(node, ["0"], InProcessTransport())
+
+
+class TestPersistKey:
+    def test_persist_then_overwrite_readvertises(self):
+        async def body():
+            store = make_store()
+            client = KvStoreClient(store)
+            client.persist_key("adj:n1", b"mine")
+            v = store.get_key("adj:n1")
+            assert v.version == 1 and v.originator_id == "n1"
+
+            # a higher-version write from another originator lands...
+            store.set_key(
+                "adj:n1",
+                Value(version=5, originator_id="zz", value=b"theirs"),
+            )
+            # ...and the client re-advertises above it
+            await wait_for(
+                lambda: (
+                    (cur := store.get_key("adj:n1")) is not None
+                    and cur.originator_id == "n1"
+                    and cur.version > 5
+                    and cur.value == b"mine"
+                )
+            )
+            client.stop()
+
+        run(body())
+
+    def test_unset_stops_readvertising(self):
+        async def body():
+            store = make_store()
+            client = KvStoreClient(store)
+            client.persist_key("k", b"mine")
+            client.unset_key("k")
+            store.set_key(
+                "k", Value(version=9, originator_id="zz", value=b"theirs")
+            )
+            await asyncio.sleep(0.1)  # give _watch a chance to (not) react
+            cur = store.get_key("k")
+            assert cur.originator_id == "zz" and cur.version == 9
+            client.stop()
+
+        run(body())
+
+    def test_ttl_refresh_bumps_ttl_version(self):
+        async def body():
+            store = make_store()
+            client = KvStoreClient(store)
+            client.persist_key("k", b"mine", ttl=200)  # refresh at ~50ms
+            v0 = store.get_key("k")
+            # capture ints: the store hands back its live Value object and
+            # ttl refreshes mutate it in place
+            ttl_version0, version0 = v0.ttl_version, v0.version
+            await wait_for(
+                lambda: store.get_key("k").ttl_version > ttl_version0,
+                timeout=5,
+            )
+            cur = store.get_key("k")
+            assert cur.value == b"mine" and cur.version == version0
+            client.stop()
+
+        run(body())
+
+    def test_subscription_fires_on_update(self):
+        async def body():
+            store = make_store()
+            client = KvStoreClient(store)
+            seen = []
+            client.subscribe_key("watched", lambda k, v: seen.append((k, v)))
+            store.set_key(
+                "watched",
+                Value(version=1, originator_id="zz", value=b"x"),
+            )
+            await wait_for(lambda: len(seen) >= 1)
+            key, value = seen[0]
+            assert key == "watched" and value.value == b"x"
+            client.stop()
+
+        run(body())
