@@ -137,7 +137,13 @@ def bench_clos_flap(pods: int, events: int = 8) -> None:
         for i in range(count):
             ls.update_adjacency_database(variants[(i + t_start) % 2])
             g = area.graph = refresh_graph(area.graph, ls)
-            changed = np.nonzero(w_host[: g.e] != g.w[: g.e])[0]
+            # mirror the solver's provenance fast path: diff only the
+            # changelog-touched positions when available
+            if g.changed_edges is not None:
+                cand = g.changed_edges
+                changed = cand[w_host[cand] != g.w[cand]]
+            else:
+                changed = np.nonzero(w_host[: g.e] != g.w[: g.e])[0]
             if len(changed):
                 stacks = list(wg_stacks)
                 for k in np.unique(sell.edge_bucket[changed]):
@@ -147,7 +153,7 @@ def bench_clos_flap(pods: int, events: int = 8) -> None:
                         .at[0, sell.edge_row[sel], sell.edge_slot[sel]]
                         .set(jnp.asarray(g.w[sel]))
                     )
-                w_host = g.w.copy()
+                w_host[changed] = g.w[changed]
 
     w_host = g.w.copy()
     _host_events(2, 0)  # warm the scatter executables outside the timing

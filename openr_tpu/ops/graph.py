@@ -112,6 +112,12 @@ class CompiledGraph:
     # sliced-ELL pull layout; None when the degree profile disqualifies it
     # (_SELL_UNROLL_CAP) and the edge-list segment-min form is used instead
     sell: Optional[SlicedEll] = None
+    # provenance of a weight-patch refresh: the version this graph was
+    # patched FROM and the edge positions whose weights differ — lets the
+    # device-buffer layer skip its O(E) diff when its snapshot matches
+    # parent_version. None/-2 for full builds.
+    parent_version: int = -2
+    changed_edges: Optional[np.ndarray] = None
 
 
 # Degree-class merging: adjacent in-degrees merge while the extra padded
@@ -341,6 +347,7 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
     sell = graph.sell
     wgs = [a.copy() for a in sell.wg] if sell is not None else None
     overloaded = graph.overloaded.copy()
+    touched: List[int] = []
     for kind, obj in changes:
         if kind == "link":
             pos = graph.link_edges.get(obj)
@@ -352,6 +359,7 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
                 (pos[1], obj.metric_from_node(obj.n2)),
             ):
                 w[p] = metric if up else INF
+                touched.append(p)
                 if wgs is not None:
                     wgs[sell.edge_bucket[p]][
                         sell.edge_row[p], sell.edge_slot[p]
@@ -388,4 +396,6 @@ def refresh_graph(graph: CompiledGraph, link_state: LinkState) -> CompiledGraph:
         version=link_state.version,
         log_pos=link_state.graph_log_pos,
         sell=new_sell,
+        parent_version=graph.version,
+        changed_edges=np.unique(np.asarray(touched, dtype=np.int64)),
     )

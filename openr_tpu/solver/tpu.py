@@ -202,15 +202,26 @@ class _AreaSolve:
                 "wgs": tuple(jnp.asarray(a) for a in sell.wg),
                 "ov": jnp.asarray(g.overloaded),
                 "w_host": g.w.copy(),
+                "w_ver": g.version,
                 "ov_host": g.overloaded.copy(),
             }
         else:
             if not np.array_equal(st["ov_host"], g.overloaded):
                 st["ov"] = jnp.asarray(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
-            changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            if (
+                g.changed_edges is not None
+                and g.parent_version == st.get("w_ver")
+            ):
+                # refresh provenance matches our snapshot: diff only the
+                # positions the changelog touched instead of all of w
+                cand = g.changed_edges
+                changed = cand[st["w_host"][cand] != g.w[cand]]
+            else:
+                changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            st["w_ver"] = g.version  # snapshot is current even if no diff
             if len(changed):
-                st["w_host"] = g.w.copy()
+                st["w_host"][changed] = g.w[changed]
                 # fused patch+solve: one dispatch carries the changed slots
                 # and returns the distances plus the patched buffers, which
                 # stay device-resident for the next event. The patch shape

@@ -628,3 +628,59 @@ class TestRouteDbParity:
         # unchanged topology: cached solve reused
         tpu.build_route_db("a", {"0": ls}, ps)
         assert tpu.device_solves == solves_before + 1
+
+
+class TestDeviceBufferProvenance:
+    def test_two_refreshes_without_solve_fall_back_to_full_diff(self):
+        """Safety of the changed-edges fast path: if the solver's device
+        snapshot is two refreshes behind (parent_version mismatch), the
+        full diff must catch BOTH events' weight changes — a silent miss
+        here means stale device weights and wrong routes, not a crash."""
+        import dataclasses
+
+        from openr_tpu.solver import SpfSolver, TpuSpfSolver
+        from openr_tpu.lsdb.prefix_state import PrefixState
+        from openr_tpu.types import IpPrefix, PrefixDatabase, PrefixEntry
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("a", "d", 9)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        ps = PrefixState()
+        for i, node in enumerate(sorted(dbs)):
+            ps.update_prefix_database(
+                PrefixDatabase(
+                    node, [PrefixEntry(IpPrefix(f"10.{i}.0.0/24"))], area="0"
+                )
+            )
+        tpu = TpuSpfSolver("a")
+        assert tpu.build_route_db("a", {"0": ls}, ps) == SpfSolver(
+            "a"
+        ).build_route_db("a", {"0": ls}, ps)
+
+        # two graph refreshes with NO solve in between: the device
+        # snapshot (w_ver) is two versions behind, so the fast-path guard
+        # must fail and the full diff must catch both events' changes
+        from openr_tpu.ops.graph import refresh_graph
+
+        area = tpu._solves[(ls.area, "a")][1]
+        for metric in (5, 7):
+            db = dbs["b"]
+            db = dataclasses.replace(
+                db,
+                adjacencies=[
+                    dataclasses.replace(adj, metric=metric)
+                    for adj in db.adjacencies
+                ],
+            )
+            dbs["b"] = db
+            ls.update_adjacency_database(db)
+            area.graph = refresh_graph(area.graph, ls)
+        assert area.graph.parent_version != area._dev["w_ver"]
+
+        # solving against the doubly-refreshed graph must see the final
+        # weights (stale device buffers here would mean wrong distances)
+        area._solve()
+        got = tpu.build_route_db("a", {"0": ls}, ps)
+        want = SpfSolver("a").build_route_db("a", {"0": ls}, ps)
+        assert got == want
+        assert area._dev["w_ver"] == area.graph.version
