@@ -10,6 +10,8 @@ seam here; the real UDP provider wraps asyncio datagram transports.
 from __future__ import annotations
 
 import asyncio
+import socket
+import struct
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -52,9 +54,10 @@ class UdpIoProvider(IoProvider):
     an IPv4 group supported for environments without usable link-local
     IPv6 (e.g. loopback in containers, where same-host instances share the
     port via SO_REUSEPORT and the kernel delivers the group to every
-    member). Receive timestamps are taken at datagram arrival — the
-    userspace stand-in for the reference's kernel timestamps
-    (spark/IoProvider.h recvfrom with SO_TIMESTAMPNS).
+    member). Receive timestamps come from the kernel via SO_TIMESTAMPNS
+    ancillary data (the reference's scheme, spark/IoProvider.h), rebased
+    onto the monotonic clock Spark's RTT math uses; when the option is
+    unsupported the arrival-time fallback applies.
     """
 
     def __init__(
@@ -69,17 +72,20 @@ class UdpIoProvider(IoProvider):
         self._v6 = ":" in group
         self._loop = loop
         self._callback = None
-        # if_name -> (socket, asyncio transport, ifindex or None)
+        # if_name -> (socket, event loop, ifindex or None)
         self._endpoints: Dict[str, Tuple[object, object, Optional[int]]] = {}
         self._opening: set = set()  # interfaces with an open in flight
         self._closed = False
+        # kernel timestamps are CLOCK_REALTIME; Spark's RTT math subtracts
+        # monotonic now_us() values, so rebase with a fixed offset sampled
+        # once (NTP slew is absorbed by the RTT step detector)
+        self._mono_minus_real_us = int(
+            time.monotonic() * 1_000_000 - time.time() * 1_000_000
+        )
 
     # -- socket plumbing -------------------------------------------------
 
     def _make_socket(self, if_name: str):
-        import socket
-        import struct
-
         if self._v6:
             sock = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -124,6 +130,11 @@ class UdpIoProvider(IoProvider):
             )
         except (OSError, AttributeError):
             pass  # unprivileged: wildcard-bound socket still works
+        try:
+            # kernel receive timestamps (spark/IoProvider.h SO_TIMESTAMPNS)
+            sock.setsockopt(socket.SOL_SOCKET, _SO_TIMESTAMPNS, 1)
+        except OSError:
+            pass  # fallback: arrival-time stamps in _on_readable
         sock.setblocking(False)
         return sock
 
@@ -139,38 +150,73 @@ class UdpIoProvider(IoProvider):
             socket_mod.if_nametoindex(if_name) if self._v6 else None
         )
         loop = self._loop or asyncio.get_event_loop()
-        provider = self
-
-        class _Proto(asyncio.DatagramProtocol):
-            def datagram_received(self, data: bytes, addr) -> None:
-                callback = provider._callback
-                if callback is None:
-                    return
-                try:
-                    packet = packet_from_bytes(data)
-                except (ValueError, KeyError, TypeError, AttributeError):
-                    return  # not a Spark packet; ignore
-                callback(
-                    ReceivedPacket(
-                        if_name=if_name,
-                        packet=packet,
-                        recv_ts_us=provider.now_us(),
-                    )
-                )
-
-        transport, _ = await loop.create_datagram_endpoint(
-            _Proto, sock=sock
-        )
         if self._closed:  # closed while this open was in flight
-            transport.close()
+            sock.close()
             return
-        self._endpoints[if_name] = (sock, transport, ifindex)
+        # raw reader (not a DatagramProtocol): recvmsg exposes the
+        # SCM_TIMESTAMPNS ancillary data asyncio transports hide
+        loop.add_reader(sock.fileno(), self._on_readable, if_name, sock)
+        self._endpoints[if_name] = (sock, loop, ifindex)
+
+    def _on_readable(self, if_name: str, sock) -> None:
+        """Drain the socket; each datagram carries its kernel receive
+        timestamp (SCM_TIMESTAMPNS cmsg) when the option took."""
+        while True:
+            try:
+                data, ancdata, _flags, _addr = sock.recvmsg(65535, 256)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return  # socket closed under us
+            if not data:
+                return
+            recv_us = None
+            for level, ctype, cdata in ancdata:
+                if (
+                    level == socket.SOL_SOCKET
+                    and ctype == _SO_TIMESTAMPNS
+                    and len(cdata) >= 16
+                ):
+                    sec, nsec = _TIMESPEC.unpack_from(cdata)
+                    rt_us = sec * 1_000_000 + nsec // 1_000
+                    recv_us = rt_us + self._mono_minus_real_us
+                    # a realtime clock STEP (not slew) would skew every
+                    # future stamp: resample the rebase offset when the
+                    # stamp disagrees with the monotonic clock by >100ms
+                    if abs(recv_us - self.now_us()) > 100_000:
+                        self._mono_minus_real_us = int(
+                            time.monotonic() * 1_000_000
+                            - time.time() * 1_000_000
+                        )
+                        recv_us = rt_us + self._mono_minus_real_us
+                        if abs(recv_us - self.now_us()) > 100_000:
+                            recv_us = None  # still off: distrust the stamp
+            callback = self._callback
+            if callback is None:
+                continue
+            try:
+                packet = packet_from_bytes(data)
+            except (ValueError, KeyError, TypeError, AttributeError):
+                continue  # not a Spark packet; ignore
+            callback(
+                ReceivedPacket(
+                    if_name=if_name,
+                    packet=packet,
+                    recv_ts_us=(
+                        recv_us if recv_us is not None else self.now_us()
+                    ),
+                )
+            )
 
     def close(self) -> None:
         self._closed = True
         self._callback = None
-        for _, transport, _ifindex in self._endpoints.values():
-            transport.close()
+        for sock, loop, _ifindex in self._endpoints.values():
+            try:
+                loop.remove_reader(sock.fileno())
+            except (OSError, ValueError):
+                pass
+            sock.close()
         self._endpoints.clear()
         self._opening.clear()
 
@@ -205,13 +251,22 @@ class UdpIoProvider(IoProvider):
                 loop = self._loop or asyncio.get_event_loop()
                 loop.create_task(_open())
             return now
-        _sock, transport, ifindex = endpoint
+        sock, _loop, ifindex = endpoint
         data = packet_to_bytes(packet)
-        if self._v6:
-            transport.sendto(data, (self.group, self.port, 0, ifindex))
-        else:
-            transport.sendto(data, (self.group, self.port))
+        try:
+            if self._v6:
+                sock.sendto(data, (self.group, self.port, 0, ifindex))
+            else:
+                sock.sendto(data, (self.group, self.port))
+        except OSError:
+            pass  # dropped datagram (incl. EAGAIN): Spark's timers retransmit
         return now
+
+
+# SOL_SOCKET option/cmsg number for nanosecond receive timestamps
+# (asm-generic sockios: SO_TIMESTAMPNS_OLD == SCM_TIMESTAMPNS == 35)
+_SO_TIMESTAMPNS = getattr(socket, "SO_TIMESTAMPNS", 35)
+_TIMESPEC = struct.Struct("@qq")
 
 
 def _ipv4_addr_of(if_name: str) -> str:
@@ -219,8 +274,6 @@ def _ipv4_addr_of(if_name: str) -> str:
     if if_name == "lo":
         return "127.0.0.1"
     import fcntl
-    import socket
-    import struct
 
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
