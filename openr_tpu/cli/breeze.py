@@ -425,6 +425,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=2018)
+    # mutual TLS against a secured daemon (--enable_secure_thrift_server)
+    parser.add_argument("--x509_ca_path", default=None)
+    parser.add_argument("--x509_cert_path", default=None)
+    parser.add_argument("--x509_key_path", default=None)
     sub = parser.add_subparsers(dest="module", required=True)
 
     kv = sub.add_parser("kvstore").add_subparsers(dest="cmd", required=True)
@@ -515,8 +519,17 @@ _HANDLERS = {
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    ssl_ctx = None
+    if args.x509_ca_path:
+        from openr_tpu.utils.tls import client_ssl_context
+
+        ssl_ctx = client_ssl_context(
+            args.x509_ca_path, args.x509_cert_path, args.x509_key_path
+        )
     try:
-        with BlockingCtrlClient(args.host, args.port) as client:
+        with BlockingCtrlClient(
+            args.host, args.port, ssl_context=ssl_ctx
+        ) as client:
             _HANDLERS[args.module](client, args)
         return 0
     except ConnectionRefusedError:

@@ -173,6 +173,13 @@ class OpenrConfig:
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     enable_bgp_peering: bool = False
     bgp_use_igp_metric: bool = False
+    # mutual TLS for the ctrl server and KvStore TCP peering
+    # (openr/Main.cpp:517-543 TLS setup semantics)
+    enable_secure_thrift_server: bool = False
+    x509_cert_path: Optional[str] = None
+    x509_key_path: Optional[str] = None
+    x509_ca_path: Optional[str] = None
+    tls_acceptable_peers: List[str] = field(default_factory=list)
 
 
 _ENUM_FIELDS = {

@@ -76,6 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable_rib_policy", action="store_true")
     p.add_argument("--enable_ordered_fib_programming", action="store_true")
     p.add_argument("--enable_bgp_peering", action="store_true")
+    # TLS (Flags.cpp: enable_secure_thrift_server, x509_*_path,
+    # tls_acceptable_peers)
+    p.add_argument("--enable_secure_thrift_server", action="store_true")
+    p.add_argument("--x509_cert_path", default=None)
+    p.add_argument("--x509_key_path", default=None)
+    p.add_argument("--x509_ca_path", default=None)
+    p.add_argument("--tls_acceptable_peers", default="", help="comma-separated peer common names; empty accepts any CA-verified peer")
     # prefix allocation (Flags.cpp: enable_prefix_alloc, seed_prefix,
     # alloc_prefix_len, set/override_loopback_addr, loopback_iface)
     p.add_argument("--enable_prefix_alloc", action="store_true")
@@ -123,6 +130,11 @@ def config_from_flags(args: argparse.Namespace) -> Config:
     cfg.enable_rib_policy = args.enable_rib_policy
     cfg.enable_ordered_fib_programming = args.enable_ordered_fib_programming
     cfg.enable_bgp_peering = args.enable_bgp_peering
+    cfg.enable_secure_thrift_server = args.enable_secure_thrift_server
+    cfg.x509_cert_path = args.x509_cert_path
+    cfg.x509_key_path = args.x509_key_path
+    cfg.x509_ca_path = args.x509_ca_path
+    cfg.tls_acceptable_peers = _csv(args.tls_acceptable_peers)
     cfg.eor_time_s = args.eor_time_s
 
     sp = cfg.spark_config

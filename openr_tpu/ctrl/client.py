@@ -35,16 +35,22 @@ class CtrlError(RuntimeError):
 class CtrlClient:
     """Async client: one connection, sequential request/response."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 2018) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 2018,
+        ssl_context=None,
+    ) -> None:
         self.host = host
         self.port = port
+        self._ssl_context = ssl_context
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._next_id = 0
 
     async def connect(self) -> "CtrlClient":
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port, ssl=self._ssl_context
         )
         return self
 
@@ -97,9 +103,15 @@ class BlockingCtrlClient:
     """Synchronous client for CLI usage."""
 
     def __init__(
-        self, host: str = "127.0.0.1", port: int = 2018, timeout: float = 30.0
+        self,
+        host: str = "127.0.0.1",
+        port: int = 2018,
+        timeout: float = 30.0,
+        ssl_context=None,
     ) -> None:
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        if ssl_context is not None:
+            self._sock = ssl_context.wrap_socket(self._sock)
         self._file = self._sock.makefile("rwb")
         self._next_id = 0
 

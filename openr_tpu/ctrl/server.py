@@ -102,10 +102,14 @@ class CtrlServer:
         config_store=None,
         config=None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        ssl_context=None,
+        tls_acceptable_peers=None,
     ) -> None:
         self.node_name = node_name
         self.host = host
         self.port = port
+        self._ssl_context = ssl_context
+        self._tls_acceptable_peers = tls_acceptable_peers
         self.kvstore = kvstore
         self.decision = decision
         self.fib = fib
@@ -129,7 +133,7 @@ class CtrlServer:
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, ssl=self._ssl_context
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -153,6 +157,13 @@ class CtrlServer:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        if self._ssl_context is not None:
+            from openr_tpu.utils.tls import enforce_acceptable_peer
+
+            if not enforce_acceptable_peer(
+                writer, self._tls_acceptable_peers, log, "ctrl"
+            ):
+                return
         try:
             while True:
                 line = await reader.readline()

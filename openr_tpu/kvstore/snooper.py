@@ -56,9 +56,10 @@ def snoop(
     prefixes: Optional[Iterable[str]] = None,
     out=sys.stdout,
     max_frames: Optional[int] = None,
+    ssl_context=None,
 ) -> int:
     """Stream publications and print them; returns frames consumed."""
-    client = BlockingCtrlClient(host, port)
+    client = BlockingCtrlClient(host, port, ssl_context=ssl_context)
     frames = 0
     try:
         for pub in client.subscribe(

@@ -42,11 +42,18 @@ class KvStoreTcpServer:
     """Serves one KvStore's peer-RPC surface on a TCP listen socket."""
 
     def __init__(
-        self, store, host: str = "127.0.0.1", port: int = 0
+        self,
+        store,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+        tls_acceptable_peers=None,
     ) -> None:
         self._store = store
         self.host = host
         self.port = port  # 0 = ephemeral; real port filled in by start()
+        self._ssl_context = ssl_context
+        self._tls_acceptable_peers = tls_acceptable_peers
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
 
@@ -56,7 +63,11 @@ class KvStoreTcpServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._serve_conn, self.host, self.port, limit=_MAX_LINE
+            self._serve_conn,
+            self.host,
+            self.port,
+            limit=_MAX_LINE,
+            ssl=self._ssl_context,
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -74,6 +85,14 @@ class KvStoreTcpServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         self._writers.add(writer)
+        if self._ssl_context is not None:
+            from openr_tpu.utils.tls import enforce_acceptable_peer
+
+            if not enforce_acceptable_peer(
+                writer, self._tls_acceptable_peers, log, "kvstore tcp"
+            ):
+                self._writers.discard(writer)
+                return
         try:
             while True:
                 line = await reader.readline()
@@ -150,9 +169,10 @@ class KvStoreTcpServer:
 class _PeerConn:
     """One persistent connection; requests serialized under a lock."""
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, ssl_context=None) -> None:
         self.host = host
         self.port = port
+        self._ssl_context = ssl_context
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self.lock = asyncio.Lock()
@@ -162,7 +182,10 @@ class _PeerConn:
         if self.writer is None or self.writer.is_closing():
             self.reader, self.writer = await asyncio.wait_for(
                 asyncio.open_connection(
-                    self.host, self.port, limit=_MAX_LINE
+                    self.host,
+                    self.port,
+                    limit=_MAX_LINE,
+                    ssl=self._ssl_context,
                 ),
                 timeout=connect_timeout,
             )
@@ -225,14 +248,25 @@ class TcpTransport(KvStoreTransport):
     """KvStoreTransport over TCP; peer_addr is "host:port"."""
 
     def __init__(
-        self, connect_timeout: float = 5.0, rpc_timeout: float = 120.0
+        self,
+        connect_timeout: float = 5.0,
+        rpc_timeout: float = 120.0,
+        ssl_context=None,
     ) -> None:
+        self._ssl_context = ssl_context
         self._conns: Dict[Tuple[str, int], _PeerConn] = {}
         # connect_timeout bounds connection establishment; rpc_timeout
         # bounds a whole exchange and must stay generous — a full-sync
         # dump of a large LSDB is one (big) response line
         self._connect_timeout = connect_timeout
         self._rpc_timeout = rpc_timeout
+
+    def set_ssl_context(self, ssl_context) -> None:
+        """Install a client TLS context before any peer connection exists
+        (the daemon wires TLS from config after constructing the
+        transport); refuses once plaintext connections are cached."""
+        assert not self._conns, "peer connections already established"
+        self._ssl_context = ssl_context
 
     @staticmethod
     def _parse(peer_addr: str) -> Tuple[str, int]:
@@ -252,7 +286,9 @@ class TcpTransport(KvStoreTransport):
         key = self._parse(peer_addr)
         conn = self._conns.get(key)
         if conn is None:
-            conn = self._conns[key] = _PeerConn(*key)
+            conn = self._conns[key] = _PeerConn(
+                *key, ssl_context=self._ssl_context
+            )
         try:
             return await conn.call(
                 method, params, self._connect_timeout, self._rpc_timeout

@@ -122,9 +122,36 @@ class OpenrDaemon:
             ),
             loop=loop,
         )
+        # mutual-TLS contexts (Main.cpp:517-543): one server + one client
+        # context shared by the ctrl server and the KvStore peering
+        server_ssl = client_ssl = None
+        if c.enable_secure_thrift_server:
+            from openr_tpu.utils.tls import (
+                client_ssl_context,
+                server_ssl_context,
+            )
+
+            if not (c.x509_cert_path and c.x509_key_path and c.x509_ca_path):
+                raise ValueError(
+                    "enable_secure_thrift_server requires x509_cert_path, "
+                    "x509_key_path and x509_ca_path"
+                )
+            server_ssl = server_ssl_context(
+                c.x509_cert_path, c.x509_key_path, c.x509_ca_path
+            )
+            client_ssl = client_ssl_context(
+                c.x509_ca_path, c.x509_cert_path, c.x509_key_path
+            )
+            if self._kv_tcp:
+                kv_transport.set_ssl_context(client_ssl)
+        self._server_ssl = server_ssl
         if self._kv_tcp:
             self.kvstore_server = KvStoreTcpServer(
-                self.kvstore, host=kvstore_host, port=kvstore_port
+                self.kvstore,
+                host=kvstore_host,
+                port=kvstore_port,
+                ssl_context=server_ssl,
+                tls_acceptable_peers=c.tls_acceptable_peers or None,
             )
         self.kvstore_client = KvStoreClient(self.kvstore, node, loop)
 
@@ -289,6 +316,8 @@ class OpenrDaemon:
             config_store=self.config_store,
             config=config,
             loop=loop,
+            ssl_context=self._server_ssl,
+            tls_acceptable_peers=c.tls_acceptable_peers or None,
         )
 
         for name, module in (

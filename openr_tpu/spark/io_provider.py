@@ -179,18 +179,17 @@ class UdpIoProvider(IoProvider):
                 ):
                     sec, nsec = _TIMESPEC.unpack_from(cdata)
                     rt_us = sec * 1_000_000 + nsec // 1_000
+                    # a realtime clock STEP (not slew) skews the stored
+                    # monotonic-realtime offset; detect it by comparing the
+                    # CURRENT offset against the stored one — queue delay
+                    # shifts both clocks equally and cannot false-trigger
+                    offset_now = int(
+                        time.monotonic() * 1_000_000
+                        - time.time() * 1_000_000
+                    )
+                    if abs(offset_now - self._mono_minus_real_us) > 100_000:
+                        self._mono_minus_real_us = offset_now
                     recv_us = rt_us + self._mono_minus_real_us
-                    # a realtime clock STEP (not slew) would skew every
-                    # future stamp: resample the rebase offset when the
-                    # stamp disagrees with the monotonic clock by >100ms
-                    if abs(recv_us - self.now_us()) > 100_000:
-                        self._mono_minus_real_us = int(
-                            time.monotonic() * 1_000_000
-                            - time.time() * 1_000_000
-                        )
-                        recv_us = rt_us + self._mono_minus_real_us
-                        if abs(recv_us - self.now_us()) > 100_000:
-                            recv_us = None  # still off: distrust the stamp
             callback = self._callback
             if callback is None:
                 continue

@@ -1,0 +1,151 @@
+"""TLS for the control-plane and KvStore-peering transports.
+
+Equivalent of the reference's thrift-server TLS setup (openr/Main.cpp:
+517-543 — x509 cert/key/CA paths, TLSTicketKeySeeds, acceptable-peer
+common names): mutual TLS with a shared CA, both sides presenting
+certificates, with an optional allow-list of peer common names checked
+after the handshake (`tls_acceptable_peers` flag semantics).
+
+`make_test_ca` generates an ephemeral CA + node certificates (via the
+`cryptography` package) for tests and lab setups; production deployments
+point the daemon at files from their own PKI.
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import List, Optional, Sequence, Tuple
+
+
+def server_ssl_context(
+    cert_path: str, key_path: str, ca_path: Optional[str] = None
+) -> ssl.SSLContext:
+    """Server side of mutual TLS: present cert, require + verify clients
+    against the CA when given."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    if ca_path:
+        ctx.load_verify_locations(ca_path)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(
+    ca_path: str,
+    cert_path: Optional[str] = None,
+    key_path: Optional[str] = None,
+) -> ssl.SSLContext:
+    """Client side: verify the server against the CA (no hostname check —
+    routers peer by address; identity is the certificate CN, checked via
+    acceptable-peers) and present our certificate for mutual auth."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(ca_path)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    if cert_path and key_path:
+        ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def peer_common_name(ssl_object) -> Optional[str]:
+    """CN of the peer certificate of an established TLS connection."""
+    cert = ssl_object.getpeercert()
+    if not cert:
+        return None
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return value
+    return None
+
+
+def check_acceptable_peer(
+    ssl_object, acceptable_peers: Optional[Sequence[str]]
+) -> bool:
+    """tls_acceptable_peers semantics: empty/None accepts any CA-verified
+    peer; otherwise the peer certificate CN must be in the list."""
+    if not acceptable_peers:
+        return True
+    return peer_common_name(ssl_object) in set(acceptable_peers)
+
+
+def enforce_acceptable_peer(writer, acceptable_peers, log, what: str) -> bool:
+    """Post-handshake allow-list check shared by the ctrl and KvStore
+    servers: closes the connection and returns False on rejection."""
+    if not acceptable_peers:
+        return True
+    if check_acceptable_peer(
+        writer.get_extra_info("ssl_object"), acceptable_peers
+    ):
+        return True
+    log.warning("%s: rejecting peer outside acceptable list", what)
+    writer.close()
+    return False
+
+
+def make_test_ca(
+    directory: str, names: List[str]
+) -> Tuple[str, List[Tuple[str, str]]]:
+    """Ephemeral CA + one (cert, key) pair per name, written under
+    `directory`. Returns (ca_path, [(cert_path, key_path), ...])."""
+    import datetime
+    import os
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _name(cn: str):
+        return x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+        )
+
+    def _write_key(path: str, key) -> None:
+        with open(path, "wb") as f:
+            f.write(
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption(),
+                )
+            )
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("openr-tpu-test-ca"))
+        .issuer_name(_name("openr-tpu-test-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    ca_path = os.path.join(directory, "ca.pem")
+    with open(ca_path, "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+
+    pairs: List[Tuple[str, str]] = []
+    for cn in names:
+        key = ec.generate_private_key(ec.SECP256R1())
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .sign(ca_key, hashes.SHA256())
+        )
+        cert_path = os.path.join(directory, f"{cn}.pem")
+        key_path = os.path.join(directory, f"{cn}.key")
+        with open(cert_path, "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+        _write_key(key_path, key)
+        pairs.append((cert_path, key_path))
+    return ca_path, pairs

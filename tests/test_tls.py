@@ -1,0 +1,192 @@
+"""Mutual TLS on the control-plane and KvStore-peering transports
+(openr/Main.cpp:517-543 TLS setup semantics: x509 cert/key/CA plus an
+acceptable-peer common-name allow-list)."""
+
+import asyncio
+import ssl
+
+import pytest
+
+from openr_tpu.ctrl.client import CtrlClient, CtrlError
+from openr_tpu.ctrl.server import CtrlServer
+from openr_tpu.kvstore import KvStore, KvStoreTcpServer, TcpTransport
+from openr_tpu.types import Value
+from openr_tpu.utils.tls import (
+    check_acceptable_peer,
+    client_ssl_context,
+    make_test_ca,
+    server_ssl_context,
+)
+
+
+def run(coro, timeout=30.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    directory = tmp_path_factory.mktemp("pki")
+    ca, pairs = make_test_ca(str(directory), ["node-a", "node-b", "rogue"])
+    return {
+        "ca": ca,
+        "node-a": pairs[0],
+        "node-b": pairs[1],
+        "rogue": pairs[2],
+    }
+
+
+class TestCtrlTls:
+    def test_mutual_tls_round_trip(self, pki):
+        async def body():
+            cert, key = pki["node-a"]
+            server = CtrlServer(
+                "node-a",
+                port=0,
+                ssl_context=server_ssl_context(cert, key, pki["ca"]),
+            )
+            port = await server.start()
+            b_cert, b_key = pki["node-b"]
+            client = CtrlClient(
+                port=port,
+                ssl_context=client_ssl_context(pki["ca"], b_cert, b_key),
+            )
+            async with client:
+                assert await client.call("getMyNodeName") == "node-a"
+            await server.stop()
+
+        run(body())
+
+    def test_plaintext_client_rejected(self, pki):
+        async def body():
+            cert, key = pki["node-a"]
+            server = CtrlServer(
+                "node-a",
+                port=0,
+                ssl_context=server_ssl_context(cert, key, pki["ca"]),
+            )
+            port = await server.start()
+            client = CtrlClient(port=port)  # no TLS
+            with pytest.raises(Exception):
+                async with client:
+                    await asyncio.wait_for(
+                        client.call("getMyNodeName"), 3
+                    )
+            await server.stop()
+
+        run(body())
+
+    def test_client_without_cert_rejected(self, pki):
+        async def body():
+            cert, key = pki["node-a"]
+            server = CtrlServer(
+                "node-a",
+                port=0,
+                ssl_context=server_ssl_context(cert, key, pki["ca"]),
+            )
+            port = await server.start()
+            # CA-verifying client that presents NO certificate: the
+            # server requires one (CERT_REQUIRED)
+            client = CtrlClient(
+                port=port, ssl_context=client_ssl_context(pki["ca"])
+            )
+            with pytest.raises(
+                (ssl.SSLError, ConnectionError, OSError, CtrlError)
+            ):
+                async with client:
+                    await asyncio.wait_for(
+                        client.call("getMyNodeName"), 3
+                    )
+            await server.stop()
+
+        run(body())
+
+    def test_acceptable_peers_enforced(self, pki):
+        async def body():
+            cert, key = pki["node-a"]
+            server = CtrlServer(
+                "node-a",
+                port=0,
+                ssl_context=server_ssl_context(cert, key, pki["ca"]),
+                tls_acceptable_peers=["node-b"],
+            )
+            port = await server.start()
+            # node-b (allowed) works
+            b_cert, b_key = pki["node-b"]
+            client = CtrlClient(
+                port=port,
+                ssl_context=client_ssl_context(pki["ca"], b_cert, b_key),
+            )
+            async with client:
+                assert await client.call("getMyNodeName") == "node-a"
+            # rogue (CA-signed but not allow-listed) is dropped
+            r_cert, r_key = pki["rogue"]
+            rogue = CtrlClient(
+                port=port,
+                ssl_context=client_ssl_context(pki["ca"], r_cert, r_key),
+            )
+            with pytest.raises(Exception):
+                async with rogue:
+                    await asyncio.wait_for(
+                        rogue.call("getMyNodeName"), 3
+                    )
+            await server.stop()
+
+        run(body())
+
+
+class TestKvStoreTls:
+    def test_full_sync_over_mutual_tls(self, pki):
+        async def body():
+            a_cert, a_key = pki["node-a"]
+            b_cert, b_key = pki["node-b"]
+            ta = TcpTransport(
+                ssl_context=client_ssl_context(pki["ca"], a_cert, a_key)
+            )
+            tb = TcpTransport(
+                ssl_context=client_ssl_context(pki["ca"], b_cert, b_key)
+            )
+            sa = KvStore("node-a", ["0"], ta)
+            sb = KvStore("node-b", ["0"], tb)
+            srv_a = KvStoreTcpServer(
+                sa,
+                ssl_context=server_ssl_context(a_cert, a_key, pki["ca"]),
+                tls_acceptable_peers=["node-a", "node-b"],
+            )
+            srv_b = KvStoreTcpServer(
+                sb,
+                ssl_context=server_ssl_context(b_cert, b_key, pki["ca"]),
+                tls_acceptable_peers=["node-a", "node-b"],
+            )
+            await srv_a.start()
+            await srv_b.start()
+
+            from openr_tpu.kvstore.store import PeerSpec
+
+            sa.set_key("k1", Value(1, "node-a", b"from-a"))
+            sa.add_peers({"node-b": PeerSpec(srv_b.address)})
+            sb.add_peers({"node-a": PeerSpec(srv_a.address)})
+
+            for _ in range(300):
+                v = sb.get_key("k1")
+                if v is not None and v.value == b"from-a":
+                    break
+                await asyncio.sleep(0.02)
+            v = sb.get_key("k1")
+            assert v is not None and v.value == b"from-a"
+
+            sa.stop()
+            sb.stop()
+            await srv_a.stop()
+            await srv_b.stop()
+
+        run(body())
+
+
+def test_check_acceptable_peer_without_tls_object():
+    class _FakeSsl:
+        def getpeercert(self):
+            return {"subject": ((("commonName", "n1"),),)}
+
+    assert check_acceptable_peer(_FakeSsl(), None)
+    assert check_acceptable_peer(_FakeSsl(), ["n1"])
+    assert not check_acceptable_peer(_FakeSsl(), ["n2"])
