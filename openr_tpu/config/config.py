@@ -128,6 +128,9 @@ class DecisionConfigSection:
     debounce_max_ms: float = 250.0
     compute_lfa_paths: bool = False
     solver_backend: str = "cpu"  # 'cpu' | 'tpu'
+    # (batch, graph) device-mesh shape for the tpu backend, e.g. [4, 2]
+    # on a v5e-8; None/empty = single device
+    solver_mesh: Optional[List[int]] = None
 
 
 @dataclass

@@ -53,6 +53,9 @@ class DecisionConfig:
     my_node_name: str
     areas: List[str] = field(default_factory=lambda: ["0"])
     solver_backend: str = "cpu"  # 'cpu' | 'tpu'
+    # (batch, graph) device-mesh shape for the tpu backend; None = single
+    # device. Resolved against jax.devices() by TpuSpfSolver on first solve.
+    solver_mesh: Optional[tuple] = None
     enable_v4: bool = True
     compute_lfa_paths: bool = False
     enable_ordered_fib: bool = False
@@ -107,15 +110,21 @@ class Decision(CountersMixin):
         self.static_routes_updates = static_routes_updates
         self._loop = loop
 
-        solver_cls = TpuSpfSolver if config.solver_backend == "tpu" else SpfSolver
-        self.solver = solver_cls(
-            config.my_node_name,
+        solver_kwargs = dict(
             enable_v4=config.enable_v4,
             compute_lfa_paths=config.compute_lfa_paths,
             enable_ordered_fib=config.enable_ordered_fib,
             bgp_dry_run=config.bgp_dry_run,
             bgp_use_igp_metric=config.bgp_use_igp_metric,
         )
+        if config.solver_backend == "tpu":
+            self.solver = TpuSpfSolver(
+                config.my_node_name,
+                mesh=config.solver_mesh,
+                **solver_kwargs,
+            )
+        else:
+            self.solver = SpfSolver(config.my_node_name, **solver_kwargs)
         self.area_link_states: Dict[str, LinkState] = {
             area: LinkState(area) for area in config.areas
         }
@@ -362,9 +371,26 @@ class Decision(CountersMixin):
         self._pending.reset()
         self._bump("decision.route_build_runs")
 
-        new_db = self.solver.build_route_db(
-            self.config.my_node_name, self.area_link_states, self.prefix_state
-        )
+        try:
+            new_db = self.solver.build_route_db(
+                self.config.my_node_name,
+                self.area_link_states,
+                self.prefix_state,
+            )
+        except Exception:
+            # rebuild_routes runs from a loop timer callback: an uncaught
+            # exception here vanishes into the loop's exception handler and
+            # the daemon silently stops converging. Log + count + re-arm the
+            # debounce, so a transient solver failure retries (at the
+            # debounce max backoff) instead of stalling until the next
+            # topology change.
+            import logging
+
+            logging.getLogger(__name__).exception("route build failed")
+            self._bump("decision.route_build_errors")
+            self._pending.needs_route_update = True
+            self._rebuild_debounce()
+            return
         if new_db is None:
             return
         self._apply_rib_policy(new_db)

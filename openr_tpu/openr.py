@@ -258,6 +258,9 @@ class OpenrDaemon:
                 my_node_name=node,
                 areas=areas,
                 solver_backend=dc.solver_backend,
+                solver_mesh=(
+                    tuple(dc.solver_mesh) if dc.solver_mesh else None
+                ),
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,

@@ -190,14 +190,37 @@ def _sell_fixpoint_core(
     return d.T
 
 
-@functools.lru_cache(maxsize=64)
-def _sell_solver(key: Tuple):
-    """Jitted single-device form of _sell_solver_raw."""
-    return jax.jit(_sell_solver_raw(key))
+def _mesh_shardings(mesh):
+    """(row-sharded over 'batch', replicated) NamedShardings for a solver
+    mesh. The sliced-ELL solve shards only its source batch; the layout
+    leaves are replicated so each relaxation round stays collective-free
+    (openr_tpu/parallel/mesh.py design)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return (
+        NamedSharding(mesh, P("batch")),
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, P("batch", None)),
+    )
 
 
 @functools.lru_cache(maxsize=64)
-def _sell_solver_patched(key: Tuple):
+def _sell_solver(key: Tuple, mesh=None):
+    """Jitted form of _sell_solver_raw. With a mesh, the source batch is
+    sharded over the 'batch' axis and D comes back row-sharded — the
+    production multi-chip path (DecisionConfig.solver_mesh)."""
+    if mesh is None:
+        return jax.jit(_sell_solver_raw(key))
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        _sell_solver_raw(key),
+        in_shardings=(row, repl, repl, repl),
+        out_shardings=out,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _sell_solver_patched(key: Tuple, mesh=None):
     """Patch-and-solve in one dispatch: applies per-bucket weight patches
     (idx [Pk, 2] of (row, slot), vals [Pk]; out-of-range rows dropped) to
     the persistent wg buffers, solves, and returns (D, new_wgs) so the
@@ -223,11 +246,19 @@ def _sell_solver_patched(key: Tuple):
     # donate the replaced weight buffers: the caller always overwrites its
     # handle with new_wgs, so XLA may update in place instead of allocating
     # a second full set of buckets per event
-    return jax.jit(solve, donate_argnums=(2,))
+    if mesh is None:
+        return jax.jit(solve, donate_argnums=(2,))
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        solve,
+        donate_argnums=(2,),
+        in_shardings=(row, repl, repl, repl, repl, repl),
+        out_shardings=(out, repl),
+    )
 
 
 @functools.lru_cache(maxsize=64)
-def _sell_solver_vw(key: Tuple):
+def _sell_solver_vw(key: Tuple, mesh=None):
     """Per-row-weights sliced-ELL fixpoint (jitted): the device form of the
     reference's penalized re-solves — KSP's link-ignore runSpf
     (LinkState.cpp:760-789) — on the sliced layout.
@@ -253,7 +284,14 @@ def _sell_solver_vw(key: Tuple):
             sources, nbrs, tuple(wgv), overloaded, zero_end, starts, shapes
         )
 
-    return jax.jit(solve)
+    if mesh is None:
+        return jax.jit(solve)
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        solve,
+        in_shardings=(row, repl, repl, repl, repl),
+        out_shardings=out,
+    )
 
 
 def sell_fixpoint_masked(
@@ -262,6 +300,7 @@ def sell_fixpoint_masked(
     overloaded,  # bool [n_pad]
     mask_positions,  # per batch row: list of edge positions to pin to INF
     device_arrays=None,  # optional (nbrs, wgs, ov) already on device
+    mesh=None,  # optional solver mesh: sources sharded over 'batch'
 ) -> jnp.ndarray:
     """Per-row link-ignore solve on the sliced layout.
 
@@ -293,7 +332,7 @@ def sell_fixpoint_masked(
         nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
         wgs = tuple(jnp.asarray(a) for a in sell.wg)
         ov = jnp.asarray(overloaded)
-    fn = _sell_solver_vw(sell.shape_key())
+    fn = _sell_solver_vw(sell.shape_key(), mesh)
     return fn(
         jnp.asarray(sources, dtype=jnp.int32), nbrs, wgs, tuple(masks), ov
     )

@@ -10,8 +10,14 @@ Scaling axes (the TPU analog of the reference's parallelism, SURVEY.md §2.4):
 
 from openr_tpu.parallel.mesh import (
     make_mesh,
+    resolve_mesh,
     sharded_batched_spf,
     sharded_spf_step,
 )
 
-__all__ = ["make_mesh", "sharded_batched_spf", "sharded_spf_step"]
+__all__ = [
+    "make_mesh",
+    "resolve_mesh",
+    "sharded_batched_spf",
+    "sharded_spf_step",
+]

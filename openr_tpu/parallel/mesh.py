@@ -36,6 +36,28 @@ def make_mesh(
     return Mesh(np.array(devices).reshape(shape), axis_names)
 
 
+def resolve_mesh(spec) -> Optional[Mesh]:
+    """Mesh | (batch, graph) shape | None -> Mesh | None.
+
+    The config-facing form of make_mesh: DecisionConfig.solver_mesh carries a
+    plain shape tuple (configs must stay picklable / thrift-ish), resolved
+    against the actual device set the first time the solver needs it."""
+    if spec is None or isinstance(spec, Mesh):
+        return spec
+    shape = tuple(int(x) for x in spec)
+    if len(shape) != 2:
+        raise ValueError(
+            f"solver_mesh must be (batch, graph), got {spec!r}"
+        )
+    n = shape[0] * shape[1]
+    devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"solver_mesh {shape} needs {n} devices, have {len(devices)}"
+        )
+    return make_mesh(devices[:n], shape=shape)
+
+
 def _pad_sources(source_rows: np.ndarray, multiple: int) -> np.ndarray:
     """Pad the source batch to a multiple of the batch-axis size; padding
     rows re-solve source 0 (cheap, discarded by the caller)."""

@@ -134,9 +134,15 @@ class _AreaSolve:
     batch is bucket-padded so a changed neighbor count stays in the same
     executable too."""
 
-    def __init__(self, link_state: LinkState, me: str) -> None:
+    def __init__(
+        self, link_state: LinkState, me: str, mesh=None
+    ) -> None:
         self.link_state = link_state
         self.me = me
+        # jax.sharding.Mesh or None: when set, the source batch is sharded
+        # over the mesh 'batch' axis and the persistent layout buffers are
+        # replicated across devices — same executables, multi-chip spread
+        self.mesh = mesh
         self.graph: CompiledGraph = compile_graph(link_state)
         self.device_solves = 0
         self.ksp_device_batches = 0
@@ -146,6 +152,28 @@ class _AreaSolve:
         # upload only the changed slots
         self._dev: Optional[dict] = None
         self._solve()
+
+    def _batch_pad(self, n: int, minimum: int = 8) -> int:
+        """Source-batch pad: power-of-two bucket, rounded up to a multiple
+        of the mesh batch-axis size so GSPMD splits rows evenly."""
+        s_pad = _next_bucket(n, minimum=minimum)
+        if self.mesh is not None:
+            b = self.mesh.shape["batch"]
+            s_pad += (-s_pad) % b
+        return s_pad
+
+    def _replicated(self, x):
+        """Device placement for a persistent layout buffer: plain asarray
+        single-device, explicitly replicated under a mesh (committed, so
+        every sharded solve reuses it without per-call resharding)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P()))
 
     def _solve(self) -> None:
         me = self.me
@@ -163,13 +191,19 @@ class _AreaSolve:
         rows = np.array(
             [self.graph.node_index[s] for s in self.sources], dtype=np.int32
         )
-        s_pad = _next_bucket(len(rows), minimum=8)
+        s_pad = self._batch_pad(len(rows), minimum=8)
         rows = np.concatenate(
             [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
         )
         # one device call for the whole batch; copy back once
         if self.graph.sell is not None:
             self.d = np.asarray(self._sell_solve_resident(rows))
+        elif self.mesh is not None:
+            from openr_tpu.parallel import sharded_batched_spf
+
+            self.d = np.asarray(
+                sharded_batched_spf(self.graph, rows, self.mesh)
+            )
         else:
             self.d = np.asarray(batched_spf(self.graph, rows))
         self.device_solves += 1
@@ -198,16 +232,16 @@ class _AreaSolve:
         if st is None or st["src_ref"] is not g.src:
             st = self._dev = {
                 "src_ref": g.src,
-                "nbrs": tuple(jnp.asarray(a) for a in sell.nbr),
-                "wgs": tuple(jnp.asarray(a) for a in sell.wg),
-                "ov": jnp.asarray(g.overloaded),
+                "nbrs": tuple(self._replicated(a) for a in sell.nbr),
+                "wgs": tuple(self._replicated(a) for a in sell.wg),
+                "ov": self._replicated(g.overloaded),
                 "w_host": g.w.copy(),
                 "w_ver": g.version,
                 "ov_host": g.overloaded.copy(),
             }
         else:
             if not np.array_equal(st["ov_host"], g.overloaded):
-                st["ov"] = jnp.asarray(g.overloaded)
+                st["ov"] = self._replicated(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
             if (
                 g.changed_edges is not None
@@ -245,7 +279,7 @@ class _AreaSolve:
                             idx[k, : len(sel), 0] = sell.edge_row[sel]
                             idx[k, : len(sel), 1] = sell.edge_slot[sel]
                             vals[k, : len(sel)] = g.w[sel]
-                    fn = _sell_solver_patched(sell.shape_key())
+                    fn = _sell_solver_patched(sell.shape_key(), self.mesh)
                     d, new_wgs = fn(
                         jnp.asarray(rows, dtype=jnp.int32),
                         st["nbrs"],
@@ -266,7 +300,7 @@ class _AreaSolve:
                         )
                 st["wgs"] = tuple(wgs)
 
-        fn = _sell_solver(sell.shape_key())
+        fn = _sell_solver(sell.shape_key(), self.mesh)
         return fn(
             jnp.asarray(rows, dtype=jnp.int32),
             st["nbrs"],
@@ -374,7 +408,7 @@ class _AreaSolve:
         # pad the batch axis to a power-of-two bucket so every anycast group
         # size in a bucket shares one jitted executable (same convention as
         # n_pad/e_pad in compile_graph); filler rows re-solve unpenalized
-        s_pad = _next_bucket(len(todo), minimum=1)
+        s_pad = self._batch_pad(len(todo), minimum=1)
         me_row = idx[self.me]
         sources = np.full(s_pad, me_row, dtype=np.int32)
         if self.graph.sell is not None:
@@ -400,6 +434,7 @@ class _AreaSolve:
                         if dev is not None
                         else None
                     ),
+                    mesh=self.mesh,
                 )
             )
         else:
@@ -484,9 +519,16 @@ def _trace_paths(
 
 
 class TpuSpfSolver(SpfSolver):
-    """SpfSolver with the batched TPU distance backend."""
+    """SpfSolver with the batched TPU distance backend.
 
-    def __init__(self, *args, **kwargs) -> None:
+    mesh: None (single device), a jax.sharding.Mesh, or a (batch, graph)
+    shape tuple resolved against jax.devices() on first use — the
+    DecisionConfig.solver_mesh production knob. Sharding rides entirely
+    inside _AreaSolve (sources row-sharded, layout replicated), so the
+    meshed solver passes the same parity suite as the single-device one.
+    """
+
+    def __init__(self, *args, mesh=None, **kwargs) -> None:
         super().__init__(*args, **kwargs)
         # (area name, node) -> (LinkState identity, solve); keyed by the
         # stable area name so a replaced LinkState object for the same area
@@ -494,6 +536,14 @@ class TpuSpfSolver(SpfSolver):
         # tracking lives in _AreaSolve.refresh()
         self._solves: Dict[Tuple[str, str], Tuple[int, _AreaSolve]] = {}
         self.device_solves = 0  # counter: batched device calls
+        # resolved EAGERLY: a solver_mesh that doesn't fit the device set
+        # must fail at daemon startup with a clear error, not inside the
+        # first debounced rebuild callback mid-convergence
+        if mesh is not None:
+            from openr_tpu.parallel import resolve_mesh
+
+            mesh = resolve_mesh(mesh)
+        self.mesh = mesh
 
     def _area_solve(
         self, link_state: LinkState, node: str
@@ -512,7 +562,7 @@ class TpuSpfSolver(SpfSolver):
             solve.refresh()  # incremental: patch arrays + one device call
             self.device_solves += solve.device_solves - before
             return solve
-        solve = _AreaSolve(link_state, node)
+        solve = _AreaSolve(link_state, node, mesh=self.mesh)
         self.device_solves += solve.device_solves
         self._solves[key] = (id(link_state), solve)
         return solve

@@ -10,5 +10,18 @@ OpenrSystemTest builds ring topologies with (tests/OpenrSystemTest.cpp).
 """
 
 from openr_tpu.testing.wrapper import OpenrWrapper, VirtualNetwork
+from openr_tpu.testing.decision_harness import (
+    assert_route_delta_equal,
+    decision_route_delta,
+    lsdb_publication,
+    run_decision_backend_parity,
+)
 
-__all__ = ["OpenrWrapper", "VirtualNetwork"]
+__all__ = [
+    "OpenrWrapper",
+    "VirtualNetwork",
+    "assert_route_delta_equal",
+    "decision_route_delta",
+    "lsdb_publication",
+    "run_decision_backend_parity",
+]

@@ -1,0 +1,119 @@
+"""Shared Decision parity harness.
+
+One implementation of "feed the same publication to Decision(backend=X) and
+Decision(backend=Y), compare the emitted route deltas" used by both the
+driver dry-run (__graft_entry__._dryrun_daemon_path) and the test suite
+(tests/test_tpu_solver_mesh.py) — so Decision startup/shutdown or
+Publication-shape changes have one place to land.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable, Optional, Tuple
+
+from openr_tpu.decision import Decision, DecisionConfig
+from openr_tpu.messaging import ReplicateQueue, RQueue, RWQueue
+from openr_tpu.types import (
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+from openr_tpu.utils import serializer
+
+
+def lsdb_publication(
+    adj_dbs: Iterable, announcers: Optional[dict] = None, area: str = "0"
+) -> Publication:
+    """One KvStore publication carrying full adjacency databases plus
+    per-node prefix announcements ({node: [prefix_str, ...]})."""
+    pub = Publication(area=area)
+    for db in adj_dbs:
+        pub.key_vals[adj_key(db.this_node_name)] = Value(
+            1, db.this_node_name, serializer.dumps(db)
+        )
+    for node, pfxs in (announcers or {}).items():
+        pdb = PrefixDatabase(
+            node, [PrefixEntry(IpPrefix(p)) for p in pfxs]
+        )
+        pub.key_vals[prefix_key(node)] = Value(
+            1, node, serializer.dumps(pdb)
+        )
+    return pub
+
+
+async def decision_route_delta(
+    my_node: str,
+    publication: Publication,
+    backend: str,
+    mesh: Optional[tuple] = None,
+    timeout: float = 30.0,
+):
+    """Boot a Decision, push one publication, await + return the emitted
+    route delta, and shut the module down cleanly (task awaited)."""
+    kv_q: RWQueue = RWQueue()
+    route_q: ReplicateQueue = ReplicateQueue()
+    decision = Decision(
+        DecisionConfig(
+            my_node_name=my_node,
+            solver_backend=backend,
+            solver_mesh=mesh,
+            debounce_min=0.005,
+            debounce_max=0.02,
+        ),
+        RQueue(kv_q),
+        route_q,
+    )
+    reader = route_q.get_reader()
+    decision.start()
+    try:
+        kv_q.push(publication)
+        return await asyncio.wait_for(reader.get(), timeout)
+    finally:
+        task = decision._task
+        decision.stop()
+        if task is not None:
+            await asyncio.gather(task, return_exceptions=True)
+
+
+def assert_route_delta_equal(a, b) -> Tuple[int, int]:
+    """Compare two DecisionRouteUpdates; returns (n_unicast, n_mpls)."""
+    a_uni = {e.prefix: e for e in a.unicast_routes_to_update}
+    b_uni = {e.prefix: e for e in b.unicast_routes_to_update}
+    assert a_uni == b_uni, "unicast route delta mismatch"
+    a_mpls = {e.label: e for e in a.mpls_routes_to_update}
+    b_mpls = {e.label: e for e in b.mpls_routes_to_update}
+    assert a_mpls == b_mpls, "mpls route delta mismatch"
+    assert sorted(a.unicast_routes_to_delete) == sorted(
+        b.unicast_routes_to_delete
+    )
+    assert sorted(a.mpls_routes_to_delete) == sorted(b.mpls_routes_to_delete)
+    return len(a_uni), len(a_mpls)
+
+
+def run_decision_backend_parity(
+    my_node: str,
+    publication: Publication,
+    mesh: Optional[tuple],
+) -> Tuple[int, int]:
+    """Decision(tpu, mesh) vs Decision(cpu) on one publication; returns
+    (n_unicast, n_mpls) on success, raises AssertionError on divergence.
+    Creates and closes its own event loop (callers are sync entry points).
+    """
+
+    async def body():
+        cpu = await decision_route_delta(my_node, publication, "cpu")
+        tpu = await decision_route_delta(
+            my_node, publication, "tpu", mesh=mesh
+        )
+        return assert_route_delta_equal(cpu, tpu)
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()

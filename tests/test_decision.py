@@ -456,3 +456,45 @@ class TestOrderedFib:
             decision.stop()
 
         run(body())
+
+
+class TestRebuildErrorResilience:
+    def test_solver_exception_does_not_kill_the_module(self):
+        """rebuild_routes runs from a timer callback; a solver failure must
+        be logged + counted, and the NEXT publication must still converge
+        (the daemon retries rather than silently stopping)."""
+
+        async def body():
+            decision, kv_q, route_q = make_decision()
+            reader = route_q.get_reader()
+            decision.start()
+
+            boom = {"armed": True}
+            real_build = decision.solver.build_route_db
+
+            def flaky(*args, **kwargs):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected solver failure")
+                return real_build(*args, **kwargs)
+
+            decision.solver.build_route_db = flaky
+            dbs = build_adj_dbs([("a", "b", 1), ("b", "c", 1)])
+            kv_q.push(
+                make_publication(
+                    adj_dbs=dbs.values(),
+                    prefix_dbs=[
+                        PrefixDatabase("c", [PrefixEntry(IpPrefix(PFX))])
+                    ],
+                )
+            )
+            # first rebuild fails, the debounce re-arms, and the retry
+            # converges without any new publication
+            delta = await asyncio.wait_for(reader.get(), 5)
+            assert decision.counters.get("decision.route_build_errors") == 1
+            assert IpPrefix(PFX) in {
+                e.prefix for e in delta.unicast_routes_to_update
+            }
+            decision.stop()
+
+        run(body())

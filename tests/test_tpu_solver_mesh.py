@@ -1,0 +1,229 @@
+"""Production mesh-sharded solver: the SAME parity contract as
+tests/test_tpu_solver.py, but with TpuSpfSolver(mesh=...) sharding the
+source batch over the virtual 8-device CPU mesh (conftest.py).
+
+This is the daemon's multi-chip path (DecisionConfig.solver_mesh), not a
+bespoke demo step: _AreaSolve places its persistent buffers with the
+shardings openr_tpu/parallel/mesh.py defines, and every route the meshed
+solver produces must match the CPU Dijkstra oracle byte for byte.
+"""
+
+import random
+
+import pytest
+
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.solver import SpfSolver, TpuSpfSolver
+from openr_tpu.topology import build_adj_dbs, fabric_edges, grid_edges
+from openr_tpu.types import (
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+MESHES = [(4, 2), (8, 1), (2, 2)]
+
+
+def build_ls(edges, area="0", **kwargs):
+    ls = LinkState(area)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def make_prefix_state(announcers, area="0", **entry_kw):
+    ps = PrefixState()
+    for node, pfxs in announcers.items():
+        ps.update_prefix_database(
+            PrefixDatabase(
+                node,
+                [PrefixEntry(IpPrefix(p), **entry_kw) for p in pfxs],
+                area=area,
+            )
+        )
+    return ps
+
+
+def assert_route_db_equal(db_cpu, db_tpu):
+    assert db_cpu is not None and db_tpu is not None
+    assert set(db_cpu.unicast_entries) == set(db_tpu.unicast_entries)
+    for prefix, entry in db_cpu.unicast_entries.items():
+        assert db_tpu.unicast_entries[prefix] == entry, prefix
+    assert set(db_cpu.mpls_entries) == set(db_tpu.mpls_entries)
+    for label, entry in db_cpu.mpls_entries.items():
+        assert db_tpu.mpls_entries[label] == entry, label
+
+
+def run_parity(edges, announcers, me, mesh, overloaded=None, lfa=False,
+               **entry_kw):
+    ls_cpu = build_ls(edges, overloaded_nodes=overloaded)
+    ls_tpu = build_ls(edges, overloaded_nodes=overloaded)
+    ps = make_prefix_state(announcers, **entry_kw)
+    cpu = SpfSolver(me, compute_lfa_paths=lfa)
+    tpu = TpuSpfSolver(me, compute_lfa_paths=lfa, mesh=mesh)
+    db_cpu = cpu.build_route_db(me, {"0": ls_cpu}, ps)
+    db_tpu = tpu.build_route_db(me, {"0": ls_tpu}, ps)
+    assert_route_db_equal(db_cpu, db_tpu)
+    assert tpu.device_solves >= 1
+    # the solve really ran sharded: its distance rows live on every mesh
+    # device (row-sharded D was gathered to host, buffers are committed)
+    solve = tpu._solves[("0", me)][1]
+    assert solve.mesh is tpu.mesh
+    if solve._dev is not None:
+        buf = solve._dev["ov"]
+        assert len(buf.sharding.device_set) == mesh[0] * mesh[1]
+    return tpu
+
+
+PFXS = ["10.1.0.0/16", "10.2.0.0/16", "10.3.0.0/16"]
+
+
+class TestMeshedRouteDbParity:
+    @pytest.mark.parametrize("mesh", MESHES)
+    def test_grid(self, mesh):
+        run_parity(
+            grid_edges(5),
+            {"g4_4": [PFXS[0]], "g0_4": [PFXS[1]], "g2_2": [PFXS[2]]},
+            "g0_0",
+            mesh,
+        )
+
+    @pytest.mark.parametrize("mesh", MESHES[:2])
+    def test_fabric_lfa(self, mesh):
+        edges = fabric_edges(4, 4, 8)
+        nodes = sorted({n for a, b, _ in edges for n in (a, b)})
+        run_parity(
+            edges,
+            {nodes[-1]: [PFXS[0]], nodes[-2]: [PFXS[1]]},
+            nodes[0],
+            mesh,
+            lfa=True,
+        )
+
+    def test_overloaded_transit(self):
+        run_parity(
+            [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)],
+            {"c": [PFXS[0]]},
+            "a",
+            (4, 2),
+            overloaded={"b"},
+        )
+
+    def test_ksp2(self):
+        run_parity(
+            grid_edges(4),
+            {"g3_3": [PFXS[0]], "g0_3": [PFXS[1]]},
+            "g0_0",
+            (4, 2),
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+
+    def test_random_graphs(self):
+        rng = random.Random(7)
+        for _ in range(6):
+            n = rng.randint(5, 14)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = []
+            for i in range(1, n):
+                edges.append(
+                    (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 5))
+                )
+            for _ in range(rng.randint(1, n)):
+                a, b = rng.sample(nodes, 2)
+                if not any({a, b} == {x, y} for x, y, _ in edges):
+                    edges.append((a, b, rng.randint(1, 5)))
+            announcers = {
+                nodes[i]: [PFXS[i % 3]] for i in range(1, n) if i % 2
+            }
+            overloaded = {
+                nodes[i] for i in range(1, n) if rng.random() < 0.15
+            }
+            run_parity(edges, announcers, nodes[0], (4, 2),
+                       overloaded=overloaded)
+
+
+class TestMeshedIncremental:
+    def test_flap_patches_sharded_buffers(self):
+        """Metric change after the first solve must ride the fused
+        patch+solve path against the replicated device buffers and still
+        match a fresh CPU oracle."""
+        import dataclasses
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"c": [PFXS[0]]})
+        tpu = TpuSpfSolver("a", mesh=(4, 2))
+        db1 = tpu.build_route_db("a", {"0": ls}, ps)
+        nh1 = {
+            nh.neighbor_node
+            for nh in db1.unicast_entries[IpPrefix(PFXS[0])].nexthops
+        }
+        assert nh1 == {"b"}
+        solves_before = tpu.device_solves
+
+        # raise a-b so the direct a-c link wins: weight patch, same shapes
+        db = dbs["a"]
+        db = dataclasses.replace(
+            db,
+            adjacencies=[
+                dataclasses.replace(adj, metric=9)
+                if adj.other_node_name == "b"
+                else adj
+                for adj in db.adjacencies
+            ],
+        )
+        ls.update_adjacency_database(db)
+        db2 = tpu.build_route_db("a", {"0": ls}, ps)
+        nh2 = {
+            nh.neighbor_node
+            for nh in db2.unicast_entries[IpPrefix(PFXS[0])].nexthops
+        }
+        assert nh2 == {"c"}
+        assert tpu.device_solves == solves_before + 1
+
+        ls_cpu = LinkState("0")
+        for name in sorted(dbs):
+            src = db if name == "a" else dbs[name]
+            ls_cpu.update_adjacency_database(src)
+        assert_route_db_equal(
+            SpfSolver("a").build_route_db("a", {"0": ls_cpu}, ps), db2
+        )
+
+
+class TestMeshedKsp:
+    def test_all_pairs_ksp_grid(self):
+        ls_oracle = build_ls(grid_edges(4))
+        ls_dev = build_ls(grid_edges(4))
+        solver = TpuSpfSolver("g0_0", mesh=(4, 2))
+        me = "g0_0"
+        dests = sorted(set(ls_oracle.node_names()) - {me})
+        for k in (1, 2):
+            solver._prefetch_kth_paths(ls_dev, me, dests, k)
+            for dest in dests:
+                got = solver._kth_paths(ls_dev, me, dest, k)
+                want = ls_oracle.get_kth_paths(me, dest, k)
+                assert got == want, (me, dest, k)
+
+
+class TestDecisionWithMesh:
+    """The daemon path: DecisionConfig(solver_backend='tpu',
+    solver_mesh=(4, 2)) must emit the same route delta as the CPU
+    backend from live KvStore publications."""
+
+    def test_route_delta_parity(self):
+        from openr_tpu.testing import (
+            lsdb_publication,
+            run_decision_backend_parity,
+        )
+
+        pub = lsdb_publication(
+            build_adj_dbs(grid_edges(3)).values(),
+            announcers={"g2_2": ["10.9.0.0/16"]},
+        )
+        n_uni, n_mpls = run_decision_backend_parity("g0_0", pub, (4, 2))
+        assert n_uni == 1
+        assert n_mpls == 9  # one node label route per grid node
