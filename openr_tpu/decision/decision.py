@@ -144,6 +144,7 @@ class Decision(CountersMixin):
         )
         self._cold_start_until: Optional[float] = None
         self._cold_start_timer: Optional[asyncio.TimerHandle] = None
+        self._retry_timer: Optional[asyncio.TimerHandle] = None
         self._rib_policy_timer: Optional[asyncio.TimerHandle] = None
         self._task: Optional[asyncio.Task] = None
         self.counters: Dict[str, int] = {}
@@ -176,6 +177,13 @@ class Decision(CountersMixin):
             self._cold_start_timer = None
         if self._rib_policy_timer is not None:
             self._rib_policy_timer.cancel()
+        if self._retry_timer is not None:
+            self._retry_timer.cancel()
+            self._retry_timer = None
+
+    def _retry_rebuild(self) -> None:
+        self._retry_timer = None
+        self.rebuild_routes()
 
     def _end_cold_start(self) -> None:
         self._cold_start_until = None
@@ -214,6 +222,10 @@ class Decision(CountersMixin):
     # publication processing
     # ------------------------------------------------------------------
 
+    # minimum adj keys in one publication for the bulk cold-start ingest;
+    # small batches gain nothing over the incremental diff path
+    _BULK_ADJ_THRESHOLD = 8
+
     def process_publication(self, publication: Publication) -> None:
         area = publication.area
         link_state = self.area_link_states.get(area)
@@ -223,9 +235,14 @@ class Decision(CountersMixin):
             self.area_link_states[area] = link_state
 
         changed = False
+        bulk_keys = self._bulk_adj_keys(publication, link_state)
+        if bulk_keys:
+            changed |= self._bulk_ingest_adj(
+                publication, bulk_keys, area, link_state
+            )
         for key, value in publication.key_vals.items():
-            if value.value is None:
-                continue  # ttl refresh only
+            if value.value is None or key in bulk_keys:
+                continue  # ttl refresh only / already bulk-ingested
             try:
                 changed |= self._process_key(key, value, area, link_state)
             except Exception:
@@ -261,6 +278,61 @@ class Decision(CountersMixin):
 
         if changed:
             self._schedule_rebuild()
+
+    def _bulk_adj_keys(
+        self, publication: Publication, link_state: LinkState
+    ) -> Set[str]:
+        """Keys eligible for the cold-start bulk adjacency ingest: the area
+        LinkState is empty (a KvStore full sync after restart) and the
+        publication carries a batch of adj keys. Ordered-FIB holds are
+        irrelevant here — with an empty graph every hop-distance lookup
+        yields zero holds, which is what the bulk path applies."""
+        if link_state.num_nodes() or link_state.get_adjacency_databases():
+            return set()
+        keys = {
+            key
+            for key, value in publication.key_vals.items()
+            if key.startswith(ADJ_DB_MARKER) and value.value is not None
+        }
+        return keys if len(keys) >= self._BULK_ADJ_THRESHOLD else set()
+
+    def _bulk_ingest_adj(
+        self,
+        publication: Publication,
+        keys: Set[str],
+        area: str,
+        link_state: LinkState,
+    ) -> bool:
+        """Deserialize + ingest a full-sync batch of adj dbs in one pass
+        (LinkState.bulk_update_adjacency_databases). Per-key malformed
+        values are dropped with the same error accounting as the
+        incremental path."""
+        adj_dbs: List[AdjacencyDatabase] = []
+        for key in sorted(keys):  # deterministic ingest order
+            try:
+                adj_db = serializer.loads(publication.key_vals[key].value)
+                assert isinstance(adj_db, AdjacencyDatabase)
+                adj_db.area = area
+                adj_dbs.append(adj_db)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "failed to process key %s", key
+                )
+                self._bump("decision.errors")
+        change = link_state.bulk_update_adjacency_databases(adj_dbs)
+        self._bump("decision.adj_db_update", len(adj_dbs))
+        self._bump("decision.bulk_adj_ingests")
+        if not (
+            change.topology_changed
+            or change.link_attributes_changed
+            or change.node_label_changed
+        ):
+            return False
+        for db in adj_dbs:
+            self._pending.apply(db.perf_events)
+        return True
 
     def _process_key(
         self, key: str, value, area: str, link_state: LinkState
@@ -380,16 +452,21 @@ class Decision(CountersMixin):
         except Exception:
             # rebuild_routes runs from a loop timer callback: an uncaught
             # exception here vanishes into the loop's exception handler and
-            # the daemon silently stops converging. Log + count + re-arm the
-            # debounce, so a transient solver failure retries (at the
-            # debounce max backoff) instead of stalling until the next
-            # topology change.
+            # the daemon silently stops converging. Log + count + schedule a
+            # retry at the debounce MAX (a direct timer: re-arming the
+            # debouncer would fire at debounce_min again — its backoff
+            # resets on every fire — and a persistent failure would then
+            # burn the loop with ~100 failed full rebuilds per second).
             import logging
 
             logging.getLogger(__name__).exception("route build failed")
             self._bump("decision.route_build_errors")
             self._pending.needs_route_update = True
-            self._rebuild_debounce()
+            if self._retry_timer is not None:
+                self._retry_timer.cancel()
+            self._retry_timer = self.loop().call_later(
+                self.config.debounce_max, self._retry_rebuild
+            )
             return
         if new_db is None:
             return

@@ -37,6 +37,8 @@ Metric = int
 class HoldableValue:
     """A value whose previous state can be held for an ordered-FIB TTL."""
 
+    __slots__ = ("_val", "_held_val", "_has_held", "_hold_ttl")
+
     def __init__(self, val) -> None:
         self._val = val
         self._held_val = None
@@ -96,6 +98,31 @@ class HoldableValue:
         return val < self._val
 
 
+def _hv_value(x):
+    """Visible value of a maybe-held slot.
+
+    Link attribute slots hold PLAIN values until a hold is first requested
+    (then a HoldableValue) — cold-start ingest builds ~4 slots per link, and
+    at 100k-link scale eagerly allocating HoldableValues dominated the
+    whole-LSDB ingest profile."""
+    return x.value if type(x) is HoldableValue else x
+
+
+def _hv_update(cur, val, hold_up_ttl: int, hold_down_ttl: int):
+    """update_value on a maybe-held slot; returns (new_slot, visible_changed).
+
+    Plain slots with zero hold TTLs stay plain (straight assignment); a
+    nonzero TTL promotes the slot to a HoldableValue carrying the hold."""
+    if type(cur) is HoldableValue:
+        return cur, cur.update_value(val, hold_up_ttl, hold_down_ttl)
+    if val == cur:
+        return cur, False
+    if hold_up_ttl == 0 and hold_down_ttl == 0:
+        return val, True
+    hv = HoldableValue(cur)
+    return hv, hv.update_value(val, hold_up_ttl, hold_down_ttl)
+
+
 class Link:
     """A single bidirectional network link (LinkState.h:82)."""
 
@@ -117,6 +144,7 @@ class Link:
         "_nh_v6_2",
         "_hold_up_ttl",
         "key",
+        "_hash",
     )
 
     def __init__(
@@ -132,10 +160,12 @@ class Link:
         self.n2 = node2
         self.if1 = adj1.if_name
         self.if2 = adj2.if_name
-        self._metric1 = HoldableValue(adj1.metric)
-        self._metric2 = HoldableValue(adj2.metric)
-        self._overload1 = HoldableValue(adj1.is_overloaded)
-        self._overload2 = HoldableValue(adj2.is_overloaded)
+        # plain values; promoted to HoldableValue on first held update
+        # (_hv_update) — see _hv_value for why
+        self._metric1 = adj1.metric
+        self._metric2 = adj2.metric
+        self._overload1 = adj1.is_overloaded
+        self._overload2 = adj2.is_overloaded
         self._adj_label1 = adj1.adj_label
         self._adj_label2 = adj2.adj_label
         self._nh_v4_1 = adj1.nexthop_v4
@@ -146,14 +176,19 @@ class Link:
         # essential identity: unordered pair of (node, iface) ordered pairs
         # (LinkState.h:107-110); deterministic across processes (the reference
         # additionally orders by an in-process hash, which is arbitrary)
-        self.key: Tuple[Tuple[str, str], Tuple[str, str]] = tuple(
-            sorted([(node1, adj1.if_name), (node2, adj2.if_name)])
+        p1, p2 = (node1, adj1.if_name), (node2, adj2.if_name)
+        self.key: Tuple[Tuple[str, str], Tuple[str, str]] = (
+            (p1, p2) if p1 <= p2 else (p2, p1)
         )
+        # links live in many sets (link_map, all_links, SPF visited/ignore
+        # sets); hashing the nested string tuple per membership op is the
+        # single hottest line at 100k-link ingest scale, so cache it
+        self._hash = hash(self.key)
 
     # -- identity ----------------------------------------------------------
 
     def __hash__(self) -> int:
-        return hash(self.key)
+        return self._hash
 
     def __eq__(self, other) -> bool:
         return isinstance(other, Link) and self.key == other.key
@@ -183,18 +218,16 @@ class Link:
         return self.if1 if self._dir(node) == 1 else self.if2
 
     def metric_from_node(self, node: str) -> Metric:
-        return (
-            self._metric1.value if self._dir(node) == 1 else self._metric2.value
+        return _hv_value(
+            self._metric1 if self._dir(node) == 1 else self._metric2
         )
 
     def adj_label_from_node(self, node: str) -> int:
         return self._adj_label1 if self._dir(node) == 1 else self._adj_label2
 
     def overload_from_node(self, node: str) -> bool:
-        return (
-            self._overload1.value
-            if self._dir(node) == 1
-            else self._overload2.value
+        return _hv_value(
+            self._overload1 if self._dir(node) == 1 else self._overload2
         )
 
     def nh_v4_from_node(self, node: str) -> str:
@@ -218,8 +251,15 @@ class Link:
     def set_metric_from_node(
         self, node: str, metric: Metric, hold_up_ttl: int, hold_down_ttl: int
     ) -> bool:
-        hv = self._metric1 if self._dir(node) == 1 else self._metric2
-        return hv.update_value(metric, hold_up_ttl, hold_down_ttl)
+        if self._dir(node) == 1:
+            self._metric1, changed = _hv_update(
+                self._metric1, metric, hold_up_ttl, hold_down_ttl
+            )
+        else:
+            self._metric2, changed = _hv_update(
+                self._metric2, metric, hold_up_ttl, hold_down_ttl
+            )
+        return changed
 
     def set_adj_label_from_node(self, node: str, label: int) -> None:
         if self._dir(node) == 1:
@@ -231,8 +271,14 @@ class Link:
         self, node: str, overload: bool, hold_up_ttl: int, hold_down_ttl: int
     ) -> bool:
         was_up = self.is_up()
-        hv = self._overload1 if self._dir(node) == 1 else self._overload2
-        hv.update_value(overload, hold_up_ttl, hold_down_ttl)
+        if self._dir(node) == 1:
+            self._overload1, _ = _hv_update(
+                self._overload1, overload, hold_up_ttl, hold_down_ttl
+            )
+        else:
+            self._overload2, _ = _hv_update(
+                self._overload2, overload, hold_up_ttl, hold_down_ttl
+            )
         # simplex overloads unsupported: only a change in effective up-ness is
         # a topology change (LinkState.cpp:342-344)
         return was_up != self.is_up()
@@ -245,8 +291,8 @@ class Link:
     def is_up(self) -> bool:
         return (
             self._hold_up_ttl == 0
-            and not self._overload1.value
-            and not self._overload2.value
+            and not _hv_value(self._overload1)
+            and not _hv_value(self._overload2)
         )
 
     def decrement_holds(self) -> bool:
@@ -254,19 +300,21 @@ class Link:
         if self._hold_up_ttl != 0:
             self._hold_up_ttl -= 1
             expired |= self._hold_up_ttl == 0
-        expired |= self._metric1.decrement_ttl()
-        expired |= self._metric2.decrement_ttl()
-        expired |= self._overload1.decrement_ttl()
-        expired |= self._overload2.decrement_ttl()
+        for slot in (
+            self._metric1, self._metric2, self._overload1, self._overload2
+        ):
+            if type(slot) is HoldableValue:
+                expired |= slot.decrement_ttl()
         return expired
 
     def has_holds(self) -> bool:
-        return (
-            self._hold_up_ttl != 0
-            or self._metric1.has_hold()
-            or self._metric2.has_hold()
-            or self._overload1.has_hold()
-            or self._overload2.has_hold()
+        if self._hold_up_ttl != 0:
+            return True
+        return any(
+            type(slot) is HoldableValue and slot.has_hold()
+            for slot in (
+                self._metric1, self._metric2, self._overload1, self._overload2
+            )
         )
 
     def __repr__(self) -> str:
@@ -493,6 +541,98 @@ class LinkState:
             i += 1
             j += 1
 
+        if change.topology_changed:
+            self._invalidate()
+        return change
+
+    def bulk_update_adjacency_databases(
+        self, adj_dbs: List[AdjacencyDatabase]
+    ) -> LinkStateChange:
+        """Cold-start ingest: apply many adjacency databases in one pass.
+
+        Equivalent to calling update_adjacency_database(db) for each db (no
+        ordered-FIB holds — cold start predates any FIB state to order
+        against), but O(E) instead of O(sum deg(u)*deg(v)): bidirectional
+        matching uses one descriptor map over all adjacencies instead of
+        the per-adjacency linear scan of the other node's list
+        (_maybe_make_link, mirroring LinkState.cpp:531-547). This is the
+        KvStore full-sync ingest path (reference hot path:
+        LinkState.cpp:564-717 run once per node at cold start).
+
+        Falls back to the incremental path when any incoming node already
+        exists — the fast path's correctness argument is only written for
+        fresh nodes (no prior links to diff against, no holds to carry).
+        """
+        adj_dbs = list(adj_dbs)
+        if any(
+            db.this_node_name in self._adjacency_databases for db in adj_dbs
+        ) or len({db.this_node_name for db in adj_dbs}) != len(adj_dbs):
+            change = LinkStateChange()
+            for db in adj_dbs:
+                change |= self.update_adjacency_database(db)
+            return change
+
+        change = LinkStateChange()
+        for db in adj_dbs:
+            assert db.area == self.area, (db.area, self.area)
+            node = db.this_node_name
+            self._adjacency_databases[node] = db
+            self._node_overloads.setdefault(
+                node, HoldableValue(db.is_overloaded)
+            )
+            change.node_label_changed |= db.node_label != 0
+        self._log_graph("structure")  # consumers rebuild wholesale
+
+        # descriptor map over ALL known adjacencies (pre-existing nodes
+        # included: an incoming node may peer with one). First-wins per
+        # descriptor reproduces _maybe_make_link's first-match scan.
+        descr: Dict[Tuple[str, str, str, str], Adjacency] = {}
+        for other_db in self._adjacency_databases.values():
+            other = other_db.this_node_name
+            for adj in other_db.adjacencies:
+                descr.setdefault(
+                    (other, adj.if_name, adj.other_node_name,
+                     adj.other_if_name),
+                    adj,
+                )
+
+        incoming = {db.this_node_name for db in adj_dbs}
+        new_links: List[Link] = []
+        any_up = False
+        for db in adj_dbs:
+            node = db.this_node_name
+            for adj in db.adjacencies:
+                other = adj.other_node_name
+                # both-incoming pairs are discovered from each side; keep
+                # exactly the side whose (node, iface) sorts first so each
+                # link is constructed once
+                if other in incoming and (other, adj.other_if_name) < (
+                    node, adj.if_name
+                ):
+                    continue
+                other_adj = descr.get(
+                    (other, adj.other_if_name, node, adj.if_name)
+                )
+                if other_adj is None:
+                    continue
+                link = Link(self.area, node, adj, other, other_adj)
+                new_links.append(link)
+                if not any_up:
+                    any_up = link.is_up()
+
+        # bulk insertion (the set adds dedupe degenerate duplicate
+        # adjacencies the same way repeated _add_link calls would)
+        self._all_links.update(new_links)
+        link_map = self._link_map
+        for link in new_links:
+            link_map.setdefault(link.n1, set()).add(link)
+            link_map.setdefault(link.n2, set()).add(link)
+        # sorted-order caches may exist for pre-existing peer nodes; a bulk
+        # event is rare enough that dropping them all is cheaper than
+        # tracking which endpoints were touched
+        self._ordered_links.clear()
+
+        change.topology_changed |= any_up
         if change.topology_changed:
             self._invalidate()
         return change

@@ -36,8 +36,7 @@ import numpy as np
 from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 
 
-@jax.jit
-def _bf_fixpoint_vw(
+def _bf_fixpoint_vw_core(
     sources: jnp.ndarray,  # int32 [S]
     src_e: jnp.ndarray,  # int32 [E]
     dst_e: jnp.ndarray,  # int32 [E]
@@ -79,6 +78,29 @@ def _bf_fixpoint_vw(
 
     d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
     return d
+
+
+_bf_fixpoint_vw = jax.jit(_bf_fixpoint_vw_core)
+
+
+@functools.lru_cache(maxsize=8)
+def _bf_vw_solver(mesh=None):
+    """Jitted per-row-weights edge-list solve, optionally mesh-sharded
+    (sources and weight rows over 'batch'). The non-sliced analog of
+    _sell_solver_vw(key, mesh) so KSP prefetch honors solver_mesh on
+    graphs that disqualify the sliced-ELL layout."""
+    if mesh is None:
+        return _bf_fixpoint_vw
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    row = NamedSharding(mesh, P("batch"))
+    row2 = NamedSharding(mesh, P("batch", None))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        _bf_fixpoint_vw_core,
+        in_shardings=(row, repl, repl, row2, repl),
+        out_shardings=row2,
+    )
 
 
 @jax.jit
@@ -346,7 +368,7 @@ def sell_fixpoint(
     overloaded,  # bool [n_pad]
 ) -> jnp.ndarray:
     """Distance matrix D [S, N] via the sliced-ELL pull relaxation."""
-    fn = _sell_solver(sell.shape_key())
+    fn = _sell_solver(sell.shape_key(), None)
     return fn(
         jnp.asarray(sources, dtype=jnp.int32),
         tuple(jnp.asarray(a) for a in sell.nbr),
@@ -376,10 +398,14 @@ def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
 
 
 def batched_spf_vw(
-    graph: CompiledGraph, source_rows: np.ndarray, w_rows: np.ndarray
+    graph: CompiledGraph, source_rows: np.ndarray, w_rows: np.ndarray,
+    mesh=None,
 ) -> jnp.ndarray:
-    """Batched solve with per-row weight vectors (shape [S, e_pad])."""
-    return _bf_fixpoint_vw(
+    """Batched solve with per-row weight vectors (shape [S, e_pad]).
+
+    With a mesh, sources and weight rows shard over 'batch' (S must be a
+    multiple of the batch-axis size)."""
+    return _bf_vw_solver(mesh)(
         jnp.asarray(source_rows, dtype=jnp.int32),
         jnp.asarray(graph.src),
         jnp.asarray(graph.dst),

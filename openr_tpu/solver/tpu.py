@@ -444,7 +444,9 @@ class _AreaSolve:
                     fwd, rev = self.graph.link_edges[link]
                     w_rows[row, fwd] = INF
                     w_rows[row, rev] = INF
-            d_rows = np.asarray(batched_spf_vw(self.graph, sources, w_rows))
+            d_rows = np.asarray(
+                batched_spf_vw(self.graph, sources, w_rows, mesh=self.mesh)
+            )
         self.ksp_device_batches += 1
 
         for row, (dest, ig) in enumerate(zip(todo, ignores)):

@@ -59,18 +59,14 @@ def build_adj_dbs(
 ) -> Dict[str, AdjacencyDatabase]:
     """Build per-node AdjacencyDatabases from an undirected edge list."""
     adjs: Dict[str, List[Adjacency]] = {}
-    nodes: List[str] = []
     for edge in edges:
         a, b, metric = edge
         adj_a, adj_b = make_adj_pair(a, b, metric)
         adjs.setdefault(a, []).append(adj_a)
         adjs.setdefault(b, []).append(adj_b)
-        for n in (a, b):
-            if n not in nodes:
-                nodes.append(n)
     overloaded = overloaded_nodes or set()
     dbs = {}
-    for i, node in enumerate(sorted(nodes)):
+    for i, node in enumerate(sorted(adjs)):
         dbs[node] = AdjacencyDatabase(
             this_node_name=node,
             adjacencies=adjs.get(node, []),

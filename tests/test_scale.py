@@ -160,3 +160,138 @@ def test_full_stack_ring_convergence_at_width():
         await net.stop_all()
 
     run(body())
+
+
+# ---------------------------------------------------------------------------
+# bulk cold-start ingest (LinkState.bulk_update_adjacency_databases)
+# ---------------------------------------------------------------------------
+
+
+def assert_link_state_equal(a: LinkState, b: LinkState, spf_sources=()):
+    """Structural equality: same nodes, links, per-direction attributes,
+    overloads — and identical SPF answers from sampled sources."""
+    assert set(a.get_adjacency_databases()) == set(b.get_adjacency_databases())
+    links_a = {l.key: l for l in a.all_links}
+    links_b = {l.key: l for l in b.all_links}
+    assert set(links_a) == set(links_b)
+    for key, la in links_a.items():
+        lb = links_b[key]
+        for node in (la.n1, la.n2):
+            assert la.metric_from_node(node) == lb.metric_from_node(node)
+            assert la.overload_from_node(node) == lb.overload_from_node(node)
+            assert la.adj_label_from_node(node) == lb.adj_label_from_node(node)
+            assert la.nh_v4_from_node(node) == lb.nh_v4_from_node(node)
+            assert la.nh_v6_from_node(node) == lb.nh_v6_from_node(node)
+        assert la.is_up() == lb.is_up()
+    for node in a.get_adjacency_databases():
+        assert a.is_node_overloaded(node) == b.is_node_overloaded(node)
+    for src in spf_sources:
+        ra, rb = a.get_spf_result(src), b.get_spf_result(src)
+        assert set(ra) == set(rb)
+        for dest in ra:
+            assert ra[dest].metric == rb[dest].metric, (src, dest)
+            assert ra[dest].next_hops == rb[dest].next_hops, (src, dest)
+
+
+class TestBulkIngest:
+    def test_clos_bulk_equals_incremental(self):
+        edges, dbs = clos_1000()
+        inc = LinkState("0")
+        for db in dbs.values():
+            inc.update_adjacency_database(db)
+        bulk = LinkState("0")
+        change = bulk.bulk_update_adjacency_databases(list(dbs.values()))
+        assert change.topology_changed and change.node_label_changed
+        assert_link_state_equal(
+            inc, bulk, spf_sources=["rsw0_0", "fsw0_0", "ssw0_0"]
+        )
+
+    def test_bulk_peers_with_preexisting_nodes(self):
+        edges = [("a", "b", 1), ("b", "c", 2), ("c", "d", 3), ("d", "a", 4),
+                 ("a", "c", 9)]
+        dbs = build_adj_dbs(edges, overloaded_nodes={"c"})
+        inc = LinkState("0")
+        for db in dbs.values():
+            inc.update_adjacency_database(db)
+        # bulk: 'a' pre-exists, the rest arrive as one batch
+        mixed = LinkState("0")
+        mixed.update_adjacency_database(dbs["a"])
+        mixed.bulk_update_adjacency_databases(
+            [dbs[n] for n in ("b", "c", "d")]
+        )
+        assert_link_state_equal(inc, mixed, spf_sources=["a", "b"])
+
+    def test_bulk_falls_back_on_overlap(self):
+        edges = [("a", "b", 1), ("b", "c", 2)]
+        dbs = build_adj_dbs(edges)
+        inc = LinkState("0")
+        for db in dbs.values():
+            inc.update_adjacency_database(db)
+        over = LinkState("0")
+        over.update_adjacency_database(dbs["b"])
+        # batch includes 'b' again -> incremental fallback, same result
+        over.bulk_update_adjacency_databases(list(dbs.values()))
+        assert_link_state_equal(inc, over, spf_sources=["a"])
+
+    def test_unidirectional_adjacency_makes_no_link(self):
+        dbs = build_adj_dbs([("a", "b", 1)])
+        # strip b's reverse adjacency: no bidirectional match
+        dbs["b"].adjacencies.clear()
+        bulk = LinkState("0")
+        bulk.bulk_update_adjacency_databases(list(dbs.values()))
+        assert bulk.num_links() == 0
+        inc = LinkState("0")
+        for db in dbs.values():
+            inc.update_adjacency_database(db)
+        assert_link_state_equal(inc, bulk)
+
+    def test_decision_full_sync_publication_uses_bulk(self):
+        """One publication carrying the whole LSDB (a KvStore full sync)
+        must ride the bulk path and produce oracle-identical routes."""
+        edges, dbs = clos_1000()
+        me = "rsw0_0"
+
+        async def body():
+            kv_q = RWQueue()
+            route_q = ReplicateQueue()
+            decision = Decision(
+                DecisionConfig(
+                    my_node_name=me,
+                    debounce_min=0.005,
+                    debounce_max=0.05,
+                ),
+                RQueue(kv_q),
+                route_q,
+            )
+            reader = route_q.get_reader()
+            decision.start()
+            pub = Publication(area="0")
+            for i, (node, db) in enumerate(sorted(dbs.items())):
+                pub.key_vals[adj_key(node)] = Value(
+                    1, node, serializer.dumps(db)
+                )
+                pub.key_vals[prefix_key(node)] = Value(
+                    1, node, serializer.dumps(prefix_db_of(i, node))
+                )
+            t0 = time.time()
+            kv_q.push(pub)
+            delta = await asyncio.wait_for(reader.get(), 120)
+            elapsed = time.time() - t0
+            assert decision.counters.get("decision.bulk_adj_ingests") == 1
+            routes = {e.prefix: e for e in delta.unicast_routes_to_update}
+            assert len(routes) == len(dbs) - 1
+
+            ls = LinkState("0")
+            ls.bulk_update_adjacency_databases(list(dbs.values()))
+            ps = PrefixState()
+            for i, node in enumerate(sorted(dbs)):
+                ps.update_prefix_database(prefix_db_of(i, node))
+            oracle = SpfSolver(me).build_route_db(me, {"0": ls}, ps)
+            assert set(routes) == set(oracle.unicast_entries)
+            for pfx in list(oracle.unicast_entries)[:50]:
+                assert routes[pfx] == oracle.unicast_entries[pfx], pfx
+            decision.stop()
+            return elapsed
+
+        elapsed = run(body())
+        assert elapsed < 60, elapsed

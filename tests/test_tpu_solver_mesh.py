@@ -227,3 +227,27 @@ class TestDecisionWithMesh:
         n_uni, n_mpls = run_decision_backend_parity("g0_0", pub, (4, 2))
         assert n_uni == 1
         assert n_mpls == 9  # one node label route per grid node
+
+
+class TestMeshedEdgeListVw:
+    def test_batched_spf_vw_meshed_matches_single_device(self):
+        """The non-sliced per-row-weights solve (KSP fallback for graphs
+        that disqualify sliced-ELL) must honor the mesh and agree with the
+        single-device result."""
+        import numpy as np
+
+        from openr_tpu.ops import compile_graph
+        from openr_tpu.ops.graph import INF
+        from openr_tpu.ops.spf import batched_spf_vw
+        from openr_tpu.parallel import resolve_mesh
+
+        ls = build_ls(grid_edges(4))
+        g = compile_graph(ls)
+        mesh = resolve_mesh((4, 2))
+        s = 8
+        rows = np.arange(s, dtype=np.int32)
+        w_rows = np.tile(g.w, (s, 1))
+        w_rows[3, :4] = INF  # one penalized row
+        d_single = np.asarray(batched_spf_vw(g, rows, w_rows))
+        d_meshed = np.asarray(batched_spf_vw(g, rows, w_rows, mesh=mesh))
+        np.testing.assert_array_equal(d_single, d_meshed)
