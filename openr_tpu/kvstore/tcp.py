@@ -265,7 +265,13 @@ class TcpTransport(KvStoreTransport):
         """Install a client TLS context before any peer connection exists
         (the daemon wires TLS from config after constructing the
         transport); refuses once plaintext connections are cached."""
-        assert not self._conns, "peer connections already established"
+        if self._conns:
+            # a bare assert would vanish under python -O and silently allow
+            # mixed plaintext/TLS peering
+            raise RuntimeError(
+                "cannot enable TLS: plaintext peer connections already "
+                "established"
+            )
         self._ssl_context = ssl_context
 
     @staticmethod

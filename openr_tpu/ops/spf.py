@@ -91,11 +91,7 @@ def _bf_vw_solver(mesh=None):
     graphs that disqualify the sliced-ELL layout."""
     if mesh is None:
         return _bf_fixpoint_vw
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    row = NamedSharding(mesh, P("batch"))
-    row2 = NamedSharding(mesh, P("batch", None))
-    repl = NamedSharding(mesh, P())
+    row, repl, row2 = _mesh_shardings(mesh)
     return jax.jit(
         _bf_fixpoint_vw_core,
         in_shardings=(row, repl, repl, row2, repl),

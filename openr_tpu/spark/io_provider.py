@@ -10,6 +10,7 @@ seam here; the real UDP provider wraps asyncio datagram transports.
 from __future__ import annotations
 
 import asyncio
+import logging
 import socket
 import struct
 import time
@@ -179,17 +180,23 @@ class UdpIoProvider(IoProvider):
                 ):
                     sec, nsec = _TIMESPEC.unpack_from(cdata)
                     rt_us = sec * 1_000_000 + nsec // 1_000
-                    # a realtime clock STEP (not slew) skews the stored
-                    # monotonic-realtime offset; detect it by comparing the
-                    # CURRENT offset against the stored one — queue delay
-                    # shifts both clocks equally and cannot false-trigger
+                    # rebase with the offset sampled NOW: its error is only
+                    # the realtime-vs-monotonic divergence over the queue
+                    # window (effectively zero), so NTP slew never
+                    # accumulates as RTT bias. The stored offset exists
+                    # only to LOG large realtime clock steps — queue delay
+                    # shifts both clocks equally and cannot false-trigger.
                     offset_now = int(
                         time.monotonic() * 1_000_000
                         - time.time() * 1_000_000
                     )
                     if abs(offset_now - self._mono_minus_real_us) > 100_000:
-                        self._mono_minus_real_us = offset_now
-                    recv_us = rt_us + self._mono_minus_real_us
+                        logging.getLogger(__name__).info(
+                            "realtime clock step detected: offset moved "
+                            "%dus", offset_now - self._mono_minus_real_us
+                        )
+                    self._mono_minus_real_us = offset_now
+                    recv_us = rt_us + offset_now
             callback = self._callback
             if callback is None:
                 continue
@@ -213,7 +220,9 @@ class UdpIoProvider(IoProvider):
         for sock, loop, _ifindex in self._endpoints.values():
             try:
                 loop.remove_reader(sock.fileno())
-            except (OSError, ValueError):
+            except (OSError, ValueError, RuntimeError):
+                # RuntimeError: the event loop is already closed; the
+                # remaining sockets must still be closed below
                 pass
             sock.close()
         self._endpoints.clear()

@@ -36,8 +36,13 @@ def client_ssl_context(
     key_path: Optional[str] = None,
 ) -> ssl.SSLContext:
     """Client side: verify the server against the CA (no hostname check —
-    routers peer by address; identity is the certificate CN, checked via
-    acceptable-peers) and present our certificate for mutual auth."""
+    routers peer by address) and present our certificate for mutual auth.
+
+    Note the asymmetry, mirroring the reference's server-side
+    `tls_acceptable_peers` flag: only SERVERS check the peer CN against
+    the acceptable-peers list; a client accepts any server certificate
+    issued by the CA. Callers needing client-side peer pinning can check
+    `peer_common_name()` after the handshake."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     ctx.load_verify_locations(ca_path)
     ctx.check_hostname = False
