@@ -22,6 +22,7 @@
 #include <linux/lwtunnel.h>
 #include <linux/mpls.h>
 #include <linux/mpls_iptunnel.h>
+#include <linux/neighbour.h>
 #include <linux/netlink.h>
 #include <linux/rtnetlink.h>
 #include <net/if.h>
@@ -33,6 +34,11 @@
 #include <cstdio>
 #include <string>
 #include <vector>
+
+#ifndef NDA_RTA /* glibc's rtnetlink.h stops at TA_RTA */
+#define NDA_RTA(r) \
+  ((struct rtattr*)(((char*)(r)) + NLMSG_ALIGN(sizeof(struct ndmsg))))
+#endif
 
 namespace {
 
@@ -156,6 +162,69 @@ bool parse_prefix(const char* s, IpAddr* addr, int* prefixlen) {
 
 void format_addr(int family, const void* data, char* out, size_t outlen) {
   inet_ntop(family, data, out, outlen);
+}
+
+void format_mac(const uint8_t* mac, size_t len, char* out, size_t outlen) {
+  if (len == 6) {
+    snprintf(out, outlen, "%02x:%02x:%02x:%02x:%02x:%02x", mac[0], mac[1],
+             mac[2], mac[3], mac[4], mac[5]);
+  } else {
+    out[0] = '\0';
+  }
+}
+
+bool parse_mac(const char* s, uint8_t* out) {
+  unsigned v[6];
+  if (sscanf(s, "%x:%x:%x:%x:%x:%x", &v[0], &v[1], &v[2], &v[3], &v[4],
+             &v[5]) != 6) {
+    return false;
+  }
+  for (int i = 0; i < 6; i++) out[i] = static_cast<uint8_t>(v[i]);
+  return true;
+}
+
+/* reference NetlinkTypes.cpp:15-23 kNeighborReachableStates */
+bool neighbor_reachable(int state) {
+  switch (state) {
+    case NUD_REACHABLE:
+    case NUD_STALE:
+    case NUD_DELAY:
+    case NUD_PERMANENT:
+    case NUD_PROBE:
+    case NUD_NOARP:
+      return true;
+    default:
+      return false;
+  }
+}
+
+/* parse one RTM_NEWNEIGH/RTM_DELNEIGH payload; false = not an IP neighbor
+ * (e.g. AF_BRIDGE fdb entry) */
+bool parse_neigh_msg(nlmsghdr* nh, onl_neigh* out) {
+  auto* m = reinterpret_cast<ndmsg*>(NLMSG_DATA(nh));
+  if (m->ndm_family != AF_INET && m->ndm_family != AF_INET6) return false;
+  memset(out, 0, sizeof(*out));
+  out->ifindex = m->ndm_ifindex;
+  out->family = m->ndm_family;
+  out->state = m->ndm_state;
+  out->is_reachable =
+      (nh->nlmsg_type == RTM_NEWNEIGH && neighbor_reachable(m->ndm_state))
+          ? 1
+          : 0;
+  int len = nh->nlmsg_len - NLMSG_LENGTH(sizeof(*m));
+  bool have_dst = false;
+  for (auto* rta = reinterpret_cast<rtattr*>(NDA_RTA(m)); RTA_OK(rta, len);
+       rta = RTA_NEXT(rta, len)) {
+    if (rta->rta_type == NDA_DST) {
+      format_addr(m->ndm_family, RTA_DATA(rta), out->dest,
+                  sizeof(out->dest));
+      have_dst = true;
+    } else if (rta->rta_type == NDA_LLADDR) {
+      format_mac(static_cast<uint8_t*>(RTA_DATA(rta)), RTA_PAYLOAD(rta),
+                 out->lladdr, sizeof(out->lladdr));
+    }
+  }
+  return have_dst;
 }
 
 /* mpls label stack entry encoding (RFC 3032): label<<12 | tc<<9 | S<<8 */
@@ -393,6 +462,61 @@ int onl_add_addr(void* hv, int ifindex, const char* addr, int prefixlen) {
 int onl_del_addr(void* hv, int ifindex, const char* addr, int prefixlen) {
   return addr_op(static_cast<Handle*>(hv), RTM_DELADDR, 0, ifindex, addr,
                  prefixlen);
+}
+
+int onl_get_neighbors(void* hv, int family, onl_neigh* out, int max) {
+  auto* h = static_cast<Handle*>(hv);
+  MsgBuilder msg(RTM_GETNEIGH, NLM_F_REQUEST | NLM_F_DUMP, 0);
+  auto* ndm = msg.add_payload<ndmsg>();
+  ndm->ndm_family = family == 0 ? AF_UNSPEC : family;
+  int count = 0;
+  bool ok = transact(h, msg, [&](nlmsghdr* nh) {
+    if (nh->nlmsg_type != RTM_NEWNEIGH || count >= max) return;
+    onl_neigh n;
+    if (!parse_neigh_msg(nh, &n)) return;
+    if (family != 0 && n.family != family) return;
+    out[count++] = n;
+  });
+  return ok ? count : -1;
+}
+
+int onl_add_neighbor(void* hv, int ifindex, const char* dest,
+                     const char* lladdr) {
+  auto* h = static_cast<Handle*>(hv);
+  IpAddr ip;
+  if (!parse_addr(dest, &ip)) {
+    h->error = "bad neighbor address";
+    return -1;
+  }
+  uint8_t mac[6];
+  if (!parse_mac(lladdr, mac)) {
+    h->error = "bad link address";
+    return -1;
+  }
+  MsgBuilder msg(RTM_NEWNEIGH,
+                 NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_REPLACE, 0);
+  auto* ndm = msg.add_payload<ndmsg>();
+  ndm->ndm_family = ip.family;
+  ndm->ndm_ifindex = ifindex;
+  ndm->ndm_state = NUD_PERMANENT;
+  msg.add_attr(NDA_DST, ip.bytes, ip.len);
+  msg.add_attr(NDA_LLADDR, mac, sizeof(mac));
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
+}
+
+int onl_del_neighbor(void* hv, int ifindex, const char* dest) {
+  auto* h = static_cast<Handle*>(hv);
+  IpAddr ip;
+  if (!parse_addr(dest, &ip)) {
+    h->error = "bad neighbor address";
+    return -1;
+  }
+  MsgBuilder msg(RTM_DELNEIGH, NLM_F_REQUEST | NLM_F_ACK, 0);
+  auto* ndm = msg.add_payload<ndmsg>();
+  ndm->ndm_family = ip.family;
+  ndm->ndm_ifindex = ifindex;
+  msg.add_attr(NDA_DST, ip.bytes, ip.len);
+  return transact(h, msg, [](nlmsghdr*) {}) ? 0 : -1;
 }
 
 int onl_add_unicast_route(void* hv, const char* dest, int proto, int table,
@@ -652,7 +776,8 @@ int onl_get_routes(void* hv, int family, int proto, int table, char* buf,
 int onl_subscribe(void* hv) {
   auto* h = static_cast<Handle*>(hv);
   if (h->event_fd >= 0) return 0;
-  uint32_t groups = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR;
+  uint32_t groups =
+      RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR | RTMGRP_NEIGH;
   if (!open_socket(&h->event_fd, groups)) {
     h->fail("event socket");
     return -1;
@@ -694,6 +819,17 @@ int onl_next_event(void* hv, onl_event* out) {
                    static_cast<char*>(RTA_DATA(rta)));
         }
       }
+      return 1;
+    }
+    if (nh->nlmsg_type == RTM_NEWNEIGH || nh->nlmsg_type == RTM_DELNEIGH) {
+      onl_neigh n;
+      if (!parse_neigh_msg(nh, &n)) continue; /* bridge fdb etc */
+      out->kind = 4;
+      out->ifindex = n.ifindex;
+      out->up = n.is_reachable;
+      out->state = n.state;
+      snprintf(out->addr, sizeof(out->addr), "%s", n.dest);
+      snprintf(out->lladdr, sizeof(out->lladdr), "%s", n.lladdr);
       return 1;
     }
     if (nh->nlmsg_type == RTM_NEWADDR || nh->nlmsg_type == RTM_DELADDR) {

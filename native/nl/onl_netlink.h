@@ -51,13 +51,27 @@ typedef struct onl_nexthop {
 } onl_nexthop;
 
 typedef struct onl_event {
-  int32_t kind; /* 1=link 2=addr 3=route */
+  int32_t kind; /* 1=link 2=addr 3=route 4=neighbor */
   int32_t ifindex;
-  int32_t up;        /* link: admin+oper up; addr: 1=added 0=deleted */
+  int32_t up;        /* link: admin+oper up; addr: 1=added 0=deleted;
+                      * neigh: 1=reachable 0=unreachable/deleted */
   int32_t prefixlen; /* addr only */
   char name[32];     /* link name */
-  char addr[64];     /* addr, presentation form */
+  char addr[64];     /* addr / neighbor dest, presentation form */
+  int32_t state;     /* neigh: NUD_* state value */
+  char lladdr[24];   /* neigh: link (MAC) address, presentation form */
 } onl_event;
+
+/* Neighbor-table entry (reference openr/nl/NetlinkTypes.h:438-525 Neighbor:
+ * ifindex + destination + link address + NUD state + reachability). */
+typedef struct onl_neigh {
+  int32_t ifindex;
+  int32_t family;       /* AF_INET / AF_INET6 */
+  int32_t state;        /* NUD_* state value */
+  int32_t is_reachable; /* per reference isNeighborReachable(state) */
+  char dest[64];        /* neighbor IP, presentation form */
+  char lladdr[24];      /* link (MAC) address; "" if kernel omitted it */
+} onl_neigh;
 
 /* Lifecycle. onl_open returns NULL on failure. */
 void* onl_open(void);
@@ -83,6 +97,18 @@ int onl_del_unicast_route(void* h, const char* dest, int proto, int table);
 int onl_add_mpls_route(void* h, int label, const onl_nexthop* nhs, int n_nhs,
                        int replace);
 int onl_del_mpls_route(void* h, int label);
+
+/* Neighbor table (NetlinkProtocolSocket::getAllNeighbors equivalent).
+ * family: AF_INET / AF_INET6 / 0 (= v4+v6; bridge fdb entries excluded).
+ * Returns count written (<= max), or -1 on error. */
+int onl_get_neighbors(void* h, int family, onl_neigh* out, int max);
+
+/* Static neighbor management (NeighborBuilder add/del semantics): add
+ * installs a NUD_PERMANENT entry for dest with the given link address;
+ * del removes the entry. Returns 0 on success, -1 on error. */
+int onl_add_neighbor(void* h, int ifindex, const char* dest,
+                     const char* lladdr);
+int onl_del_neighbor(void* h, int ifindex, const char* dest);
 
 /* Dump routes for (proto, table). Writes one route per line into buf:
  *   dest|via,ifindex,weight[,action:l1/l2];via,ifindex,weight...

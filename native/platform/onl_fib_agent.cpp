@@ -543,6 +543,30 @@ static Json handle(Agent& ag, const std::string& method, const Json& params,
     return arr;
   }
 
+  if (method == "getNeighbors") {
+    Json arr = jarr();
+    if (!ag.dryrun) {
+      std::vector<onl_neigh> ns(8192);
+      int n = onl_get_neighbors(ag.nl, (int)params.get_int("family", 0),
+                                ns.data(), (int)ns.size());
+      if (n < 0) {
+        err = onl_strerror(ag.nl);
+        return Json();
+      }
+      for (int i = 0; i < n; ++i) {
+        Json o = jobj();
+        o.obj.emplace_back("ifindex", jint(ns[i].ifindex));
+        o.obj.emplace_back("dest", jstr(ns[i].dest));
+        o.obj.emplace_back("lladdr", jstr(ns[i].lladdr));
+        o.obj.emplace_back("family", jint(ns[i].family));
+        o.obj.emplace_back("state", jint(ns[i].state));
+        o.obj.emplace_back("is_reachable", jint(ns[i].is_reachable));
+        arr.arr.push_back(std::move(o));
+      }
+    }
+    return arr;
+  }
+
   err = "unknown method " + method;
   return Json();
 }

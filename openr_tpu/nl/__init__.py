@@ -8,6 +8,7 @@ core (native/nl/onl_netlink.cpp ≙ openr/nl/NetlinkProtocolSocket.{h,cpp}).
 from openr_tpu.nl.netlink import (
     Link,
     IfAddress,
+    Neighbor,
     NetlinkError,
     NetlinkSocket,
     NlNextHop,
@@ -18,6 +19,7 @@ from openr_tpu.nl.netlink import (
 __all__ = [
     "Link",
     "IfAddress",
+    "Neighbor",
     "NetlinkError",
     "NetlinkSocket",
     "NlNextHop",

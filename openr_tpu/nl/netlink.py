@@ -69,6 +69,19 @@ class _CEvent(ctypes.Structure):
         ("prefixlen", ctypes.c_int32),
         ("name", ctypes.c_char * 32),
         ("addr", ctypes.c_char * 64),
+        ("state", ctypes.c_int32),
+        ("lladdr", ctypes.c_char * 24),
+    ]
+
+
+class _CNeigh(ctypes.Structure):
+    _fields_ = [
+        ("ifindex", ctypes.c_int32),
+        ("family", ctypes.c_int32),
+        ("state", ctypes.c_int32),
+        ("is_reachable", ctypes.c_int32),
+        ("dest", ctypes.c_char * 64),
+        ("lladdr", ctypes.c_char * 24),
     ]
 
 
@@ -149,6 +162,23 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p,
         ctypes.c_int,
     ]
+    lib.onl_get_neighbors.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(_CNeigh),
+        ctypes.c_int,
+    ]
+    lib.onl_add_neighbor.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.onl_del_neighbor.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+    ]
     lib.onl_subscribe.argtypes = [ctypes.c_void_p]
     lib.onl_event_fd.argtypes = [ctypes.c_void_p]
     lib.onl_next_event.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CEvent)]
@@ -181,6 +211,18 @@ class IfAddress:
     addr: str
     prefixlen: int
     family: int
+
+
+@dataclass(frozen=True)
+class Neighbor:
+    """Kernel neighbor-table entry (openr/nl/NetlinkTypes.h:491 Neighbor)."""
+
+    ifindex: int
+    dest: str
+    lladdr: str
+    family: int
+    state: int
+    is_reachable: bool
 
 
 @dataclass(frozen=True)
@@ -254,6 +296,42 @@ class NetlinkSocket:
             IfAddress(a.ifindex, a.addr.decode(), a.prefixlen, a.family)
             for a in arr[:n]
         ]
+
+    def get_neighbors(self, family: int = 0) -> List[Neighbor]:
+        """Dump the kernel neighbor (ARP/NDP) table.
+
+        Equivalent of NetlinkProtocolSocket::getAllNeighbors
+        (openr/nl/NetlinkProtocolSocket.h:170); family 0 = v4+v6.
+        """
+        arr = (_CNeigh * 8192)()
+        n = self._lib.onl_get_neighbors(self._h, family, arr, 8192)
+        self._check(n, "get_neighbors")
+        return [
+            Neighbor(
+                a.ifindex,
+                a.dest.decode(),
+                a.lladdr.decode(),
+                a.family,
+                a.state,
+                bool(a.is_reachable),
+            )
+            for a in arr[:n]
+        ]
+
+    def add_neighbor(self, ifindex: int, dest: str, lladdr: str) -> None:
+        """Install a permanent neighbor entry (NeighborBuilder add)."""
+        self._check(
+            self._lib.onl_add_neighbor(
+                self._h, ifindex, dest.encode(), lladdr.encode()
+            ),
+            "add_neighbor",
+        )
+
+    def del_neighbor(self, ifindex: int, dest: str) -> None:
+        self._check(
+            self._lib.onl_del_neighbor(self._h, ifindex, dest.encode()),
+            "del_neighbor",
+        )
 
     # -- addresses -------------------------------------------------------
 
@@ -398,7 +476,9 @@ class NetlinkSocket:
 
     def next_event(self):
         """Non-blocking event read → (kind, ifindex, up, name, addr,
-        prefixlen) or None."""
+        prefixlen, state, lladdr) or None. kind: 1=link 2=addr 4=neighbor
+        (for neighbors, addr carries the destination IP, up =
+        reachability)."""
         ev = _CEvent()
         rc = self._lib.onl_next_event(self._h, ctypes.byref(ev))
         self._check(rc, "next_event")
@@ -411,4 +491,6 @@ class NetlinkSocket:
             ev.name.decode(),
             ev.addr.decode(),
             ev.prefixlen,
+            ev.state,
+            ev.lladdr.decode(),
         )

@@ -19,7 +19,13 @@ import logging
 import time
 from typing import Callable, Dict, List, Optional
 
-from openr_tpu.nl import NetlinkError, NetlinkSocket, NlNextHop, NlRoute
+from openr_tpu.nl import (
+    Neighbor,
+    NetlinkError,
+    NetlinkSocket,
+    NlNextHop,
+    NlRoute,
+)
 from openr_tpu.nl.netlink import (
     MPLS_NONE,
     MPLS_PHP,
@@ -242,6 +248,11 @@ class NetlinkFibHandler(FibService):
             out.append(UnicastRoute(IpPrefix(r.dest), nexthops))
         return out
 
+    async def get_neighbors(self, family: int = 0) -> List[Neighbor]:
+        """Kernel neighbor (ARP/NDP) table, the SystemService-side dump the
+        reference exposes as getAllNeighbors."""
+        return await self._run(self._sock.get_neighbors, family)
+
     async def get_mpls_route_table_by_client(
         self, client_id: int
     ) -> List[MplsRoute]:
@@ -265,20 +276,24 @@ class NetlinkPublisher:
     """Kernel link/addr event pump (PlatformPublisher equivalent).
 
     Subscribes the native socket to rtnetlink multicast groups and invokes
-    `on_link(ifname, is_up)` / `on_addr(ifindex, addr, prefixlen, added)`
-    callbacks from the asyncio loop — LinkMonitor plugs its
-    update_interface here (the reference routes these through a ZMQ PUB
-    socket; in-process callbacks replace that hop).
+    `on_link(ifname, is_up)` / `on_addr(ifindex, addr, prefixlen, added)` /
+    `on_neighbor(ifindex, dest, lladdr, is_reachable)` callbacks from the
+    asyncio loop — LinkMonitor plugs its update_interface here (the
+    reference routes these through a ZMQ PUB socket; in-process callbacks
+    replace that hop; the neighbor feed mirrors
+    NetlinkProtocolSocket::setNeighborEventCB).
     """
 
     def __init__(
         self,
         on_link: Callable[[str, bool], None],
         on_addr: Optional[Callable[[int, str, int, bool], None]] = None,
+        on_neighbor: Optional[Callable[[int, str, str, bool], None]] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
     ) -> None:
         self.on_link = on_link
         self.on_addr = on_addr
+        self.on_neighbor = on_neighbor
         self._loop = loop
         self._sock = NetlinkSocket()
         self._fd: Optional[int] = None
@@ -304,8 +319,10 @@ class NetlinkPublisher:
                 return
             if ev is None:
                 return
-            kind, ifindex, up, name, addr, prefixlen = ev
+            kind, ifindex, up, name, addr, prefixlen, _state, lladdr = ev
             if kind == 1 and name:
                 self.on_link(name, up)
             elif kind == 2 and self.on_addr is not None:
                 self.on_addr(ifindex, addr, prefixlen, up)
+            elif kind == 4 and self.on_neighbor is not None:
+                self.on_neighbor(ifindex, addr, lladdr, up)

@@ -267,3 +267,20 @@ class RemoteFibService(FibService):
             )
             for r in rows
         ]
+
+    async def get_neighbors(self, family: int = 0):
+        """Kernel neighbor table via the agent (empty in dryrun mode)."""
+        from openr_tpu.nl import Neighbor
+
+        rows = await self._call("getNeighbors", family=family)
+        return [
+            Neighbor(
+                ifindex=r["ifindex"],
+                dest=r["dest"],
+                lladdr=r["lladdr"],
+                family=r["family"],
+                state=r["state"],
+                is_reachable=bool(r["is_reachable"]),
+            )
+            for r in rows
+        ]
