@@ -43,11 +43,18 @@ def time_marginal(run, reps_small: int, reps_big: int, rounds: int = 3) -> float
     positive per-round marginals is reported — taking the minimum would
     systematically favor rounds where link-sync jitter happened to inflate
     the short chain and deflate the long one.
+
+    When EVERY round's marginal is non-positive (sync jitter swamped the
+    chain-length delta), falls back to the best (minimum) whole-chain time
+    observed across all rounds divided by reps_big — the least
+    jitter-inflated sample available — and notes the degraded methodology
+    on stderr (the fixed dispatch latency is then NOT cancelled, so the
+    number overstates per-event cost).
     """
     run(reps_small)  # compile/warm
     run(reps_big)
     marginals = []
-    t_big = None
+    best_t_big = None
     for _ in range(rounds):
         t0 = time.time()
         run(reps_small)
@@ -55,11 +62,18 @@ def time_marginal(run, reps_small: int, reps_big: int, rounds: int = 3) -> float
         t0 = time.time()
         run(reps_big)
         t_big = time.time() - t0
+        if best_t_big is None or t_big < best_t_big:
+            best_t_big = t_big
         marginal = (t_big - t_small) / (reps_big - reps_small)
         if marginal > 0:  # noise guard: jitter can invert tiny pairs
             marginals.append(marginal)
     if not marginals:
-        return t_big / reps_big
+        note(
+            f"time_marginal: all {rounds} round marginals non-positive; "
+            f"degraded fallback = best whole-chain {best_t_big:.4f}s / "
+            f"{reps_big} reps (dispatch latency not cancelled)"
+        )
+        return best_t_big / reps_big
     return float(np.median(marginals))
 
 

@@ -490,6 +490,12 @@ class Decision(CountersMixin):
                 self.config.debounce_max, self._retry_rebuild
             )
             return
+        # surface the solver's SPF convergence counters (warm vs cold solve
+        # split, relaxation rounds of the last solve) through this module's
+        # registered counter dict so getCounters sees them
+        for key, value in self.solver.counters.items():
+            if key.startswith("decision.spf."):
+                self.counters[key] = value
         if new_db is None:
             return
         self._apply_rib_policy(new_db)

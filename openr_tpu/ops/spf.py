@@ -140,23 +140,33 @@ def _sell_solver_raw(key: Tuple):
 _UNROLL_MAX = 32
 
 
-def _sell_fixpoint_core(
-    sources, nbrs, wgs, overloaded, zero_end, starts, shapes
-):
-    """Shared fixpoint body for the plain and per-row-weights solvers.
+def _sell_d0_allow(sources, overloaded):
+    """Cold-start dest-major initial state [N, S] plus the per-source
+    transit mask (overloaded nodes relay nothing unless they are the
+    source itself)."""
+    (n,) = overloaded.shape
+    s = sources.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
+    d0 = d0.at[sources, jnp.arange(s)].set(0)
+    allow = (~overloaded)[:, None] | (node_ids[:, None] == sources[None, :])
+    return d0, allow
+
+
+def _sell_relax(d0, allow, nbrs, wgs, zero_end, starts, shapes):
+    """Min-plus relaxation from dest-major initial state d0 to the fixpoint.
+
+    Returns (d [N, S], rounds). Valid for ANY d0 that is an entrywise upper
+    bound of the true distances with the source diagonal pinned to 0: the
+    iteration map F(D) = min(D, relax(D)) is monotone, keeps D >= D*, and
+    its only fixed point with D[s, s] = 0 at or above D* is D* itself —
+    which is what makes warm-starting from a previous event's distances
+    sound (cold start D0 = INF is just the trivial upper bound).
 
     wgs leaves are [nk, dk] (shared across the batch) or [nk, dk, S]
     (per-batch-row weights, the penalized-re-solve form); broadcasting
     handles both in one implementation so the two paths cannot diverge."""
-    (n,) = overloaded.shape
-    s = sources.shape[0]
-    node_ids = jnp.arange(n, dtype=jnp.int32)
-
-    d0 = jnp.full((n, s), INF, dtype=jnp.int32)  # dest-major
-    d0 = d0.at[sources, jnp.arange(s)].set(0)
-    # transit allowed through u for source column j unless u is overloaded
-    # and u is not the source itself
-    allow = (~overloaded)[:, None] | (node_ids[:, None] == sources[None, :])
+    n = d0.shape[0]
 
     def body(state):
         d, _, it = state
@@ -204,7 +214,16 @@ def _sell_fixpoint_core(
         _, changed, it = state
         return changed & (it < n)
 
-    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    d, _, rounds = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    return d, rounds
+
+
+def _sell_fixpoint_core(
+    sources, nbrs, wgs, overloaded, zero_end, starts, shapes
+):
+    """Cold-start fixpoint (distances only), row-major [S, N]."""
+    d0, allow = _sell_d0_allow(sources, overloaded)
+    d, _ = _sell_relax(d0, allow, nbrs, wgs, zero_end, starts, shapes)
     return d.T
 
 
@@ -238,28 +257,58 @@ def _sell_solver(key: Tuple, mesh=None):
 
 
 @functools.lru_cache(maxsize=64)
+def _sell_solver_counted(key: Tuple, mesh=None):
+    """Like _sell_solver, but also returns the relaxation round count:
+    (D [S, N], rounds). The device-resident event path uses this for its
+    cold solves so `decision.spf.rounds_last` covers every solve, warm or
+    cold, and warm-start wins are observable as a round-count drop."""
+    zero_end, starts, shapes = key
+
+    def solve(sources, nbrs, wgs, overloaded):
+        d0, allow = _sell_d0_allow(sources, overloaded)
+        d, rounds = _sell_relax(d0, allow, nbrs, wgs, zero_end, starts, shapes)
+        return d.T, rounds
+
+    if mesh is None:
+        return jax.jit(solve)
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        solve,
+        in_shardings=(row, repl, repl, repl),
+        out_shardings=(out, repl),
+    )
+
+
+def _sell_apply_patches(wgs, patch_idx, patch_vals):
+    """Scatter the fixed-width per-bucket weight patches into the bucket
+    arrays; padding rows carry out-of-range indices and are dropped."""
+    return tuple(
+        wg_k.at[patch_idx[k, :, 0], patch_idx[k, :, 1]].set(
+            patch_vals[k], mode="drop"
+        )
+        for k, wg_k in enumerate(wgs)
+    )
+
+
+@functools.lru_cache(maxsize=64)
 def _sell_solver_patched(key: Tuple, mesh=None):
     """Patch-and-solve in one dispatch: applies per-bucket weight patches
     (idx [Pk, 2] of (row, slot), vals [Pk]; out-of-range rows dropped) to
-    the persistent wg buffers, solves, and returns (D, new_wgs) so the
-    caller can keep the patched buffers device-resident. One device
-    dispatch per LSDB event instead of scatter + solve — the host-side
-    share of a flap event is mostly dispatch latency."""
+    the persistent wg buffers, solves cold, and returns (D, new_wgs,
+    rounds) so the caller can keep the patched buffers device-resident.
+    One device dispatch per LSDB event instead of scatter + solve — the
+    host-side share of a flap event is mostly dispatch latency."""
     zero_end, starts, shapes = key
 
     def solve(sources, nbrs, wgs, overloaded, patch_idx, patch_vals):
         # patch_idx [B, P, 2] / patch_vals [B, P]: one upload each, sliced
         # per bucket at trace time (B is fixed by the shape key)
-        new_wgs = tuple(
-            wg_k.at[patch_idx[k, :, 0], patch_idx[k, :, 1]].set(
-                patch_vals[k], mode="drop"
-            )
-            for k, wg_k in enumerate(wgs)
+        new_wgs = _sell_apply_patches(wgs, patch_idx, patch_vals)
+        d0, allow = _sell_d0_allow(sources, overloaded)
+        d, rounds = _sell_relax(
+            d0, allow, nbrs, new_wgs, zero_end, starts, shapes
         )
-        d = _sell_fixpoint_core(
-            sources, nbrs, new_wgs, overloaded, zero_end, starts, shapes
-        )
-        return d, new_wgs
+        return d.T, new_wgs, rounds
 
     # donate the replaced weight buffers: the caller always overwrites its
     # handle with new_wgs, so XLA may update in place instead of allocating
@@ -271,7 +320,130 @@ def _sell_solver_patched(key: Tuple, mesh=None):
         solve,
         donate_argnums=(2,),
         in_shardings=(row, repl, repl, repl, repl, repl),
-        out_shardings=(out, repl),
+        out_shardings=(out, repl, repl),
+    )
+
+
+def _sell_invalidate(dp, nbrs, wgs, inc_idx, zero_end, starts, shapes):
+    """Ramalingam–Reps-style invalidation, vectorized on the sliced layout.
+
+    dp is the dest-major [N, S] OLD distance fixpoint and wgs the OLD
+    bucket weights. inc_idx [B, P, 2] names the (row, slot) positions whose
+    weight is about to increase (padding rows carry out-of-range indices).
+    Returns a bool [N, S] mask of entries whose old shortest-path witness
+    may traverse an increased edge: seed marks where an increased edge sits
+    on the old shortest-path DAG (triangle condition against the old
+    weights), then propagate marks down the old DAG with a boolean
+    fixpoint. Over-marking is safe (marked entries are recomputed from
+    INF); under-marking is impossible because every true DAG edge passes
+    the unmasked triangle test."""
+    n, s = dp.shape
+    marks = jnp.zeros((n, s), dtype=jnp.bool_)
+    for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
+        nk, dk = shapes[k]
+        rows = inc_idx[k, :, 0]
+        slots = inc_idx[k, :, 1]
+        valid = rows < (1 << 29)  # padding rows are 1 << 30
+        r = jnp.clip(rows, 0, nk - 1)
+        j = jnp.clip(slots, 0, dk - 1)
+        u = nbr_k[r, j]  # [P] in-neighbor of each increased edge
+        w_old = wg_k[r, j]  # [P]
+        v = starts[k] + r  # [P] global node row of each edge head
+        dv = dp[v]  # [P, S]
+        cond = (
+            valid[:, None]
+            & (dv < INF)
+            & (jnp.minimum(dp[u] + w_old[:, None], INF) == dv)
+        )
+        marks = marks.at[v].max(cond)
+
+    def body(state):
+        m, _, it = state
+        parts = [m[:zero_end]] if zero_end else []
+        end = zero_end
+        for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
+            nk, dk = shapes[k]
+            bs = starts[k]
+            dv = dp[bs : bs + nk]
+            reach = dv < INF
+            acc = m[bs : bs + nk]
+            if dk <= _UNROLL_MAX:
+                for j in range(dk):
+                    ids = nbr_k[:, j]
+                    wj = wg_k[:, j][:, None]
+                    on_dag = jnp.minimum(dp[ids] + wj, INF) == dv
+                    acc = acc | (m[ids] & on_dag & reach)
+            else:
+
+                def j_step(j, a, nbr_k=nbr_k, wg_k=wg_k, dv=dv, reach=reach):
+                    ids = jax.lax.dynamic_index_in_dim(
+                        nbr_k, j, axis=1, keepdims=False
+                    )
+                    wj = jax.lax.dynamic_index_in_dim(
+                        wg_k, j, axis=1, keepdims=False
+                    )[:, None]
+                    on_dag = jnp.minimum(dp[ids] + wj, INF) == dv
+                    return a | (m[ids] & on_dag & reach)
+
+                acc = jax.lax.fori_loop(0, dk, j_step, acc)
+            parts.append(acc)
+            end = bs + nk
+        if end < n:
+            parts.append(m[end:])
+        new_m = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+        return new_m, jnp.any(new_m != m), it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n)
+
+    # zero increased edges -> zero seed marks -> the loop is skipped whole,
+    # so decrease-only events pay nothing for sharing this executable
+    marks, _, _ = jax.lax.while_loop(cond, body, (marks, jnp.any(marks), 0))
+    return marks
+
+
+@functools.lru_cache(maxsize=64)
+def _sell_solver_warm(key: Tuple, mesh=None):
+    """Warm-start incremental patch-and-solve, one dispatch per LSDB event.
+
+    (sources, nbrs, wgs, overloaded, patch_idx, patch_vals, inc_idx,
+    d_prev) -> (D, new_wgs, rounds): invalidates the entries of d_prev
+    [S, N] whose old shortest path may witness an increased edge
+    (_sell_invalidate, against the OLD weights), applies the weight
+    patches, and relaxes from the repaired state instead of from INF —
+    rounds scale with the affected radius of the event, not the graph
+    diameter. Decrease-only events have an empty inc_idx and warm-start
+    directly. All patch shapes are fixed (_PATCH_SLOTS per bucket) so one
+    executable serves every event; d_prev and the weight buffers are
+    donated since the caller always replaces its handles."""
+    zero_end, starts, shapes = key
+
+    def solve(
+        sources, nbrs, wgs, overloaded, patch_idx, patch_vals, inc_idx, d_prev
+    ):
+        s = sources.shape[0]
+        dp = d_prev.T  # dest-major [N, S], like the relaxation state
+        marks = _sell_invalidate(
+            dp, nbrs, wgs, inc_idx, zero_end, starts, shapes
+        )
+        new_wgs = _sell_apply_patches(wgs, patch_idx, patch_vals)
+        d0 = jnp.where(marks, INF, dp)
+        d0 = d0.at[sources, jnp.arange(s)].set(0)  # re-pin marked sources
+        _, allow = _sell_d0_allow(sources, overloaded)
+        d, rounds = _sell_relax(
+            d0, allow, nbrs, new_wgs, zero_end, starts, shapes
+        )
+        return d.T, new_wgs, rounds
+
+    if mesh is None:
+        return jax.jit(solve, donate_argnums=(2, 7))
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        solve,
+        donate_argnums=(2, 7),
+        in_shardings=(row, repl, repl, repl, repl, repl, repl, out),
+        out_shardings=(out, repl, repl),
     )
 
 

@@ -7,6 +7,14 @@ results are consumed. The ECMP DAG extraction shards its edge axis across the
 'graph' mesh axis, all-gathering the (row-sharded) distance matrix it reads.
 This is the design the reference cannot express: its SPF is a single-threaded
 per-source Dijkstra (openr/decision/LinkState.cpp:806).
+
+The warm-start incremental event path (ops.spf._sell_solver_warm) rides the
+same scheme: the device-resident previous distance matrix is row-sharded
+P('batch', None) exactly like the solver output it came from, the
+invalidation boolean fixpoint runs on the same dest-major layout as the
+relaxation rounds (source axis minor, sharded), and the fixed-shape patch /
+increased-edge index arrays are replicated — so a meshed link-flap event is
+still a single collective-free dispatch per chip until D is consumed.
 """
 
 from __future__ import annotations

@@ -132,10 +132,19 @@ class _AreaSolve:
     compiled arrays via the LinkState changelog (weight-only changes keep
     shapes and jit executables) and re-runs the device solve. The source
     batch is bucket-padded so a changed neighbor count stays in the same
-    executable too."""
+    executable too.
+
+    The distance matrix stays DEVICE-RESIDENT between events: host readers
+    go through the lazy `d` mirror, and a weight-patch event feeds the
+    previous fixpoint back in as the warm initial state (decrease-only
+    events directly; events with weight increases first invalidate the
+    entries whose old shortest path witnesses a changed edge — see
+    ops.spf._sell_solver_warm). A cold solve is forced by a structural
+    rebuild, a source-batch change, an overload-mask change, or a
+    _PATCH_SLOTS overflow."""
 
     def __init__(
-        self, link_state: LinkState, me: str, mesh=None
+        self, link_state: LinkState, me: str, mesh=None, warm_start: bool = True
     ) -> None:
         self.link_state = link_state
         self.me = me
@@ -143,15 +152,36 @@ class _AreaSolve:
         # over the mesh 'batch' axis and the persistent layout buffers are
         # replicated across devices — same executables, multi-chip spread
         self.mesh = mesh
+        self.warm_start = warm_start
         self.graph: CompiledGraph = compile_graph(link_state)
         self.device_solves = 0
         self.ksp_device_batches = 0
+        # convergence observability (decision.spf.* counters)
+        self.incremental_solves = 0  # warm-started weight-patch solves
+        self.full_solves = 0  # cold solves (from D0 = INF)
+        self.rounds_last: Optional[int] = None  # relax rounds of last solve
         # persistent device buffers (SURVEY.md §7: the <100ms convergence
         # budget leaves no room to re-upload the LSDB per event): sell
         # nbr/wg/overloaded live on device across events; weight patches
         # upload only the changed slots
         self._dev: Optional[dict] = None
+        # device-resident distance matrix [s_pad, n_pad] + lazy host mirror
+        self._d_dev = None
+        self._d_host: Optional[np.ndarray] = None
         self._solve()
+
+    @property
+    def d(self) -> np.ndarray:
+        """Host mirror of the device-resident distance matrix, fetched on
+        first access after each solve — chained events that are never read
+        host-side (or only read late) skip the [S, n_pad] copy-back.
+
+        An OWNED copy, not np.asarray: on the CPU backend asarray can be a
+        zero-copy view of the device buffer, and the warm solver donates
+        that buffer to the next event — a view would alias reused memory."""
+        if self._d_host is None:
+            self._d_host = np.array(self._d_dev)
+        return self._d_host
 
     def _batch_pad(self, n: int, minimum: int = 8) -> int:
         """Source-batch pad: power-of-two bucket, rounded up to a multiple
@@ -195,17 +225,21 @@ class _AreaSolve:
         rows = np.concatenate(
             [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
         )
-        # one device call for the whole batch; copy back once
+        # one device call for the whole batch; results stay device-resident
+        # (the host mirror is fetched lazily through the `d` property)
         if self.graph.sell is not None:
-            self.d = np.asarray(self._sell_solve_resident(rows))
+            self._d_dev, self.rounds_last = self._sell_solve_resident(rows)
         elif self.mesh is not None:
             from openr_tpu.parallel import sharded_batched_spf
 
-            self.d = np.asarray(
-                sharded_batched_spf(self.graph, rows, self.mesh)
-            )
+            self._d_dev = sharded_batched_spf(self.graph, rows, self.mesh)
+            self.rounds_last = None  # edge-list form: rounds untracked
+            self.full_solves += 1
         else:
-            self.d = np.asarray(batched_spf(self.graph, rows))
+            self._d_dev = batched_spf(self.graph, rows)
+            self.rounds_last = None
+            self.full_solves += 1
+        self._d_host = None
         self.device_solves += 1
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
         # reset with the snapshot, so topology changes invalidate it for free
@@ -214,17 +248,25 @@ class _AreaSolve:
         self._nh_mask: Optional[np.ndarray] = None
 
     def _sell_solve_resident(self, rows: np.ndarray):
-        """Sliced-ELL solve against persistent device buffers.
+        """Sliced-ELL solve against persistent device buffers; returns
+        (device distance matrix [s_pad, n_pad], relaxation rounds).
 
         The first call (or any structural rebuild, detected by src array
         identity) uploads the full layout; subsequent events diff the host
         weight/overload arrays against the device snapshot and upload only
         the changed slots (`.at[].set` with tiny index arrays) — a link
         flap moves a handful of ints over the host-device link instead of
-        the whole LSDB."""
+        the whole LSDB. When the event is a pure weight patch (same source
+        batch, same overload mask, fits _PATCH_SLOTS), the previous
+        device-resident distances warm-start the fixpoint instead of
+        re-relaxing from INF."""
         import jax.numpy as jnp
 
-        from openr_tpu.ops.spf import _sell_solver, _sell_solver_patched
+        from openr_tpu.ops.spf import (
+            _sell_solver_counted,
+            _sell_solver_patched,
+            _sell_solver_warm,
+        )
 
         g = self.graph
         sell = g.sell
@@ -238,11 +280,18 @@ class _AreaSolve:
                 "w_host": g.w.copy(),
                 "w_ver": g.version,
                 "ov_host": g.overloaded.copy(),
+                "rows": np.array(rows),
             }
         else:
-            if not np.array_equal(st["ov_host"], g.overloaded):
+            ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
+            if ov_changed:
                 st["ov"] = self._replicated(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
+            # warm start needs the previous fixpoint to describe the same
+            # problem modulo edge weights: identical source batch (a flap
+            # adjacent to me changes the rows) and identical transit mask
+            rows_same = np.array_equal(st["rows"], rows)
+            st["rows"] = np.array(rows)
             if (
                 g.changed_edges is not None
                 and g.parent_version == st.get("w_ver")
@@ -255,6 +304,9 @@ class _AreaSolve:
                 changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
             st["w_ver"] = g.version  # snapshot is current even if no diff
             if len(changed):
+                # classify vs the weights that produced the resident D —
+                # increases invalidate, decreases warm-start as-is
+                increased = changed[g.w[changed] > st["w_host"][changed]]
                 st["w_host"][changed] = g.w[changed]
                 # fused patch+solve: one dispatch carries the changed slots
                 # and returns the distances plus the patched buffers, which
@@ -264,12 +316,12 @@ class _AreaSolve:
                 # fixpoint per new event size. Oversized events (SRLG-style
                 # bulk changes) fall back to standalone scatters + plain
                 # solve, whose small ops are cheap to compile per shape.
+                nb = len(sell.nbr)
                 per_bucket = [
                     changed[sell.edge_bucket[changed] == k]
-                    for k in range(len(sell.nbr))
+                    for k in range(nb)
                 ]
                 if all(len(s_) <= _PATCH_SLOTS for s_ in per_bucket):
-                    nb = len(sell.nbr)
                     idx = np.full(
                         (nb, _PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
                     )
@@ -279,8 +331,7 @@ class _AreaSolve:
                             idx[k, : len(sel), 0] = sell.edge_row[sel]
                             idx[k, : len(sel), 1] = sell.edge_slot[sel]
                             vals[k, : len(sel)] = g.w[sel]
-                    fn = _sell_solver_patched(sell.shape_key(), self.mesh)
-                    d, new_wgs = fn(
+                    args = (
                         jnp.asarray(rows, dtype=jnp.int32),
                         st["nbrs"],
                         st["wgs"],
@@ -288,8 +339,32 @@ class _AreaSolve:
                         jnp.asarray(idx),
                         jnp.asarray(vals),
                     )
+                    if (
+                        self.warm_start
+                        and rows_same
+                        and not ov_changed
+                        and self._d_dev is not None
+                    ):
+                        inc_idx = np.full(
+                            (nb, _PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
+                        )
+                        for k in range(nb):
+                            sel = increased[sell.edge_bucket[increased] == k]
+                            if len(sel):
+                                inc_idx[k, : len(sel), 0] = sell.edge_row[sel]
+                                inc_idx[k, : len(sel), 1] = sell.edge_slot[sel]
+                        fn = _sell_solver_warm(sell.shape_key(), self.mesh)
+                        d, new_wgs, rounds = fn(
+                            *args, jnp.asarray(inc_idx), self._d_dev
+                        )
+                        st["wgs"] = new_wgs
+                        self.incremental_solves += 1
+                        return d, int(rounds)
+                    fn = _sell_solver_patched(sell.shape_key(), self.mesh)
+                    d, new_wgs, rounds = fn(*args)
                     st["wgs"] = new_wgs
-                    return d
+                    self.full_solves += 1
+                    return d, int(rounds)
                 wgs = list(st["wgs"])
                 for k, sel in enumerate(per_bucket):
                     if len(sel):
@@ -300,13 +375,15 @@ class _AreaSolve:
                         )
                 st["wgs"] = tuple(wgs)
 
-        fn = _sell_solver(sell.shape_key(), self.mesh)
-        return fn(
+        fn = _sell_solver_counted(sell.shape_key(), self.mesh)
+        d, rounds = fn(
             jnp.asarray(rows, dtype=jnp.int32),
             st["nbrs"],
             st["wgs"],
             st["ov"],
         )
+        self.full_solves += 1
+        return d, int(rounds)
 
     def nh_mask(self) -> Tuple[List[str], np.ndarray]:
         """(neighbor names, [L, n_pad] bool): entry [i, t] is True iff the
@@ -530,7 +607,7 @@ class TpuSpfSolver(SpfSolver):
     meshed solver passes the same parity suite as the single-device one.
     """
 
-    def __init__(self, *args, mesh=None, **kwargs) -> None:
+    def __init__(self, *args, mesh=None, warm_start: bool = True, **kwargs) -> None:
         super().__init__(*args, **kwargs)
         # (area name, node) -> (LinkState identity, solve); keyed by the
         # stable area name so a replaced LinkState object for the same area
@@ -538,6 +615,7 @@ class TpuSpfSolver(SpfSolver):
         # tracking lives in _AreaSolve.refresh()
         self._solves: Dict[Tuple[str, str], Tuple[int, _AreaSolve]] = {}
         self.device_solves = 0  # counter: batched device calls
+        self.warm_start = warm_start
         # resolved EAGERLY: a solver_mesh that doesn't fit the device set
         # must fail at daemon startup with a clear error, not inside the
         # first debounced rebuild callback mid-convergence
@@ -561,13 +639,36 @@ class TpuSpfSolver(SpfSolver):
         if cached is not None and cached[0] == id(link_state):
             solve = cached[1]
             before = solve.device_solves
+            inc0, full0 = solve.incremental_solves, solve.full_solves
             solve.refresh()  # incremental: patch arrays + one device call
             self.device_solves += solve.device_solves - before
+            self._sync_spf_counters(solve, inc0, full0)
             return solve
-        solve = _AreaSolve(link_state, node, mesh=self.mesh)
+        solve = _AreaSolve(
+            link_state, node, mesh=self.mesh, warm_start=self.warm_start
+        )
         self.device_solves += solve.device_solves
+        self._sync_spf_counters(solve, 0, 0)
         self._solves[key] = (id(link_state), solve)
         return solve
+
+    def _sync_spf_counters(
+        self, solve: _AreaSolve, inc0: int, full0: int
+    ) -> None:
+        """Fold an _AreaSolve's convergence stats into the decision.spf.*
+        counters (merged into Decision's counter dict for the monitor/ctrl
+        API): incremental vs full solves are monotonic, rounds_last is the
+        relaxation-round gauge of the most recent solve."""
+        d_inc = solve.incremental_solves - inc0
+        d_full = solve.full_solves - full0
+        if d_inc:
+            self._bump("decision.spf.incremental_solves", d_inc)
+        if d_full:
+            self._bump("decision.spf.full_solves", d_full)
+        if solve.rounds_last is not None:
+            self._ensure_counters()["decision.spf.rounds_last"] = (
+                solve.rounds_last
+            )
 
     # -- SPF access seam -------------------------------------------------
 

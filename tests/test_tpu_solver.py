@@ -324,6 +324,249 @@ class TestIncrementalRefresh:
         assert solve.graph.version == ls.version
 
 
+def apply_random_event(rng, dbs, ls, links):
+    """One randomized weight-only event: link flap (down/up via adjacency
+    overload), metric change, or node-overload toggle. Mutates dbs and ls;
+    returns the event kind."""
+    import dataclasses
+
+    kind = rng.choice(("flap", "metric", "node_overload"))
+    if kind in ("flap", "metric"):
+        a, b, _ = links[rng.randrange(len(links))]
+        db = dbs[a]
+        new_adjs = []
+        for adj in db.adjacencies:
+            if adj.other_node_name == b:
+                if kind == "flap":
+                    adj = dataclasses.replace(
+                        adj, is_overloaded=not adj.is_overloaded
+                    )
+                else:
+                    adj = dataclasses.replace(adj, metric=rng.randint(1, 9))
+            new_adjs.append(adj)
+        db = dataclasses.replace(db, adjacencies=new_adjs)
+        dbs[a] = db
+        ls.update_adjacency_database(db)
+    else:
+        import dataclasses as dc
+
+        node = sorted(dbs)[rng.randrange(len(dbs))]
+        db = dc.replace(dbs[node], is_overloaded=not dbs[node].is_overloaded)
+        dbs[node] = db
+        ls.update_adjacency_database(db)
+    return kind
+
+
+def assert_solve_matches_oracle(ls, solve):
+    """Every solved source row must equal the CPU Dijkstra oracle."""
+    d = solve.d
+    graph = solve.graph
+    for name, row in solve.row_map.items():
+        oracle = ls.get_spf_result(name)
+        for dst in graph.names:
+            col = graph.node_index[dst]
+            got = int(d[row, col])
+            if dst in oracle:
+                assert got == oracle[dst].metric, (name, dst)
+            else:
+                assert got >= INF, (name, dst)
+
+
+def run_warm_differential(edges, me, seed, n_events, mesh=None):
+    """Randomized event sequence: after every event the warm-started
+    incremental solve must be bit-identical to a from-scratch cold solve
+    AND to the CPU oracle. Returns the warm _AreaSolve for counter
+    assertions."""
+    from openr_tpu.solver.tpu import _AreaSolve
+
+    rng = random.Random(seed)
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    warm = _AreaSolve(ls, me, mesh=mesh)
+    links = list(edges)
+    applied = 0
+    for _ in range(n_events):
+        before = ls.version
+        apply_random_event(rng, dbs, ls, links)
+        if ls.version == before:
+            continue  # event was a topology no-op
+        warm.refresh()
+        cold = _AreaSolve(ls, me, mesh=mesh)  # cold solve of the same state
+        np.testing.assert_array_equal(warm.d, cold.d)
+        assert_solve_matches_oracle(ls, warm)
+        applied += 1
+    assert applied > 0
+    return warm
+
+
+class TestWarmStartDifferential:
+    """The warm-start incremental event path (device-resident previous
+    distances + on-device invalidation of increased entries) must be
+    bit-identical to recompute-from-INF on arbitrary event sequences."""
+
+    def test_grid_random_sequences(self):
+        for seed in (3, 11):
+            warm = run_warm_differential(grid_edges(4), "g0_0", seed, 14)
+            assert warm.incremental_solves > 0
+
+    def test_clos_random_sequence(self):
+        edges = fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        warm = run_warm_differential(edges, "rsw0_0", 7, 12)
+        assert warm.incremental_solves > 0
+
+    def test_increase_then_decrease_same_link(self):
+        import dataclasses
+
+        from openr_tpu.solver.tpu import _AreaSolve
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("a", "d", 9)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        warm = _AreaSolve(ls, "a")
+        cold_rounds = warm.rounds_last
+        for metric in (8, 1):  # increase (invalidation pass), then decrease
+            db = dbs["b"]
+            db = dataclasses.replace(
+                db,
+                adjacencies=[
+                    dataclasses.replace(adj, metric=metric)
+                    if adj.other_node_name == "c"
+                    else adj
+                    for adj in db.adjacencies
+                ],
+            )
+            dbs["b"] = db
+            ls.update_adjacency_database(db)
+            warm.refresh()
+            cold = _AreaSolve(ls, "a")
+            np.testing.assert_array_equal(warm.d, cold.d)
+            assert_solve_matches_oracle(ls, warm)
+            assert warm.rounds_last <= cold.rounds_last
+        assert warm.incremental_solves == 2
+        assert warm.rounds_last < cold_rounds  # warm win visible in counter
+
+    def test_partition_flap_and_heal(self):
+        import dataclasses
+
+        from openr_tpu.solver.tpu import _AreaSolve
+
+        # two triangles joined by one bridge: flapping it partitions
+        edges = [
+            ("a", "b", 1), ("b", "c", 1), ("c", "a", 1),
+            ("c", "x", 2),  # bridge
+            ("x", "y", 1), ("y", "z", 1), ("z", "x", 1),
+        ]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        warm = _AreaSolve(ls, "a")
+        for down in (True, False):
+            db = dbs["c"]
+            db = dataclasses.replace(
+                db,
+                adjacencies=[
+                    dataclasses.replace(adj, is_overloaded=down)
+                    if adj.other_node_name == "x"
+                    else adj
+                    for adj in db.adjacencies
+                ],
+            )
+            dbs["c"] = db
+            ls.update_adjacency_database(db)
+            warm.refresh()
+            cold = _AreaSolve(ls, "a")
+            np.testing.assert_array_equal(warm.d, cold.d)
+            assert_solve_matches_oracle(ls, warm)
+            far = int(warm.d[0, warm.graph.node_index["z"]])
+            assert (far >= INF) == down
+        assert warm.incremental_solves == 2
+
+    def test_node_overload_toggle_forces_cold(self):
+        import dataclasses
+
+        from openr_tpu.solver.tpu import _AreaSolve
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        warm = _AreaSolve(ls, "a")
+        full_before = warm.full_solves
+        for overloaded in (True, False):
+            db = dataclasses.replace(dbs["b"], is_overloaded=overloaded)
+            dbs["b"] = db
+            ls.update_adjacency_database(db)
+            warm.refresh()
+            cold = _AreaSolve(ls, "a")
+            np.testing.assert_array_equal(warm.d, cold.d)
+            assert_solve_matches_oracle(ls, warm)
+        # a changed transit mask invalidates the resident D wholesale:
+        # both events must re-solve cold, never warm-start
+        assert warm.incremental_solves == 0
+        assert warm.full_solves == full_before + 2
+
+    def test_oversized_event_falls_back_to_cold(self, monkeypatch):
+        import dataclasses
+
+        import openr_tpu.solver.tpu as tpu_mod
+
+        # any non-empty patch overflows a zero-slot budget
+        monkeypatch.setattr(tpu_mod, "_PATCH_SLOTS", 0)
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("a", "d", 9)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        warm = tpu_mod._AreaSolve(ls, "a")
+        full_before = warm.full_solves
+        db = dbs["b"]
+        db = dataclasses.replace(
+            db,
+            adjacencies=[
+                dataclasses.replace(adj, metric=4) for adj in db.adjacencies
+            ],
+        )
+        dbs["b"] = db
+        ls.update_adjacency_database(db)
+        warm.refresh()
+        assert warm.incremental_solves == 0
+        assert warm.full_solves == full_before + 1
+        cold = tpu_mod._AreaSolve(ls, "a")
+        np.testing.assert_array_equal(warm.d, cold.d)
+        assert_solve_matches_oracle(ls, warm)
+
+    def test_solver_exposes_spf_counters(self):
+        import dataclasses
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"c": [PFXS[0]]})
+        tpu = TpuSpfSolver("a")
+        tpu.build_route_db("a", {"0": ls}, ps)
+        assert tpu.counters["decision.spf.full_solves"] == 1
+        assert tpu.counters["decision.spf.rounds_last"] >= 1
+        cold_rounds = tpu.counters["decision.spf.rounds_last"]
+        # weight-only event rides the warm path and the counters show it
+        db = dbs["b"]
+        db = dataclasses.replace(
+            db,
+            adjacencies=[
+                dataclasses.replace(adj, metric=3)
+                if adj.other_node_name == "c"
+                else adj
+                for adj in db.adjacencies
+            ],
+        )
+        dbs["b"] = db
+        ls.update_adjacency_database(db)
+        db2 = tpu.build_route_db("a", {"0": ls}, ps)
+        assert db2 is not None
+        assert tpu.counters["decision.spf.incremental_solves"] == 1
+        assert tpu.counters["decision.spf.full_solves"] == 1
+        assert tpu.counters["decision.spf.rounds_last"] <= cold_rounds
+
+
 def all_pairs_distance_check_graph(ls, graph):
     """all_pairs_distance_check against a pre-built CompiledGraph."""
     d = np.asarray(batched_spf(graph, np.arange(graph.n_pad, dtype=np.int32)))
