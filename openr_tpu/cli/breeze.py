@@ -13,7 +13,7 @@ same command vocabulary:
             set-link-overload|unset-link-overload|
             set-link-metric|unset-link-metric
   breeze prefixmgr view|advertise|withdraw|sync
-  breeze monitor counters|logs
+  breeze monitor counters|histograms|logs
   breeze openr version|config
   breeze perf view                   (fib perf event database — 'breeze perf')
   breeze config show|dryrun          (running config / validate candidate)
@@ -399,6 +399,27 @@ def cmd_prefixmgr(client: BlockingCtrlClient, args) -> None:
 def cmd_monitor(client: BlockingCtrlClient, args) -> None:
     if args.cmd == "counters":
         _print_json(client.call("getCounters"))
+    elif args.cmd == "histograms":
+        hists = client.call("getHistograms")
+
+        def ms(v: float) -> str:
+            return f"{v:.3f}"
+
+        rows = [
+            [
+                name,
+                h["count"],
+                ms(h["avg"]),
+                ms(h["p50"]),
+                ms(h["p95"]),
+                ms(h["p99"]),
+                ms(h["max"]),
+            ]
+            for name, h in sorted(hists.items())
+        ]
+        _print_table(
+            ["Histogram", "Count", "Avg", "p50", "p95", "p99", "Max"], rows
+        )
     elif args.cmd == "logs":
         for log_json in client.call("getEventLogs"):
             print(log_json)
@@ -484,6 +505,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     mon = sub.add_parser("monitor").add_subparsers(dest="cmd", required=True)
     mon.add_parser("counters")
+    mon.add_parser("histograms")
     mon.add_parser("logs")
 
     op = sub.add_parser("openr").add_subparsers(dest="cmd", required=True)

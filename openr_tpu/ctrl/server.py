@@ -260,6 +260,21 @@ class CtrlServer:
                 counters.update(module.counters)
         return counters
 
+    def m_getHistograms(self, params) -> Dict[str, Any]:
+        """Merged latency histograms of every registered module
+        (count/sum/avg/min/max + p50/p95/p99 per name) — the fb303
+        exported-histogram surface next to getCounters."""
+        if self.monitor is not None:
+            return self.monitor.get_histograms()
+        from openr_tpu.monitor import merge_module_histograms
+
+        merged = merge_module_histograms(
+            m
+            for m in (self.decision, self.fib, self.link_monitor)
+            if m is not None
+        )
+        return {name: h.to_dict() for name, h in sorted(merged.items())}
+
     def m_getEventLogs(self, params) -> List[str]:
         if self.monitor is None:
             return []

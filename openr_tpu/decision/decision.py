@@ -21,11 +21,13 @@ Behavioral port of openr/decision/Decision.{h,cpp} module shell:
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from openr_tpu.lsdb import LinkState, PrefixState
 from openr_tpu.messaging import QueueClosedError, RQueue, ReplicateQueue
+from openr_tpu.monitor.spans import Span
 from openr_tpu.solver import (
     DecisionRouteDb,
     DecisionRouteUpdate,
@@ -44,7 +46,7 @@ from openr_tpu.types import (
     parse_prefix_key,
 )
 from openr_tpu.utils import AsyncDebounce
-from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 from openr_tpu.utils import serializer
 
 import dataclasses
@@ -96,8 +98,22 @@ class _PendingUpdates:
         self.count = 0
         self.perf_events: Optional[PerfEvents] = None
         self.needs_route_update = False
+        self.span: Optional[Span] = None
 
-    def apply(self, perf_events: Optional[PerfEvents]) -> None:
+    def apply(
+        self,
+        perf_events: Optional[PerfEvents],
+        pub_ts: Optional[float] = None,
+    ) -> None:
+        if self.count == 0:
+            # the batch's oldest event is the one convergence is measured
+            # from: stamp it on the MONOTONIC clock (seeded from the local
+            # KvStore publication stamp when one rode along) so
+            # convergence.e2e_ms is immune to wall-clock jumps — the
+            # PerfEvents trace below stays wall-clock for cross-node
+            # reporting, the span owns all local latency math
+            self.span = Span("convergence", t0=pub_ts)
+            self.span.mark("decision.recv")
         self.count += 1
         self.needs_route_update = True
         # keep the OLDEST event trace in the batch (Decision.h:174-191)
@@ -116,9 +132,10 @@ class _PendingUpdates:
         self.count = 0
         self.perf_events = None
         self.needs_route_update = False
+        self.span = None
 
 
-class Decision(CountersMixin):
+class Decision(CountersMixin, HistogramsMixin):
     def __init__(
         self,
         config: DecisionConfig,
@@ -171,6 +188,7 @@ class Decision(CountersMixin):
         self._rib_policy_timer: Optional[asyncio.TimerHandle] = None
         self._task: Optional[asyncio.Task] = None
         self.counters: Dict[str, int] = {}
+        self.histograms: Dict = {}
         self.have_computed_routes = False
 
     # ------------------------------------------------------------------
@@ -258,6 +276,7 @@ class Decision(CountersMixin):
             self.area_link_states[area] = link_state
 
         changed = False
+        pub_ts = publication.ts_monotonic
         bulk_keys = self._bulk_adj_keys(publication, link_state)
         if bulk_keys:
             changed |= self._bulk_ingest_adj(
@@ -267,7 +286,9 @@ class Decision(CountersMixin):
             if value.value is None or key in bulk_keys:
                 continue  # ttl refresh only / already bulk-ingested
             try:
-                changed |= self._process_key(key, value, area, link_state)
+                changed |= self._process_key(
+                    key, value, area, link_state, pub_ts
+                )
             except Exception:
                 # a malformed value must not poison the rest of the batch
                 # (Decision.cpp:1726-1729 catches per-key)
@@ -283,7 +304,7 @@ class Decision(CountersMixin):
                 node = key[len(ADJ_DB_MARKER):]
                 if link_state.delete_adjacency_database(node).topology_changed:
                     changed = True
-                    self._pending.apply(None)
+                    self._pending.apply(None, pub_ts)
             elif key.startswith(PREFIX_DB_MARKER):
                 node, _, _ = parse_prefix_key(key)
                 delete_db = PrefixDatabase(
@@ -297,7 +318,7 @@ class Decision(CountersMixin):
                 node_db.area = area
                 if self.prefix_state.update_prefix_database(node_db):
                     changed = True
-                    self._pending.apply(None)
+                    self._pending.apply(None, pub_ts)
 
         if changed:
             self._schedule_rebuild()
@@ -352,12 +373,18 @@ class Decision(CountersMixin):
             or change.node_label_changed
         ):
             return False
+        pub_ts = publication.ts_monotonic
         for db in adj_dbs:
-            self._pending.apply(db.perf_events)
+            self._pending.apply(db.perf_events, pub_ts)
         return True
 
     def _process_key(
-        self, key: str, value, area: str, link_state: LinkState
+        self,
+        key: str,
+        value,
+        area: str,
+        link_state: LinkState,
+        pub_ts: Optional[float] = None,
     ) -> bool:
         """Apply one LSDB key; returns True if state changed."""
         changed = False
@@ -385,7 +412,7 @@ class Decision(CountersMixin):
                 or change.node_label_changed
             ):
                 changed = True
-                self._pending.apply(adj_db.perf_events)
+                self._pending.apply(adj_db.perf_events, pub_ts)
         elif key.startswith(PREFIX_DB_MARKER):
             # cached decode: prefix dbs are never mutated by this module
             # (aggregation builds fresh node_db objects)
@@ -398,7 +425,7 @@ class Decision(CountersMixin):
             self._bump("decision.prefix_db_update")
             if self.prefix_state.update_prefix_database(node_db):
                 changed = True
-                self._pending.apply(prefix_db.perf_events)
+                self._pending.apply(prefix_db.perf_events, pub_ts)
         return changed
 
     def _update_node_prefix_database(
@@ -461,10 +488,15 @@ class Decision(CountersMixin):
         if not self._pending.needs_route_update:
             return
         perf_events = self._pending.perf_events
+        span = self._pending.span
         self._bump("decision.batched_updates", self._pending.count)
         self._pending.reset()
         self._bump("decision.route_build_runs")
+        if span is not None:
+            # oldest-event recv -> debounce fire, on the monotonic clock
+            self._observe("decision.debounce_ms", span.mark("decision.debounce"))
 
+        t0 = time.perf_counter()
         try:
             new_db = self.solver.build_route_db(
                 self.config.my_node_name,
@@ -490,12 +522,23 @@ class Decision(CountersMixin):
                 self.config.debounce_max, self._retry_rebuild
             )
             return
+        self._observe(
+            "decision.route_build_ms", (time.perf_counter() - t0) * 1e3
+        )
+        if span is not None:
+            span.mark("decision.route_build")
         # surface the solver's SPF convergence counters (warm vs cold solve
-        # split, relaxation rounds of the last solve) through this module's
-        # registered counter dict so getCounters sees them
+        # split, relaxation + invalidation rounds of the last solve) and
+        # profiling histograms (solve latency, warm/cold split) through this
+        # module's registered dicts so getCounters/getHistograms see them;
+        # histogram objects are shared by reference — the solver keeps
+        # recording into them, the monitor merges copies on export
         for key, value in self.solver.counters.items():
             if key.startswith("decision.spf."):
                 self.counters[key] = value
+        for key, hist in self.solver._ensure_histograms().items():
+            if key.startswith("decision.spf."):
+                self._ensure_histograms()[key] = hist
         if new_db is None:
             return
         self._apply_rib_policy(new_db)
@@ -504,6 +547,7 @@ class Decision(CountersMixin):
         self.have_computed_routes = True
         if not delta.empty():
             delta.perf_events = perf_events
+            delta.span = span
             self.route_updates_queue.push(delta)
             self._bump("decision.route_updates_published")
 

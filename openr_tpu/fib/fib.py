@@ -45,7 +45,7 @@ from openr_tpu.types import (
     UnicastRoute,
 )
 from openr_tpu.utils import ExponentialBackoff
-from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 
 log = logging.getLogger(__name__)
 
@@ -149,7 +149,7 @@ class _RouteState:
     dirty_route_db: bool = False
 
 
-class Fib(CountersMixin):
+class Fib(CountersMixin, HistogramsMixin):
     def __init__(
         self,
         config: FibConfig,
@@ -157,6 +157,7 @@ class Fib(CountersMixin):
         route_updates: RQueue,
         interface_updates: Optional[RQueue] = None,
         kvstore_client=None,
+        log_sample_fn=None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
     ) -> None:
         self.config = config
@@ -164,6 +165,9 @@ class Fib(CountersMixin):
         self.route_updates = route_updates
         self.interface_updates = interface_updates
         self.kvstore_client = kvstore_client
+        # sink for finished convergence spans (monitor log-sample queue's
+        # push in the daemon; None drops the CONVERGENCE_TRACE samples)
+        self._log_sample_fn = log_sample_fn
         self._loop = loop
 
         self.route_state = _RouteState()
@@ -181,6 +185,7 @@ class Fib(CountersMixin):
         self._sync_handle: Optional[asyncio.TimerHandle] = None
         self._tasks: List[asyncio.Task] = []
         self.counters: Dict[str, int] = {}
+        self.histograms: Dict = {}
 
     def loop(self) -> asyncio.AbstractEventLoop:
         return self._loop or asyncio.get_event_loop()
@@ -247,6 +252,9 @@ class Fib(CountersMixin):
         perf_events = delta.perf_events
         if isinstance(perf_events, PerfEvents):
             perf_events.add(self.config.my_node_name, "FIB_ROUTE_DB_RECVD")
+        span = getattr(delta, "span", None)
+        if span is not None:
+            span.mark("fib.recv")
 
         unicast_to_update: List[UnicastRoute] = []
         for entry in delta.unicast_routes_to_update:
@@ -276,6 +284,7 @@ class Fib(CountersMixin):
             mpls_to_update,
             list(delta.mpls_routes_to_delete),
             perf_events,
+            span=span,
         )
 
     async def process_interface_db(self, if_db: InterfaceDatabase) -> None:
@@ -350,10 +359,12 @@ class Fib(CountersMixin):
         mpls_to_update: List[MplsRoute],
         mpls_to_delete: List[int],
         perf_events: Optional[PerfEvents],
+        span=None,
     ) -> None:
         """Incremental delta programming (Fib.cpp:498-610)."""
         async with self._program_lock:
             self.update_global_counters()
+            t0 = time.perf_counter()
             # best-nexthop (min-metric) groups actually get programmed
             unicast_best = [
                 UnicastRoute(
@@ -370,6 +381,7 @@ class Fib(CountersMixin):
 
             if self.config.dryrun:
                 self.log_perf_events(perf_events)
+                self._finish_span(span, t0)
                 return
             if self._sync_scheduled:
                 return  # pending full sync subsumes this delta
@@ -402,6 +414,7 @@ class Fib(CountersMixin):
                 self._bump("fib.num_of_route_updates", n)
                 self.route_state.dirty_route_db = False
                 self.log_perf_events(perf_events)
+                self._finish_span(span, t0)
             except Exception:
                 self._bump("fib.thrift.failure.add_del_route")
                 self.route_state.dirty_route_db = True
@@ -541,6 +554,23 @@ class Fib(CountersMixin):
         )
         counters["fib.num_dirty_labels"] = len(self.route_state.dirty_labels)
         counters["fib.synced"] = 0 if self._sync_scheduled else 1
+
+    def _finish_span(self, span, t0: float) -> None:
+        """Close one convergence span after routes are programmed (or
+        dryrun-accepted): programming latency and end-to-end
+        publication→programmed latency land in this module's histograms,
+        and the finished stage trace goes out as one CONVERGENCE_TRACE
+        LogSample through the monitor queue. All math runs on the
+        monotonic clock (Span/perf_counter) — wall-clock steps never skew
+        these, unlike the PerfEvents-derived fib.convergence_time_ms."""
+        self._observe("fib.program_ms", (time.perf_counter() - t0) * 1e3)
+        if span is None:
+            return
+        span.mark("fib.program")
+        self._observe("convergence.e2e_ms", span.elapsed_ms())
+        self._bump("fib.convergence_spans")
+        if self._log_sample_fn is not None:
+            self._log_sample_fn(span.to_log_sample())
 
     def log_perf_events(self, perf_events: Optional[PerfEvents]) -> None:
         """Convergence measurement (Fib.cpp:760-843)."""

@@ -456,7 +456,10 @@ class KvStoreDb(CountersMixin):
             publication.node_ids = []
         publication.node_ids.append(self.params.node_id)
 
-        # internal subscribers (Decision et al.)
+        # internal subscribers (Decision et al.); the monotonic stamp seeds
+        # Decision's convergence span (this store's clock — always restamp:
+        # a shared in-process publication object may carry another node's)
+        publication.ts_monotonic = time.monotonic()
         self.updates_queue.push(publication)
         self._bump("kvstore.num_updates")
 

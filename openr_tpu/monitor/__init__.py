@@ -1,9 +1,25 @@
-"""Observability: structured event logs, counters aggregation, watchdog.
+"""Observability: structured event logs, counters + histogram aggregation,
+convergence spans, watchdog.
 
-Equivalents of openr/monitor/ (MonitorBase, LogSample) and openr/watchdog/.
+Equivalents of openr/monitor/ (MonitorBase, LogSample) and openr/watchdog/,
+plus the monotonic span tracing layer (monitor/spans.py) that PerfEvents
+ride-alongs feed into.
 """
 
-from openr_tpu.monitor.monitor import LogSample, Monitor
+from openr_tpu.monitor.monitor import (
+    LogSample,
+    Monitor,
+    merge_module_histograms,
+)
+from openr_tpu.monitor.spans import SPAN_EVENT, Span
 from openr_tpu.monitor.watchdog import Watchdog, WatchdogConfig
 
-__all__ = ["LogSample", "Monitor", "Watchdog", "WatchdogConfig"]
+__all__ = [
+    "LogSample",
+    "Monitor",
+    "Span",
+    "SPAN_EVENT",
+    "Watchdog",
+    "WatchdogConfig",
+    "merge_module_histograms",
+]

@@ -12,11 +12,32 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 from openr_tpu.messaging import QueueClosedError, RQueue
+from openr_tpu.utils.counters import Histogram
 
 EVENT_LOG_CATEGORY = "openr.event_logs"  # Constants::kEventLogCategory
+
+
+def merge_module_histograms(modules: Iterable[object]) -> Dict[str, Histogram]:
+    """Merge the `histograms` dicts of a module set into fresh Histogram
+    objects (same-name histograms across modules fold together; module-owned
+    histograms are never mutated). Shared by Monitor.get_histograms and the
+    ctrl server's monitor-less fallback."""
+    merged: Dict[str, Histogram] = {}
+    for module in modules:
+        hists = getattr(module, "histograms", None)
+        if not isinstance(hists, dict):
+            continue
+        for name, hist in hists.items():
+            if not isinstance(hist, Histogram):
+                continue
+            if name in merged:
+                merged[name].merge(hist)
+            else:
+                merged[name] = hist.copy()
+    return merged
 
 
 class LogSample:
@@ -121,3 +142,10 @@ class Monitor:
             if isinstance(counters, dict):
                 merged.update(counters)
         return merged
+
+    def get_histograms(self) -> Dict[str, Dict[str, float]]:
+        """Merged latency histograms of every registered module (the
+        getHistograms ctrl API surface): name -> exported stats dict
+        (count/sum/avg/min/max/p50/p95/p99)."""
+        merged = merge_module_histograms(self._modules.values())
+        return {name: h.to_dict() for name, h in sorted(merged.items())}

@@ -1,0 +1,75 @@
+"""Convergence spans: structured stage traces of one LSDB event.
+
+PerfEvents (types.py) ride LSDB values across nodes with wall-clock ms
+stamps — right for cross-node convergence reports (`breeze perf view`),
+wrong for local latency histograms: an NTP step mid-event skews every
+duration derived from them. A Span is the local monotonic-clock sibling of
+that trace: created when Decision keeps the oldest event of a debounce
+batch (seeded from the KvStore publication stamp when one rode along),
+marked at each pipeline stage —
+
+    kvstore publication → decision recv → debounce fire → route build
+    → fib recv → fib program
+
+— and finished by Fib once routes are programmed. Stage durations feed the
+`*_ms` histograms (decision.debounce_ms, decision.spf.solve_ms,
+fib.program_ms, convergence.e2e_ms) and the finished span is emitted as
+one CONVERGENCE_TRACE LogSample through the monitor queue.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.monitor.monitor import LogSample
+
+SPAN_EVENT = "CONVERGENCE_TRACE"
+
+
+class Span:
+    """Ordered (stage, monotonic-ts) marks over one event's pipeline pass.
+
+    Spans never cross a process boundary (monotonic clocks don't compare
+    across hosts) — they ride in-process queue payloads only, as the
+    `span` attribute next to `perf_events`.
+    """
+
+    __slots__ = ("name", "t0", "marks")
+
+    def __init__(self, name: str, t0: Optional[float] = None) -> None:
+        self.name = name
+        self.t0 = time.monotonic() if t0 is None else t0
+        self.marks: List[Tuple[str, float]] = []
+
+    def mark(self, stage: str) -> float:
+        """Append a stage boundary; returns the stage's duration in ms
+        (time since the previous mark, or since t0 for the first)."""
+        now = time.monotonic()
+        prev = self.marks[-1][1] if self.marks else self.t0
+        self.marks.append((stage, now))
+        return (now - prev) * 1e3
+
+    def elapsed_ms(self) -> float:
+        """End-to-end ms since the span started (t0 → now)."""
+        return (time.monotonic() - self.t0) * 1e3
+
+    def stage_durations_ms(self) -> Dict[str, float]:
+        """stage -> ms from the previous mark (t0 for the first)."""
+        out: Dict[str, float] = {}
+        prev = self.t0
+        for stage, ts in self.marks:
+            out[stage] = (ts - prev) * 1e3
+            prev = ts
+        return out
+
+    def to_log_sample(self) -> LogSample:
+        sample = LogSample()
+        sample.add_string("event", SPAN_EVENT)
+        sample.add_string("span", self.name)
+        total = 0.0
+        for stage, ms in self.stage_durations_ms().items():
+            sample.add_double(f"{stage}_ms", ms)
+            total += ms
+        sample.add_double("total_ms", total)
+        return sample

@@ -302,6 +302,9 @@ class OpenrDaemon:
             self.route_updates_queue.get_reader(),
             self.interface_updates_queue.get_reader(),
             kvstore_client=self.kvstore_client,
+            # finished convergence spans (CONVERGENCE_TRACE) drain into the
+            # monitor's event-log ring like every other LogSample
+            log_sample_fn=self.log_sample_queue.push,
             loop=loop,
         )
 

@@ -330,13 +330,16 @@ def _sell_invalidate(dp, nbrs, wgs, inc_idx, zero_end, starts, shapes):
     dp is the dest-major [N, S] OLD distance fixpoint and wgs the OLD
     bucket weights. inc_idx [B, P, 2] names the (row, slot) positions whose
     weight is about to increase (padding rows carry out-of-range indices).
-    Returns a bool [N, S] mask of entries whose old shortest-path witness
-    may traverse an increased edge: seed marks where an increased edge sits
-    on the old shortest-path DAG (triangle condition against the old
-    weights), then propagate marks down the old DAG with a boolean
-    fixpoint. Over-marking is safe (marked entries are recomputed from
-    INF); under-marking is impossible because every true DAG edge passes
-    the unmasked triangle test."""
+    Returns (marks, rounds): marks is a bool [N, S] mask of entries whose
+    old shortest-path witness may traverse an increased edge, rounds the
+    boolean fixpoint's iteration count (the ROADMAP rounds-accounting gap:
+    mark propagation is cheap per round but unbounded in principle on deep
+    DAGs, so it is surfaced as decision.spf.invalidation_rounds_last).
+    Seed marks where an increased edge sits on the old shortest-path DAG
+    (triangle condition against the old weights), then propagate marks down
+    the old DAG with a boolean fixpoint. Over-marking is safe (marked
+    entries are recomputed from INF); under-marking is impossible because
+    every true DAG edge passes the unmasked triangle test."""
     n, s = dp.shape
     marks = jnp.zeros((n, s), dtype=jnp.bool_)
     for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
@@ -399,8 +402,10 @@ def _sell_invalidate(dp, nbrs, wgs, inc_idx, zero_end, starts, shapes):
 
     # zero increased edges -> zero seed marks -> the loop is skipped whole,
     # so decrease-only events pay nothing for sharing this executable
-    marks, _, _ = jax.lax.while_loop(cond, body, (marks, jnp.any(marks), 0))
-    return marks
+    marks, _, rounds = jax.lax.while_loop(
+        cond, body, (marks, jnp.any(marks), 0)
+    )
+    return marks, rounds
 
 
 @functools.lru_cache(maxsize=64)
@@ -408,15 +413,16 @@ def _sell_solver_warm(key: Tuple, mesh=None):
     """Warm-start incremental patch-and-solve, one dispatch per LSDB event.
 
     (sources, nbrs, wgs, overloaded, patch_idx, patch_vals, inc_idx,
-    d_prev) -> (D, new_wgs, rounds): invalidates the entries of d_prev
-    [S, N] whose old shortest path may witness an increased edge
+    d_prev) -> (D, new_wgs, rounds, inv_rounds): invalidates the entries of
+    d_prev [S, N] whose old shortest path may witness an increased edge
     (_sell_invalidate, against the OLD weights), applies the weight
     patches, and relaxes from the repaired state instead of from INF —
     rounds scale with the affected radius of the event, not the graph
-    diameter. Decrease-only events have an empty inc_idx and warm-start
-    directly. All patch shapes are fixed (_PATCH_SLOTS per bucket) so one
-    executable serves every event; d_prev and the weight buffers are
-    donated since the caller always replaces its handles."""
+    diameter. inv_rounds is the invalidation mark fixpoint's own round
+    count (0 for decrease-only events, whose empty inc_idx skips the loop
+    and warm-starts directly). All patch shapes are fixed (_PATCH_SLOTS per
+    bucket) so one executable serves every event; d_prev and the weight
+    buffers are donated since the caller always replaces its handles."""
     zero_end, starts, shapes = key
 
     def solve(
@@ -424,7 +430,7 @@ def _sell_solver_warm(key: Tuple, mesh=None):
     ):
         s = sources.shape[0]
         dp = d_prev.T  # dest-major [N, S], like the relaxation state
-        marks = _sell_invalidate(
+        marks, inv_rounds = _sell_invalidate(
             dp, nbrs, wgs, inc_idx, zero_end, starts, shapes
         )
         new_wgs = _sell_apply_patches(wgs, patch_idx, patch_vals)
@@ -434,7 +440,7 @@ def _sell_solver_warm(key: Tuple, mesh=None):
         d, rounds = _sell_relax(
             d0, allow, nbrs, new_wgs, zero_end, starts, shapes
         )
-        return d.T, new_wgs, rounds
+        return d.T, new_wgs, rounds, inv_rounds
 
     if mesh is None:
         return jax.jit(solve, donate_argnums=(2, 7))
@@ -443,7 +449,7 @@ def _sell_solver_warm(key: Tuple, mesh=None):
         solve,
         donate_argnums=(2, 7),
         in_shardings=(row, repl, repl, repl, repl, repl, repl, out),
-        out_shardings=(out, repl, repl),
+        out_shardings=(out, repl, repl, repl),
     )
 
 
@@ -615,5 +621,31 @@ def ecmp_dag(graph: CompiledGraph, d: jnp.ndarray) -> jnp.ndarray:
         jnp.asarray(graph.w),
         jnp.asarray(graph.overloaded),
     )
+
+
+def compile_cache_stats() -> dict:
+    """Aggregate executable-cache totals across the jitted solver factories.
+
+    Each factory's lru_cache is keyed by (SlicedEll.shape_key(), mesh), so a
+    miss is one new trace+XLA compile for a new bucket structure and a hit
+    is an executable reused across LSDB events — the shape-bucketing design
+    working as intended. TpuSpfSolver surfaces these as the
+    decision.spf.compile_cache_{hits,misses} gauges (process-wide: the
+    caches are module-level, shared by every solver instance)."""
+    hits = misses = entries = 0
+    for fn in (
+        _sell_solver_raw,
+        _sell_solver,
+        _sell_solver_counted,
+        _sell_solver_patched,
+        _sell_solver_warm,
+        _sell_solver_vw,
+        _bf_vw_solver,
+    ):
+        info = fn.cache_info()
+        hits += info.hits
+        misses += info.misses
+        entries += info.currsize
+    return {"hits": hits, "misses": misses, "entries": entries}
 
 

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.lsdb.link_state import Link, LinkState, Path, path_a_in_path_b
-from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 from openr_tpu.lsdb.prefix_state import PrefixState
 from openr_tpu.solver.metric_vector import (
     CompareResult,
@@ -83,7 +83,7 @@ def get_prefix_forwarding_algorithm(
     return PrefixForwardingAlgorithm.KSP2_ED_ECMP
 
 
-class SpfSolver(CountersMixin):
+class SpfSolver(CountersMixin, HistogramsMixin):
     """Route computation from one node's perspective (Decision.cpp:90)."""
 
     def __init__(
@@ -105,6 +105,7 @@ class SpfSolver(CountersMixin):
         self._static_mpls_routes: Dict[int, Set[NextHop]] = {}
         self._static_updates: List[Tuple[Dict[int, Set[NextHop]], Set[int]]] = []
         self.counters: Dict[str, int] = {}
+        self.histograms: Dict = {}
 
     # ------------------------------------------------------------------
     # SPF access seam — the TPU backend overrides these two methods to
@@ -114,7 +115,8 @@ class SpfSolver(CountersMixin):
 
     def _spf(self, link_state: LinkState, node: str):
         """SpfResult-like mapping dest -> object with .metric/.next_hops."""
-        return link_state.get_spf_result(node)
+        with self._timer("decision.spf.solve_ms"):
+            return link_state.get_spf_result(node)
 
     def _dist(self, link_state: LinkState, a: str, b: str) -> Optional[Metric]:
         return link_state.get_metric_from_a_to_b(a, b)

@@ -87,6 +87,9 @@ class DecisionRouteUpdate:
     mpls_routes_to_update: List[RibMplsEntry] = field(default_factory=list)
     mpls_routes_to_delete: List[int] = field(default_factory=list)
     perf_events: Optional[object] = None
+    # monotonic stage trace riding the delta to Fib (monitor.spans.Span);
+    # host-local only — never serialized, never compared
+    span: Optional[object] = None
 
     def empty(self) -> bool:
         return not (

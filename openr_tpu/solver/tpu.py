@@ -28,6 +28,7 @@ order).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -160,6 +161,21 @@ class _AreaSolve:
         self.incremental_solves = 0  # warm-started weight-patch solves
         self.full_solves = 0  # cold solves (from D0 = INF)
         self.rounds_last: Optional[int] = None  # relax rounds of last solve
+        # boolean invalidation-mark fixpoint rounds of the last WARM solve
+        # (None until one runs; 0 for decrease-only events)
+        self.invalidation_rounds_last: Optional[int] = None
+        # profiling (decision.spf.* histograms/gauges): wall time of the
+        # last solve dispatch + whether it rode the warm path, and the
+        # host<->device traffic this solve has generated — the warm event
+        # path's whole point is shrinking both, so they are measured in the
+        # serving path, not offline
+        self.solve_ms_last: Optional[float] = None
+        self.last_solve_warm = False
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        # _sync_spf_counters bookmarks (bytes already folded into counters)
+        self._h2d_synced = 0
+        self._d2h_synced = 0
         # persistent device buffers (SURVEY.md §7: the <100ms convergence
         # budget leaves no room to re-upload the LSDB per event): sell
         # nbr/wg/overloaded live on device across events; weight patches
@@ -181,6 +197,7 @@ class _AreaSolve:
         that buffer to the next event — a view would alias reused memory."""
         if self._d_host is None:
             self._d_host = np.array(self._d_dev)
+            self.d2h_bytes += self._d_host.nbytes
         return self._d_host
 
     def _batch_pad(self, n: int, minimum: int = 8) -> int:
@@ -226,7 +243,13 @@ class _AreaSolve:
             [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
         )
         # one device call for the whole batch; results stay device-resident
-        # (the host mirror is fetched lazily through the `d` property)
+        # (the host mirror is fetched lazily through the `d` property).
+        # Timing covers patch build + dispatch; on the sliced-ELL paths the
+        # scalar `rounds` output forces completion of the same computation,
+        # so the measured wall time includes device execution there.
+        inc_before = self.incremental_solves
+        t0 = time.perf_counter()
+        self.h2d_bytes += rows.nbytes
         if self.graph.sell is not None:
             self._d_dev, self.rounds_last = self._sell_solve_resident(rows)
         elif self.mesh is not None:
@@ -239,6 +262,8 @@ class _AreaSolve:
             self._d_dev = batched_spf(self.graph, rows)
             self.rounds_last = None
             self.full_solves += 1
+        self.solve_ms_last = (time.perf_counter() - t0) * 1e3
+        self.last_solve_warm = self.incremental_solves > inc_before
         self._d_host = None
         self.device_solves += 1
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
@@ -282,11 +307,17 @@ class _AreaSolve:
                 "ov_host": g.overloaded.copy(),
                 "rows": np.array(rows),
             }
+            self.h2d_bytes += (
+                sum(a.nbytes for a in sell.nbr)
+                + sum(a.nbytes for a in sell.wg)
+                + g.overloaded.nbytes
+            )
         else:
             ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
             if ov_changed:
                 st["ov"] = self._replicated(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
+                self.h2d_bytes += g.overloaded.nbytes
             # warm start needs the previous fixpoint to describe the same
             # problem modulo edge weights: identical source batch (a flap
             # adjacent to me changes the rows) and identical transit mask
@@ -339,6 +370,7 @@ class _AreaSolve:
                         jnp.asarray(idx),
                         jnp.asarray(vals),
                     )
+                    self.h2d_bytes += idx.nbytes + vals.nbytes
                     if (
                         self.warm_start
                         and rows_same
@@ -354,11 +386,13 @@ class _AreaSolve:
                                 inc_idx[k, : len(sel), 0] = sell.edge_row[sel]
                                 inc_idx[k, : len(sel), 1] = sell.edge_slot[sel]
                         fn = _sell_solver_warm(sell.shape_key(), self.mesh)
-                        d, new_wgs, rounds = fn(
+                        self.h2d_bytes += inc_idx.nbytes
+                        d, new_wgs, rounds, inv_rounds = fn(
                             *args, jnp.asarray(inc_idx), self._d_dev
                         )
                         st["wgs"] = new_wgs
                         self.incremental_solves += 1
+                        self.invalidation_rounds_last = int(inv_rounds)
                         return d, int(rounds)
                     fn = _sell_solver_patched(sell.shape_key(), self.mesh)
                     d, new_wgs, rounds = fn(*args)
@@ -373,6 +407,8 @@ class _AreaSolve:
                             .at[sell.edge_row[sel], sell.edge_slot[sel]]
                             .set(jnp.asarray(g.w[sel]))
                         )
+                        # standalone scatters: row/slot index + value uploads
+                        self.h2d_bytes += 3 * 4 * len(sel)
                 st["wgs"] = tuple(wgs)
 
         fn = _sell_solver_counted(sell.shape_key(), self.mesh)
@@ -655,20 +691,49 @@ class TpuSpfSolver(SpfSolver):
     def _sync_spf_counters(
         self, solve: _AreaSolve, inc0: int, full0: int
     ) -> None:
-        """Fold an _AreaSolve's convergence stats into the decision.spf.*
-        counters (merged into Decision's counter dict for the monitor/ctrl
-        API): incremental vs full solves are monotonic, rounds_last is the
-        relaxation-round gauge of the most recent solve."""
+        """Fold an _AreaSolve's convergence + profiling stats into the
+        decision.spf.* counters/histograms (merged into Decision's dicts
+        for the monitor/ctrl API): incremental vs full solves and transfer
+        bytes are monotonic, rounds/invalidation-rounds are gauges of the
+        most recent solve, solve wall time lands in the warm/cold-split
+        latency histograms."""
         d_inc = solve.incremental_solves - inc0
         d_full = solve.full_solves - full0
+        counters = self._ensure_counters()
         if d_inc:
             self._bump("decision.spf.incremental_solves", d_inc)
         if d_full:
             self._bump("decision.spf.full_solves", d_full)
         if solve.rounds_last is not None:
-            self._ensure_counters()["decision.spf.rounds_last"] = (
-                solve.rounds_last
+            counters["decision.spf.rounds_last"] = solve.rounds_last
+        if solve.invalidation_rounds_last is not None:
+            counters["decision.spf.invalidation_rounds_last"] = (
+                solve.invalidation_rounds_last
             )
+        if (d_inc or d_full) and solve.solve_ms_last is not None:
+            self._observe("decision.spf.solve_ms", solve.solve_ms_last)
+            self._observe(
+                "decision.spf.solve_warm_ms"
+                if solve.last_solve_warm
+                else "decision.spf.solve_cold_ms",
+                solve.solve_ms_last,
+            )
+        # transfer-byte deltas since the last sync (the lazy d mirror fetch
+        # lands on the NEXT sync — the fetch happens after this call, when
+        # the route pipeline first reads solve.d)
+        d_h2d = solve.h2d_bytes - solve._h2d_synced
+        if d_h2d:
+            solve._h2d_synced = solve.h2d_bytes
+            self._bump("decision.spf.host_to_device_bytes", d_h2d)
+        d_d2h = solve.d2h_bytes - solve._d2h_synced
+        if d_d2h:
+            solve._d2h_synced = solve.d2h_bytes
+            self._bump("decision.spf.device_to_host_bytes", d_d2h)
+        from openr_tpu.ops.spf import compile_cache_stats
+
+        stats = compile_cache_stats()
+        counters["decision.spf.compile_cache_hits"] = stats["hits"]
+        counters["decision.spf.compile_cache_misses"] = stats["misses"]
 
     # -- SPF access seam -------------------------------------------------
 

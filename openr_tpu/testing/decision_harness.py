@@ -10,7 +10,8 @@ Publication-shape changes have one place to land.
 from __future__ import annotations
 
 import asyncio
-from typing import Iterable, Optional, Tuple
+import time
+from typing import Iterable, List, Optional, Tuple
 
 from openr_tpu.decision import Decision, DecisionConfig
 from openr_tpu.messaging import ReplicateQueue, RQueue, RWQueue
@@ -93,6 +94,88 @@ def assert_route_delta_equal(a, b) -> Tuple[int, int]:
     )
     assert sorted(a.mpls_routes_to_delete) == sorted(b.mpls_routes_to_delete)
     return len(a_uni), len(a_mpls)
+
+
+async def run_convergence_trace(
+    my_node: str,
+    publications: Iterable[Publication],
+    backend: str = "tpu",
+    mesh: Optional[tuple] = None,
+    timeout: float = 30.0,
+):
+    """Full KvStore→Decision→Fib observability pass.
+
+    Boots Decision(backend) and a dryrun Fib wired by the route queue plus
+    a Monitor aggregating both (the daemon's registration layout), stamps
+    and pushes each publication the way KvStore.flood_publication does, and
+    waits for Fib to close that event's convergence span before pushing the
+    next — each publication MUST change routes or this times out. Returns
+    (monitor, decision, fib) with the modules stopped but their counters,
+    histograms and the monitor's event-log ring intact for assertions.
+    """
+    from openr_tpu.fib import Fib, FibConfig
+    from openr_tpu.monitor import Monitor
+    from openr_tpu.platform import MockFibHandler
+
+    kv_q: RWQueue = RWQueue()
+    route_q: ReplicateQueue = ReplicateQueue()
+    log_q: ReplicateQueue = ReplicateQueue()
+    decision = Decision(
+        DecisionConfig(
+            my_node_name=my_node,
+            solver_backend=backend,
+            solver_mesh=mesh,
+            debounce_min=0.005,
+            debounce_max=0.02,
+        ),
+        RQueue(kv_q),
+        route_q,
+    )
+    fib = Fib(
+        FibConfig(my_node_name=my_node, dryrun=True),
+        MockFibHandler(),
+        route_q.get_reader(),
+        log_sample_fn=log_q.push,
+    )
+    monitor = Monitor(my_node, log_q.get_reader())
+    monitor.register_module("decision", decision)
+    monitor.register_module("fib", fib)
+    monitor.start()
+    decision.start()
+    fib.start()
+    loop = asyncio.get_running_loop()
+    try:
+        done = 0
+        for pub in publications:
+            pub.ts_monotonic = time.monotonic()
+            kv_q.push(pub)
+            done += 1
+            deadline = loop.time() + timeout
+            while (
+                fib.histograms.get("convergence.e2e_ms") is None
+                or fib.histograms["convergence.e2e_ms"].count < done
+            ):
+                if loop.time() > deadline:
+                    raise TimeoutError(
+                        f"publication {done} produced no convergence span"
+                    )
+                await asyncio.sleep(0.005)
+        # let the monitor drain the emitted CONVERGENCE_TRACE samples
+        deadline = loop.time() + timeout
+        while len(monitor.get_event_logs()) < done:
+            if loop.time() > deadline:
+                raise TimeoutError("monitor did not drain span log samples")
+            await asyncio.sleep(0.005)
+    finally:
+        tasks: List[asyncio.Task] = [
+            t for t in (decision._task, *fib._tasks) if t is not None
+        ]
+        fib.stop()
+        decision.stop()
+        monitor.stop()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+    return monitor, decision, fib
 
 
 def run_decision_backend_parity(

@@ -388,6 +388,11 @@ class Publication:
     node_ids: Optional[List[str]] = None
     tobe_updated_keys: Optional[List[str]] = None
     area: str = "0"
+    # time.monotonic() stamp set by the local KvStore when it hands this
+    # publication to internal subscribers — seeds Decision's convergence
+    # span (monitor/spans.py). Host-local only: never serialized (wire.py
+    # rebuilds publications without it) and meaningless across processes.
+    ts_monotonic: Optional[float] = None
 
 
 # ---------------------------------------------------------------------------

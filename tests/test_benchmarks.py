@@ -118,6 +118,26 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert result["value"] > 0
     # conftest pins JAX_PLATFORMS=cpu, so the probe reports a native run
     assert "backend" not in result
+    assert "degraded" not in result
+
+
+def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):
+    """A cpu-fallback run measures a reduced workload on the wrong
+    hardware: the JSON line must say so explicitly so BENCH consumers
+    treat it as an availability signal, never as a perf regression."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setattr(bench, "_probe_backend", lambda: "cpu-fallback")
+    bench.main([])
+    out = capsys.readouterr().out.strip().splitlines()
+    result = json.loads(out[-1])
+    assert result["backend"] == "cpu-fallback"
+    assert result["degraded"] is True
+    # the availability-signal contract: a degraded line still carries the
+    # full metric shape, so dashboards can plot uptime without special
+    # cases — only perf comparisons must skip it
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
 
 
 def test_config_store_bench(capsys, monkeypatch):

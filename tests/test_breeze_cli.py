@@ -12,6 +12,7 @@ from openr_tpu.kvstore import InProcessTransport, KvStore
 from openr_tpu.monitor import Monitor
 from openr_tpu.types import AdjacencyDatabase, Adjacency, Value, adj_key
 from openr_tpu.utils import serializer
+from openr_tpu.utils.counters import Histogram
 
 
 @pytest.fixture
@@ -37,6 +38,17 @@ def ctrl_endpoint():
             Value(1, "cli-node", serializer.dumps(adj_db)),
         )
         monitor = Monitor("cli-node")
+
+        class _Hists:
+            """Module exposing latency histograms (Decision stand-in)."""
+
+            histograms = {}
+
+        hist = Histogram()
+        for v in (1.0, 2.0, 4.0):
+            hist.record(v)
+        _Hists.histograms = {"decision.spf.solve_ms": hist}
+        monitor.register_module("decision", _Hists())
         server = CtrlServer(
             "cli-node", port=0, kvstore=store, monitor=monitor
         )
@@ -94,6 +106,21 @@ def test_monitor_counters(ctrl_endpoint, capsys):
     assert breeze(host, port, "monitor", "counters") == 0
     out = capsys.readouterr().out
     assert "process.uptime.seconds" in out
+
+
+def test_monitor_histograms(ctrl_endpoint, capsys):
+    host, port = ctrl_endpoint
+    assert breeze(host, port, "monitor", "histograms") == 0
+    out = capsys.readouterr().out
+    # table header + the registered histogram with its stats rendered
+    for token in ("Histogram", "Count", "p50", "p99"):
+        assert token in out
+    line = next(
+        l for l in out.splitlines() if "decision.spf.solve_ms" in l
+    )
+    assert " 3 " in f" {line} "  # count column
+    # p50 of {1, 2, 4} interpolates inside the 2.0 bucket
+    assert "2." in line
 
 
 def test_connection_refused_exit_code(capsys):

@@ -1,0 +1,168 @@
+"""Tier-1 observability lint: every counter/histogram name emitted through
+CountersMixin/HistogramsMixin follows the `<module>.<name>` convention from
+docs/Monitoring.md — drift fails at test time, not in dashboards.
+
+The walk is AST-based: classes inheriting (transitively, by name) from the
+mixins are scanned for literal names at the emission sites —
+`self._bump("...")`, `self._observe("...")`, `self._timer("...")` and
+literal subscripts on `counters` / `histograms` /
+`_ensure_counters()` / `_ensure_histograms()`. Non-mixin counter dicts
+(e.g. MockFibHandler's per-API mock counters) are intentionally out of
+scope, exactly as the convention is.
+"""
+
+import ast
+import re
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "openr_tpu"
+
+MIXINS = {"CountersMixin", "HistogramsMixin"}
+
+# module prefixes registered with the Monitor (openr.py) plus the
+# cross-module end-to-end namespace
+ALLOWED_PREFIXES = {
+    "decision",
+    "kvstore",
+    "fib",
+    "spark",
+    "link_monitor",
+    "prefix_manager",
+    "convergence",
+}
+
+# <module>.<name>[.<name>...], lowercase snake segments
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+_EMIT_CALLS = {"_bump", "_observe", "_timer"}
+_DICT_ATTRS = {"counters", "histograms"}
+_ENSURE_CALLS = {"_ensure_counters", "_ensure_histograms"}
+
+
+def _base_names(node: ast.ClassDef):
+    for base in node.bases:
+        if isinstance(base, ast.Name):
+            yield base.id
+        elif isinstance(base, ast.Attribute):
+            yield base.attr
+
+
+def _mixin_classes(trees):
+    """Names of classes inheriting a mixin, transitively by simple name."""
+    bases = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases[node.name] = set(_base_names(node))
+    users = set(MIXINS)
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name not in users and bs & users:
+                users.add(name)
+                changed = True
+    return users - MIXINS
+
+
+def _is_dict_ref(node) -> bool:
+    """`self.counters` / `x.histograms` / `self._ensure_counters()` or a
+    local alias of one (`counters = self._ensure_counters()`)."""
+    if isinstance(node, ast.Attribute) and node.attr in _DICT_ATTRS:
+        return True
+    if isinstance(node, ast.Name) and node.id in _DICT_ATTRS:
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _ENSURE_CALLS
+    )
+
+
+def collect_emitted_names():
+    """(name, 'file:line') pairs from every mixin user in the package."""
+    trees = {
+        py: ast.parse(py.read_text(), filename=str(py))
+        for py in sorted(PKG.rglob("*.py"))
+    }
+    mixin_users = _mixin_classes(trees)
+    found = []
+    for py, tree in trees.items():
+        for cls in ast.walk(tree):
+            if not (
+                isinstance(cls, ast.ClassDef) and cls.name in mixin_users
+            ):
+                continue
+            for node in ast.walk(cls):
+                name = None
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMIT_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    name = node.args[0].value
+                elif (
+                    isinstance(node, ast.Subscript)
+                    and _is_dict_ref(node.value)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    name = node.slice.value
+                if name is not None:
+                    rel = py.relative_to(PKG.parent)
+                    found.append((name, f"{rel}:{node.lineno}"))
+    return found
+
+
+def test_scanner_finds_the_counter_surface():
+    """Guard against scanner rot: the walk must see the known emission
+    sites, including the observability layer's new names."""
+    names = {name for name, _ in collect_emitted_names()}
+    assert len(names) >= 40, sorted(names)
+    for expected in (
+        "decision.adj_db_update",
+        "decision.debounce_ms",
+        "decision.spf.solve_ms",
+        "decision.spf.invalidation_rounds_last",
+        "fib.program_ms",
+        "convergence.e2e_ms",
+        "kvstore.num_updates",
+        "link_monitor.neighbor_up",
+    ):
+        assert expected in names, expected
+
+
+def test_counter_names_follow_convention():
+    bad = [
+        (name, where)
+        for name, where in collect_emitted_names()
+        if not NAME_RE.match(name)
+        or name.split(".", 1)[0] not in ALLOWED_PREFIXES
+    ]
+    assert not bad, f"counter names violating <module>.<name>: {bad}"
+
+
+def test_histogram_names_carry_a_unit_suffix():
+    """Latency/size distributions must self-describe their unit."""
+    trees = {
+        py: ast.parse(py.read_text(), filename=str(py))
+        for py in sorted(PKG.rglob("*.py"))
+    }
+    bad = []
+    for py, tree in trees.items():
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in {"_observe", "_timer"}
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                name = node.args[0].value
+                if not name.endswith(("_ms", "_bytes")):
+                    bad.append((name, f"{py.name}:{node.lineno}"))
+    assert not bad, f"histogram names missing unit suffix: {bad}"

@@ -83,6 +83,51 @@ class TestBasics:
 
         run(body())
 
+    def test_get_histograms(self):
+        from openr_tpu.utils.counters import Histogram
+
+        async def body():
+            monitor = Monitor("test-node")
+
+            class Fake:
+                histograms = {}
+
+            hist = Histogram()
+            hist.record(2.0)
+            hist.record(6.0)
+            Fake.histograms = {"decision.spf.solve_ms": hist}
+            monitor.register_module("decision", Fake())
+            server, client = await make_server(monitor=monitor)
+            hists = await client.call("getHistograms")
+            solve = hists["decision.spf.solve_ms"]
+            assert solve["count"] == 2
+            assert solve["min"] == 2.0 and solve["max"] == 6.0
+            assert 0.0 < solve["p50"] <= solve["p99"] <= 6.0
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_get_histograms_without_monitor_merges_modules(self):
+        """Monitor-less fallback merges the attached modules' histograms
+        (same shape the monitor path serves)."""
+        from openr_tpu.utils.counters import Histogram
+
+        async def body():
+            class FakeDecision:
+                histograms = {}
+
+            hist = Histogram()
+            hist.record(1.0)
+            FakeDecision.histograms = {"decision.debounce_ms": hist}
+            server, client = await make_server(decision=FakeDecision())
+            hists = await client.call("getHistograms")
+            assert hists["decision.debounce_ms"]["count"] == 1
+            await client.close()
+            await server.stop()
+
+        run(body())
+
 
 class TestKvStoreApis:
     def test_set_get_dump(self):

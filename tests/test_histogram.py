@@ -1,0 +1,134 @@
+"""Histogram/Timer primitive tests (utils/counters.py): log-bucket math at
+boundary values, empty-histogram behavior, cross-module merge, and the
+HistogramsMixin/Timer recording path."""
+
+import math
+
+from openr_tpu.utils.counters import (
+    _LO,
+    _NBUCKETS,
+    _SUB,
+    Histogram,
+    HistogramsMixin,
+)
+
+
+class TestBucketMath:
+    def test_zero_and_tiny_values_land_in_bucket_zero(self):
+        assert Histogram.bucket_index(0.0) == 0
+        assert Histogram.bucket_index(_LO / 2) == 0
+        assert Histogram.bucket_index(_LO * 0.999) == 0
+
+    def test_lower_edge_is_inclusive(self):
+        # bucket i's lower edge belongs to bucket i ([lo, hi) semantics)
+        for i in (1, 2, 5, _SUB, 3 * _SUB + 1):
+            lo, hi = Histogram.bucket_bounds(i)
+            assert Histogram.bucket_index(lo) == i, i
+            # clearly below the upper edge stays in bucket i
+            assert Histogram.bucket_index(hi * (1 - 1e-6)) == i, i
+            # the upper edge itself opens the next bucket
+            assert Histogram.bucket_index(hi) == i + 1, i
+
+    def test_index_monotonic_over_geometric_sweep(self):
+        prev = -1
+        v = _LO / 4
+        while v < 1e9:
+            idx = Histogram.bucket_index(v)
+            assert 0 <= idx < _NBUCKETS
+            assert idx >= prev, v
+            prev = idx
+            v *= 1.31
+
+    def test_huge_values_clamp_to_last_bucket(self):
+        assert Histogram.bucket_index(1e300) == _NBUCKETS - 1
+        h = Histogram()
+        h.record(1e300)
+        assert h.count == 1 and h.max == 1e300
+
+    def test_bounds_tile_the_axis(self):
+        for i in range(1, _NBUCKETS - 1):
+            lo, hi = Histogram.bucket_bounds(i)
+            lo2, _ = Histogram.bucket_bounds(i + 1)
+            assert math.isclose(hi, lo2)
+            assert math.isclose(hi / lo, 2 ** (1 / _SUB))
+
+
+class TestHistogram:
+    def test_empty(self):
+        h = Histogram()
+        assert h.count == 0
+        assert h.sum == 0.0
+        assert h.percentile(50) == 0.0
+        d = h.to_dict()
+        assert d["count"] == 0 and d["p99"] == 0.0 and d["max"] == 0.0
+
+    def test_single_sample_percentiles_are_exact(self):
+        h = Histogram()
+        h.record(5.0)
+        for p in (0, 50, 95, 99, 100):
+            assert h.percentile(p) == 5.0
+        assert h.min == h.max == 5.0
+        assert h.avg == 5.0
+
+    def test_negative_and_nan_clamp_to_zero(self):
+        h = Histogram()
+        h.record(-3.0)
+        h.record(float("nan"))
+        assert h.count == 2
+        assert h.sum == 0.0 and h.max == 0.0
+
+    def test_percentiles_bounded_by_bucket_error(self):
+        # log buckets guarantee <= 2**(1/_SUB)-1 relative error
+        h = Histogram()
+        values = [0.1 * 1.13 ** i for i in range(150)]
+        for v in values:
+            h.record(v)
+        values.sort()
+        for p in (50, 95, 99):
+            true = values[min(len(values) - 1, int(p / 100 * len(values)))]
+            got = h.percentile(p)
+            assert got <= true * 2 ** (1 / _SUB) * 1.01
+            assert got >= true / (2 ** (1 / _SUB) * 1.01)
+        assert h.percentile(100) == max(values)
+
+    def test_merge_equals_recording_into_one(self):
+        a, b, both = Histogram(), Histogram(), Histogram()
+        for i, v in enumerate(x * 0.37 for x in range(1, 50)):
+            (a if i % 2 else b).record(v)
+            both.record(v)
+        merged = a.copy().merge(b)
+        assert merged.buckets == both.buckets
+        assert merged.count == both.count
+        assert math.isclose(merged.sum, both.sum)
+        assert merged.min == both.min and merged.max == both.max
+        assert merged.percentile(95) == both.percentile(95)
+        # merge never mutates its argument, copy never aliases
+        assert a.count + b.count == merged.count
+        a.record(1.0)
+        assert merged.count == both.count
+
+    def test_merge_with_empty(self):
+        a = Histogram()
+        a.record(2.0)
+        assert a.copy().merge(Histogram()).to_dict() == a.to_dict()
+        assert Histogram().merge(a).to_dict() == a.to_dict()
+
+
+class TestHistogramsMixin:
+    class _Mod(HistogramsMixin):
+        pass
+
+    def test_observe_creates_and_records(self):
+        m = self._Mod()
+        m._observe("decision.debounce_ms", 1.5)
+        m._observe("decision.debounce_ms", 2.5)
+        h = m.histograms["decision.debounce_ms"]
+        assert h.count == 2 and h.sum == 4.0
+
+    def test_timer_records_elapsed_ms(self):
+        m = self._Mod()
+        with m._timer("fib.program_ms"):
+            sum(range(1000))
+        h = m.histograms["fib.program_ms"]
+        assert h.count == 1
+        assert 0.0 <= h.max < 10_000.0

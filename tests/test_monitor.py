@@ -4,6 +4,7 @@ import asyncio
 
 from openr_tpu.messaging import RWQueue
 from openr_tpu.monitor import LogSample, Monitor, Watchdog, WatchdogConfig
+from openr_tpu.utils.counters import Histogram
 
 
 def run(coro, timeout=10.0):
@@ -52,6 +53,34 @@ class TestMonitor:
         counters = mon.get_counters()
         assert counters["decision.spf_runs"] == 12
         assert "process.uptime.seconds" in counters
+
+    def test_histogram_aggregation_merges_across_modules(self):
+        """Same-name histograms from different modules fold into one
+        exported distribution; module-owned histograms stay untouched."""
+
+        def module(*values):
+            class FakeModule:
+                histograms = {}
+
+            h = Histogram()
+            for v in values:
+                h.record(v)
+            FakeModule.histograms = {"convergence.e2e_ms": h}
+            return FakeModule()
+
+        a, b = module(1.0, 3.0), module(10.0)
+        mon = Monitor("n1")
+        mon.register_module("decision", a)
+        mon.register_module("fib", b)
+        # a module without histograms must not break aggregation
+        mon.register_module("bare", object())
+        hists = mon.get_histograms()
+        e2e = hists["convergence.e2e_ms"]
+        assert e2e["count"] == 3
+        assert e2e["min"] == 1.0 and e2e["max"] == 10.0
+        # export merged copies, never the modules' own objects
+        assert a.histograms["convergence.e2e_ms"].count == 2
+        assert b.histograms["convergence.e2e_ms"].count == 1
 
 
 class TestWatchdog:

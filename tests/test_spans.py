@@ -1,0 +1,176 @@
+"""Convergence span tests: Span primitive semantics, monotonic-clock
+immunity to wall-clock jumps, and the full KvStore→Decision→Fib trace pass
+(ISSUE 2 acceptance: non-zero decision.spf.solve_ms and convergence.e2e_ms
+after a link-flap sequence, warm vs cold solves distinguishable,
+invalidation rounds populated on an increase event)."""
+
+import asyncio
+import time
+
+from openr_tpu.monitor import SPAN_EVENT, Span
+from openr_tpu.testing.decision_harness import (
+    lsdb_publication,
+    run_convergence_trace,
+)
+from openr_tpu.topology import build_adj_dbs, grid_edges
+from openr_tpu.types import Value, adj_key
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=120.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+class TestSpan:
+    def test_marks_accumulate_stage_durations(self):
+        span = Span("convergence")
+        first = span.mark("decision.recv")
+        second = span.mark("decision.debounce")
+        assert first >= 0.0 and second >= 0.0
+        durations = span.stage_durations_ms()
+        assert list(durations) == ["decision.recv", "decision.debounce"]
+        assert span.elapsed_ms() >= first + second
+
+    def test_seeded_t0_predates_first_mark(self):
+        t0 = time.monotonic() - 0.050
+        span = Span("convergence", t0=t0)
+        ms = span.mark("decision.recv")
+        assert ms >= 50.0
+
+    def test_wall_clock_jump_does_not_skew(self, monkeypatch):
+        """Satellite: spans run on time.monotonic — a wall-clock step
+        (NTP, manual date set) between marks must not leak into stage
+        durations or e2e."""
+        span = Span("convergence")
+        monkeypatch.setattr(time, "time", lambda: 4e9)  # jump ~100 years
+        ms = span.mark("decision.recv")
+        assert ms < 10_000.0
+        assert span.elapsed_ms() < 10_000.0
+
+    def test_to_log_sample(self):
+        span = Span("convergence")
+        span.mark("decision.recv")
+        span.mark("fib.program")
+        sample = span.to_log_sample()
+        assert sample.get("event") == SPAN_EVENT
+        assert sample.get("span") == "convergence"
+        assert sample.get("decision.recv_ms") >= 0.0
+        assert sample.get("fib.program_ms") >= 0.0
+        assert sample.get("total_ms") >= 0.0
+
+
+def _flap_publication(edges, metric, nodes=("g0_0", "g0_1"), version=2):
+    """Publication re-announcing `nodes` adj dbs with the (g0_0, g0_1)
+    link's metric set to `metric`."""
+    flapped = [
+        (a, b, metric) if {a, b} == {"g0_0", "g0_1"} else (a, b, m)
+        for a, b, m in edges
+    ]
+    dbs = build_adj_dbs(flapped)
+    pub = lsdb_publication([])
+    for node in nodes:
+        pub.key_vals[adj_key(node)] = Value(
+            version, node, serializer.dumps(dbs[node])
+        )
+    return pub
+
+
+class TestConvergenceTracePass:
+    """Cold ingest + metric increase/decrease/increase flaps through the
+    full Decision(tpu)→Fib pipeline, observability asserted end to end."""
+
+    def _run(self):
+        edges = grid_edges(4)
+        base = lsdb_publication(
+            build_adj_dbs(edges).values(), {"g3_3": ["10.0.0.0/24"]}
+        )
+        # increase → decrease → increase; the last event is an increase so
+        # the invalidation_rounds_last gauge reflects a mark fixpoint run
+        # (a decrease correctly writes 0 — its inc_idx is empty)
+        flaps = [
+            _flap_publication(edges, 5, version=2),
+            _flap_publication(edges, 1, version=3),
+            _flap_publication(edges, 7, version=4),
+        ]
+        return run(run_convergence_trace("g0_0", [base, *flaps]))
+
+    def test_link_flap_sequence_histograms_and_counters(self):
+        monitor, decision, fib = self._run()
+        hists = monitor.get_histograms()
+
+        # acceptance: non-zero solve + e2e latency distributions
+        solve = hists["decision.spf.solve_ms"]
+        assert solve["count"] >= 4
+        assert solve["p50"] > 0.0 and solve["p99"] > 0.0
+        e2e = hists["convergence.e2e_ms"]
+        assert e2e["count"] == 4
+        assert e2e["p50"] > 0.0 and e2e["p99"] > 0.0
+
+        # warm vs cold solves distinguishable: one cold ingest, three warm
+        # weight-patch flaps
+        assert hists["decision.spf.solve_cold_ms"]["count"] >= 1
+        assert hists["decision.spf.solve_warm_ms"]["count"] >= 3
+
+        # per-stage histograms populated once per debounced rebuild
+        assert hists["decision.debounce_ms"]["count"] == 4
+        assert hists["decision.route_build_ms"]["count"] == 4
+        assert hists["fib.program_ms"]["count"] == 4
+
+        counters = monitor.get_counters()
+        assert counters["decision.spf.incremental_solves"] == 3
+        # the increase event ran the boolean invalidation-mark fixpoint
+        assert counters["decision.spf.invalidation_rounds_last"] >= 1
+        assert counters["decision.spf.rounds_last"] >= 1
+        # profiling: traffic crossed the host-device link both ways and
+        # the executable cache compiled at least the cold + warm solvers
+        assert counters["decision.spf.host_to_device_bytes"] > 0
+        assert counters["decision.spf.device_to_host_bytes"] > 0
+        assert counters["decision.spf.compile_cache_misses"] >= 1
+        assert counters["fib.convergence_spans"] == 4
+
+    def test_span_log_samples_reach_monitor(self):
+        monitor, decision, fib = self._run()
+        traces = [
+            s
+            for s in monitor.get_event_logs()
+            if s.get("event") == SPAN_EVENT
+        ]
+        assert len(traces) == 4
+        for sample in traces:
+            # the full stage chain is present and non-negative
+            for stage in (
+                "decision.recv_ms",
+                "decision.debounce_ms",
+                "decision.route_build_ms",
+                "fib.recv_ms",
+                "fib.program_ms",
+                "total_ms",
+            ):
+                assert sample.get(stage) is not None, stage
+                assert sample.get(stage) >= 0.0, stage
+            # debounce waited at least roughly the configured minimum
+            assert sample.get("total_ms") >= sample.get(
+                "decision.debounce_ms"
+            )
+            # node_name auto-filled by the monitor drain
+            assert sample.get("node_name") == "g0_0"
+
+
+class TestCpuBackendSolveHistogram:
+    """The CPU oracle backend reports decision.spf.solve_ms too, so the
+    observability surface does not depend on the device backend."""
+
+    def test_cpu_solver_times_spf(self):
+        edges = grid_edges(3)
+        base = lsdb_publication(
+            build_adj_dbs(edges).values(), {"g2_2": ["10.1.0.0/24"]}
+        )
+        monitor, decision, fib = run(
+            run_convergence_trace("g0_0", [base], backend="cpu")
+        )
+        hists = monitor.get_histograms()
+        assert hists["decision.spf.solve_ms"]["count"] >= 1
+        assert hists["convergence.e2e_ms"]["count"] == 1
