@@ -7,13 +7,13 @@ monitor / openr). argparse instead of click (no extra deps in this image);
 same command vocabulary:
 
   breeze kvstore keys|keyvals|peers|areas
-  breeze decision adj|prefixes|routes|rib-policy
+  breeze decision adj|prefixes|routes|rib-policy|solver-health
   breeze fib routes|unicast-routes|mpls-routes|counters
   breeze lm links|set-node-overload|unset-node-overload|
             set-link-overload|unset-link-overload|
             set-link-metric|unset-link-metric
   breeze prefixmgr view|advertise|withdraw|sync
-  breeze monitor counters|histograms|logs
+  breeze monitor counters|histograms[--reset]|logs
   breeze openr version|config
   breeze perf view                   (fib perf event database — 'breeze perf')
   breeze config show|dryrun          (running config / validate candidate)
@@ -163,6 +163,11 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
             _print_table(["Label", "Nexthops"], rows)
     elif args.cmd == "rib-policy":
         _print_json(client.call("getRibPolicy"))
+    elif args.cmd == "solver-health":
+        health = client.call("getSolverHealth")
+        state = "DEGRADED" if health.get("degraded") else "HEALTHY"
+        print(f"solver: {state} (breaker: {health.get('breaker_state')})")
+        _print_json(health)
     elif args.cmd == "path":
         # all shortest paths src -> dst over the live adjacency dump
         # (py/openr/cli/commands/decision.py PathCmd equivalent)
@@ -400,7 +405,9 @@ def cmd_monitor(client: BlockingCtrlClient, args) -> None:
     if args.cmd == "counters":
         _print_json(client.call("getCounters"))
     elif args.cmd == "histograms":
-        hists = client.call("getHistograms")
+        # --reset: reset-on-read windowing — this export clears the
+        # sources, so the next call describes a fresh window (rates)
+        hists = client.call("getHistograms", reset=bool(args.reset))
 
         def ms(v: float) -> str:
             return f"{v:.3f}"
@@ -472,6 +479,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = dec.add_parser("routes")
     p.add_argument("--node", default=None)
     dec.add_parser("rib-policy")
+    dec.add_parser("solver-health")
     p = dec.add_parser("path")
     p.add_argument("src")
     p.add_argument("dst")
@@ -505,7 +513,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     mon = sub.add_parser("monitor").add_subparsers(dest="cmd", required=True)
     mon.add_parser("counters")
-    mon.add_parser("histograms")
+    p = mon.add_parser("histograms")
+    p.add_argument("--reset", action="store_true")
     mon.add_parser("logs")
 
     op = sub.add_parser("openr").add_subparsers(dest="cmd", required=True)

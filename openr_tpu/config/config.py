@@ -131,6 +131,16 @@ class DecisionConfigSection:
     # (batch, graph) device-mesh shape for the tpu backend, e.g. [4, 2]
     # on a v5e-8; None/empty = single device
     solver_mesh: Optional[List[int]] = None
+    # solver fault domain (docs/Robustness.md): supervision wraps the tpu
+    # backend with classified retries, a CPU-fallback circuit breaker,
+    # probe-driven recovery, and an every-Nth-solve warm-state audit
+    solver_supervised: bool = True
+    solver_failure_threshold: int = 3
+    solver_max_attempts: int = 2
+    solver_deadline_s: float = 30.0
+    solver_probe_interval_s: float = 5.0
+    solver_probe_successes: int = 2
+    solver_audit_interval: int = 0
 
 
 @dataclass

@@ -263,17 +263,29 @@ class CtrlServer:
     def m_getHistograms(self, params) -> Dict[str, Any]:
         """Merged latency histograms of every registered module
         (count/sum/avg/min/max + p50/p95/p99 per name) — the fb303
-        exported-histogram surface next to getCounters."""
+        exported-histogram surface next to getCounters. `reset: true`
+        clears the sources after export (reset-on-read windowing, so
+        dashboards can compute rates from consecutive snapshots)."""
+        reset = bool(params.get("reset", False))
         if self.monitor is not None:
-            return self.monitor.get_histograms()
+            return self.monitor.get_histograms(reset=reset)
         from openr_tpu.monitor import merge_module_histograms
 
         merged = merge_module_histograms(
-            m
-            for m in (self.decision, self.fib, self.link_monitor)
-            if m is not None
+            (
+                m
+                for m in (self.decision, self.fib, self.link_monitor)
+                if m is not None
+            ),
+            reset=reset,
         )
         return {name: h.to_dict() for name, h in sorted(merged.items())}
+
+    def m_getSolverHealth(self, params) -> Dict[str, Any]:
+        """Solver fault-domain state: degraded flag, breaker state,
+        probe/audit stats (docs/Robustness.md)."""
+        assert self.decision is not None, "decision module not attached"
+        return self.decision.get_solver_health()
 
     def m_getEventLogs(self, params) -> List[str]:
         if self.monitor is None:

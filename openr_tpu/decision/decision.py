@@ -31,7 +31,9 @@ from openr_tpu.monitor.spans import Span
 from openr_tpu.solver import (
     DecisionRouteDb,
     DecisionRouteUpdate,
+    SolverSupervisor,
     SpfSolver,
+    SupervisorConfig,
     TpuSpfSolver,
     get_route_delta,
 )
@@ -89,6 +91,17 @@ class DecisionConfig:
     debounce_min: float = 0.01  # 10ms (docs/Runbook.md:425-435)
     debounce_max: float = 0.25  # 250ms
     eor_time_s: float = 0.0  # cold-start hold; 0 = no hold
+    # solver fault domain (docs/Robustness.md): the tpu backend runs under
+    # a SolverSupervisor — error-classified retries, a circuit breaker
+    # falling back to the CPU oracle, probe-driven recovery, and an
+    # every-Nth-solve warm-state audit (0 disables the audit)
+    solver_supervised: bool = True
+    solver_failure_threshold: int = 3
+    solver_max_attempts: int = 2
+    solver_deadline_s: float = 30.0
+    solver_probe_interval_s: float = 5.0
+    solver_probe_successes: int = 2
+    solver_audit_interval: int = 0
 
 
 class _PendingUpdates:
@@ -143,6 +156,8 @@ class Decision(CountersMixin, HistogramsMixin):
         route_updates_queue: ReplicateQueue,
         static_routes_updates: Optional[RQueue] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        watchdog=None,
+        log_sample_fn=None,
     ) -> None:
         self.config = config
         self.kvstore_updates = kvstore_updates
@@ -158,11 +173,33 @@ class Decision(CountersMixin, HistogramsMixin):
             bgp_use_igp_metric=config.bgp_use_igp_metric,
         )
         if config.solver_backend == "tpu":
-            self.solver = TpuSpfSolver(
+            primary = TpuSpfSolver(
                 config.my_node_name,
                 mesh=config.solver_mesh,
                 **solver_kwargs,
             )
+            if config.solver_supervised:
+                # the solve path's fault domain: device faults degrade to
+                # the CPU oracle behind a circuit breaker instead of
+                # unwinding into this module's event loop
+                self.solver = SolverSupervisor(
+                    primary,
+                    SpfSolver(config.my_node_name, **solver_kwargs),
+                    SupervisorConfig(
+                        failure_threshold=config.solver_failure_threshold,
+                        max_attempts=config.solver_max_attempts,
+                        solve_deadline_s=config.solver_deadline_s,
+                        probe_interval_s=config.solver_probe_interval_s,
+                        probe_successes_to_close=(
+                            config.solver_probe_successes
+                        ),
+                        audit_interval=config.solver_audit_interval,
+                    ),
+                    watchdog=watchdog,
+                    log_sample_fn=log_sample_fn,
+                )
+            else:
+                self.solver = primary
         else:
             self.solver = SpfSolver(config.my_node_name, **solver_kwargs)
         self.area_link_states: Dict[str, LinkState] = {
@@ -189,6 +226,14 @@ class Decision(CountersMixin, HistogramsMixin):
         self._task: Optional[asyncio.Task] = None
         self.counters: Dict[str, int] = {}
         self.histograms: Dict = {}
+        if isinstance(self.solver, SolverSupervisor):
+            # breaker trips, probes and audits happen in the BACKGROUND,
+            # between rebuilds — the supervisor records straight into this
+            # module's monitor-registered dicts so getCounters/ctrl always
+            # read live fault-domain state, not the last rebuild's copy
+            self.solver.counters = self.counters
+            self.solver.histograms = self.histograms
+            self.counters["decision.spf.fallback_active"] = 0
         self.have_computed_routes = False
 
     # ------------------------------------------------------------------
@@ -206,9 +251,13 @@ class Decision(CountersMixin, HistogramsMixin):
             self._cold_start_timer = self.loop().call_later(
                 self.config.eor_time_s, self._end_cold_start
             )
+        if isinstance(self.solver, SolverSupervisor):
+            self.solver.start(self.loop())  # background health-probe loop
         self._task = self.loop().create_task(self._run())
 
     def stop(self) -> None:
+        if isinstance(self.solver, SolverSupervisor):
+            self.solver.stop()
         if self._task is not None:
             self._task.cancel()
             self._task = None
@@ -600,6 +649,20 @@ class Decision(CountersMixin, HistogramsMixin):
         return solver.build_route_db(
             node, self.area_link_states, self.prefix_state
         )
+
+    def get_solver_health(self) -> Dict:
+        """Solver fault-domain state (ctrl getSolverHealth / `breeze
+        decision solver-health`): the degraded flag, breaker state and
+        probe/audit stats when supervised; a static healthy record when
+        the backend runs bare (cpu oracle or supervision disabled)."""
+        if isinstance(self.solver, SolverSupervisor):
+            return self.solver.health()
+        return {
+            "degraded": False,
+            "breaker_state": "unsupervised",
+            "fallback_active": 0,
+            "backend": self.config.solver_backend,
+        }
 
     def get_adjacency_databases(self) -> Dict[str, AdjacencyDatabase]:
         out: Dict[str, AdjacencyDatabase] = {}

@@ -44,6 +44,7 @@ from openr_tpu.types import (
     PerfEvents,
     UnicastRoute,
 )
+from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils import ExponentialBackoff
 from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 
@@ -134,6 +135,12 @@ class FibConfig:
     keep_alive_interval: float = 30.0  # Constants::kKeepAliveCheckInterval
     backoff_min: float = 0.008  # Fib.cpp:37-38
     backoff_max: float = 4.096
+    # decorrelated jitter on the full-sync retry schedule: when a fleet's
+    # agents restart together, deterministic doubling re-synchronizes every
+    # node's resync attempts into storms — jitter (utils/backoff.py)
+    # decorrelates them. Seed is injectable for deterministic tests.
+    backoff_jitter: bool = True
+    backoff_seed: Optional[int] = None
     has_eor_time: bool = False  # eor_time_s set → Decision gates first sync
 
 
@@ -175,8 +182,17 @@ class Fib(CountersMixin, HistogramsMixin):
         self.perf_db: List[PerfEvents] = []
         self._recent_perf_ts = 0
         self.has_synced_fib = False
+        import random as _random
+
         self._backoff = ExponentialBackoff(
-            config.backoff_min, config.backoff_max
+            config.backoff_min,
+            config.backoff_max,
+            jitter=config.backoff_jitter,
+            rng=(
+                _random.Random(config.backoff_seed)
+                if config.backoff_seed is not None
+                else None
+            ),
         )
         # single-slot semaphore serializing route programming across the
         # route-update and interface-update consumers (Fib.h:270)
@@ -390,6 +406,10 @@ class Fib(CountersMixin, HistogramsMixin):
                 return
 
             try:
+                # named fault seam: injected programming failures ride the
+                # exact dirty-marking + debounced-resync path a thrift
+                # failure would (docs/Robustness.md)
+                fault_point("fib.program", self)
                 n = 0
                 if unicast_to_delete:
                     n += len(unicast_to_delete)
@@ -438,6 +458,7 @@ class Fib(CountersMixin, HistogramsMixin):
         if self.config.dryrun:
             return True
         try:
+            fault_point("fib.sync", self)
             self._bump("fib.sync_fib_calls")
             await self.fib_service.sync_fib(FIB_CLIENT_OPENR, unicast)
             self.route_state.dirty_prefixes.clear()
@@ -479,6 +500,9 @@ class Fib(CountersMixin, HistogramsMixin):
 
     async def keep_alive_check(self) -> None:
         """Agent-restart detection (Fib.cpp:681-695)."""
+        # named fault seam, ctx=self: tests arm actions here to kill or
+        # restart the stub agent exactly when the poll observes it
+        fault_point("fib.keepalive", self)
         alive_since = await self.fib_service.alive_since()
         if getattr(self, "_latest_alive_since", None) not in (
             None,

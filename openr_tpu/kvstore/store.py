@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.testing.faults import fault_point
 from openr_tpu.types import (
     KeyVals,
     Publication,
@@ -520,6 +521,9 @@ class KvStoreDb(CountersMixin):
         if peer is None:
             return
         try:
+            # named fault seam: an injected send failure exercises the
+            # API_ERROR peer-state path without a real transport fault
+            fault_point("kvstore.flood_send", peer_name)
             await self.transport.set_key_vals(
                 peer.spec.peer_addr, self.area, key_vals, node_ids
             )

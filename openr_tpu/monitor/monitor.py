@@ -20,12 +20,23 @@ from openr_tpu.utils.counters import Histogram
 EVENT_LOG_CATEGORY = "openr.event_logs"  # Constants::kEventLogCategory
 
 
-def merge_module_histograms(modules: Iterable[object]) -> Dict[str, Histogram]:
+def merge_module_histograms(
+    modules: Iterable[object], reset: bool = False
+) -> Dict[str, Histogram]:
     """Merge the `histograms` dicts of a module set into fresh Histogram
-    objects (same-name histograms across modules fold together; module-owned
-    histograms are never mutated). Shared by Monitor.get_histograms and the
-    ctrl server's monitor-less fallback."""
+    objects (same-name histograms across modules fold together). Shared by
+    Monitor.get_histograms and the ctrl server's monitor-less fallback.
+
+    With `reset=True` (the reset-on-read snapshot mode) every merged
+    source histogram is cleared after the copy, so consecutive exports
+    describe disjoint windows and dashboards can compute rates from
+    otherwise lifetime-cumulative distributions. Objects shared by
+    reference across modules (e.g. Decision re-exporting the solver's
+    decision.spf.* histograms) are reset exactly once — they were also
+    merged from whichever module listed them first, and the id-dedup
+    keeps the copy and the clear consistent."""
     merged: Dict[str, Histogram] = {}
+    seen_ids = set()
     for module in modules:
         hists = getattr(module, "histograms", None)
         if not isinstance(hists, dict):
@@ -33,10 +44,15 @@ def merge_module_histograms(modules: Iterable[object]) -> Dict[str, Histogram]:
         for name, hist in hists.items():
             if not isinstance(hist, Histogram):
                 continue
+            if id(hist) in seen_ids:
+                continue  # same object re-exported by another module
+            seen_ids.add(id(hist))
             if name in merged:
                 merged[name].merge(hist)
             else:
                 merged[name] = hist.copy()
+            if reset:
+                hist.reset()
     return merged
 
 
@@ -143,9 +159,12 @@ class Monitor:
                 merged.update(counters)
         return merged
 
-    def get_histograms(self) -> Dict[str, Dict[str, float]]:
+    def get_histograms(
+        self, reset: bool = False
+    ) -> Dict[str, Dict[str, float]]:
         """Merged latency histograms of every registered module (the
         getHistograms ctrl API surface): name -> exported stats dict
-        (count/sum/avg/min/max/p50/p95/p99)."""
-        merged = merge_module_histograms(self._modules.values())
+        (count/sum/avg/min/max/p50/p95/p99). `reset=True` clears every
+        source histogram after export (reset-on-read windowing)."""
+        merged = merge_module_histograms(self._modules.values(), reset=reset)
         return {name: h.to_dict() for name, h in sorted(merged.items())}

@@ -49,6 +49,8 @@ class Watchdog:
         self._tasks: Dict[str, asyncio.Task] = {}
         self._checker: Optional[asyncio.Task] = None
         self.monitored_modules: list = []
+        # module -> count of budget-overrun sections (note_slow)
+        self.slow_sections: Dict[str, int] = {}
 
     def loop(self) -> asyncio.AbstractEventLoop:
         return self._loop or asyncio.get_event_loop()
@@ -69,6 +71,19 @@ class Watchdog:
     def touch(self, name: str) -> None:
         """Modules doing long cooperative work can stamp explicitly."""
         self._heartbeats[name] = time.monotonic()
+
+    def note_slow(self, name: str, elapsed_s: float, budget_s: float) -> None:
+        """Attributed slow-section report (SolverSupervisor's per-solve
+        deadline enforcement lands here): a section finished but blew its
+        budget — below the fire threshold, above normal. Recorded per
+        module so a watchdog fire that follows can name the culprit."""
+        self.slow_sections[name] = self.slow_sections.get(name, 0) + 1
+        log.warning(
+            "module %s section ran %.3fs (budget %.3fs)",
+            name,
+            elapsed_s,
+            budget_s,
+        )
 
     def start(self) -> None:
         self._checker = self.loop().create_task(self._check_loop())

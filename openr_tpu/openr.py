@@ -261,6 +261,13 @@ class OpenrDaemon:
                 solver_mesh=(
                     tuple(dc.solver_mesh) if dc.solver_mesh else None
                 ),
+                solver_supervised=dc.solver_supervised,
+                solver_failure_threshold=dc.solver_failure_threshold,
+                solver_max_attempts=dc.solver_max_attempts,
+                solver_deadline_s=dc.solver_deadline_s,
+                solver_probe_interval_s=dc.solver_probe_interval_s,
+                solver_probe_successes=dc.solver_probe_successes,
+                solver_audit_interval=dc.solver_audit_interval,
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,
@@ -273,6 +280,11 @@ class OpenrDaemon:
             self.route_updates_queue,
             static_routes_updates=self.static_routes_queue.get_reader(),
             loop=loop,
+            # solver fault domain: the supervisor stamps solve sections
+            # into the watchdog heartbeat map and emits breaker/audit
+            # events into the monitor's log-sample ring
+            watchdog=self.watchdog,
+            log_sample_fn=self.log_sample_queue.push,
         )
 
         # --- fib -------------------------------------------------------

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
+from openr_tpu.testing.faults import fault_point
 
 
 def _bf_fixpoint_vw_core(
@@ -558,6 +559,8 @@ def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
     profile qualifies (ops.graph._build_sell), else the edge-list
     segment-min form.
     """
+    # named fault seam for injected dispatch failures (docs/Robustness.md)
+    fault_point("ops.spf.batched_spf", graph)
     if graph.sell is not None:
         return sell_fixpoint(
             graph.sell, source_rows, graph.sell.wg, graph.overloaded
@@ -579,6 +582,7 @@ def batched_spf_vw(
 
     With a mesh, sources and weight rows shard over 'batch' (S must be a
     multiple of the batch-axis size)."""
+    fault_point("ops.spf.batched_spf_vw", graph)
     return _bf_vw_solver(mesh)(
         jnp.asarray(source_rows, dtype=jnp.int32),
         jnp.asarray(graph.src),

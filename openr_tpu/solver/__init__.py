@@ -5,6 +5,9 @@ The solver consumes LinkState + PrefixState and produces a DecisionRouteDb
 openr/decision/Decision.cpp SpfSolver. Two interchangeable backends:
   - cpu.SpfSolver: faithful oracle (per-source memoized Dijkstra)
   - tpu.TpuSpfSolver: batched min-plus solver on TPU via JAX
+plus supervisor.SolverSupervisor, the fault-domain facade that serves the
+TPU backend under a circuit breaker with the CPU oracle as the degraded
+path (docs/Robustness.md).
 """
 
 from openr_tpu.solver.routes import (
@@ -15,9 +18,12 @@ from openr_tpu.solver.routes import (
     get_route_delta,
 )
 from openr_tpu.solver.cpu import SpfSolver
+from openr_tpu.solver.supervisor import SolverSupervisor, SupervisorConfig
 from openr_tpu.solver.tpu import TpuSpfSolver
 
 __all__ = [
+    "SolverSupervisor",
+    "SupervisorConfig",
     "TpuSpfSolver",
     "DecisionRouteDb",
     "DecisionRouteUpdate",

@@ -1,0 +1,563 @@
+"""Supervised solver layer: the solve path's explicit fault domain.
+
+Sits between Decision and the solver backends so that a failing device
+solve (XLA compile error, runtime fault, device loss, deadline overrun)
+degrades to the CPU oracle instead of unwinding into Decision's event loop
+— degraded hardware means slower convergence, never wrong routes or a dead
+Decision module (FatPaths correctness-under-failure posture, PAPERS.md).
+
+Three cooperating mechanisms:
+
+  1. **Supervised solves** — every `build_route_db` on the primary (TPU)
+     backend is wrapped with error classification
+     (compile / runtime / device_loss / deadline), bounded in-call retry,
+     and per-solve deadline accounting stamped into the Watchdog's
+     heartbeat map (`monitor/watchdog.py`) so a wedged solve is attributed
+     to the solver, not generically to Decision.
+
+  2. **Circuit breaker with CPU fallback** — `failure_threshold`
+     consecutive primary failures trip the breaker OPEN: the primary's
+     device-resident warm state is invalidated (it is untrustworthy after
+     a device fault) and every solve is served by the CPU oracle
+     (`decision.spf.fallback_active` = 1). Recovery is probe-driven with
+     hysteresis: background health-probe solves re-run the primary on the
+     live LSDB off the hot path, and only `probe_successes_to_close`
+     consecutive successes close the breaker; any probe failure re-arms an
+     `ExponentialBackoff` gate so a flapping device cannot oscillate the
+     serving path.
+
+  3. **Warm-state self-audit** — every `audit_interval`-th successful
+     primary solve triggers a shadow cold solve (recomputed from the
+     host-side graph truth) compared entrywise against the warm
+     device-resident distance matrix. Divergence increments
+     `decision.spf.audit_mismatches`, emits a `WARM_STATE_AUDIT` LogSample
+     (CONVERGENCE_TRACE-style, through the monitor queue), forces a cold
+     re-solve and re-serves the corrected routes — self-healing, not
+     crash: a silently-diverged warm `D` would otherwise program wrong
+     routes forever.
+
+All counters live in the `decision.spf.*` namespace so they flow through
+Decision's existing counter sync into Monitor/ctrl/breeze.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from openr_tpu.utils.backoff import ExponentialBackoff
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
+
+log = logging.getLogger(__name__)
+
+# breaker states
+CLOSED = "closed"  # primary serving
+OPEN = "open"  # fallback serving, probes running
+HALF_OPEN = "half_open"  # fallback serving, probe streak in progress
+
+# fault kinds (classification buckets)
+FAULT_COMPILE = "compile"
+FAULT_RUNTIME = "runtime"
+FAULT_DEVICE_LOSS = "device_loss"
+FAULT_DEADLINE = "deadline"
+
+
+class SolveDeadlineExceeded(RuntimeError):
+    """A solve finished but blew its per-solve deadline budget."""
+
+
+def classify_solver_error(exc: BaseException) -> str:
+    """Map a raised solve exception onto a fault-kind bucket.
+
+    Classification is by exception type name + message substrings rather
+    than concrete jax types: the supervisor must not import device
+    runtimes it is there to survive, and jax's exception taxonomy moves
+    between releases. Unknown errors classify as runtime (the safe bucket:
+    retry-then-fallback)."""
+    if isinstance(exc, SolveDeadlineExceeded):
+        return FAULT_DEADLINE
+    names = {type(e).__name__ for e in _exc_chain(exc)}
+    text = " ".join(
+        f"{type(e).__name__}: {e}" for e in _exc_chain(exc)
+    ).lower()
+    if any(
+        hint in text
+        for hint in (
+            "device_lost",
+            "device lost",
+            "device is lost",
+            "failed to connect",
+            "halted",
+            "data transfer",
+            "device unavailable",
+        )
+    ):
+        return FAULT_DEVICE_LOSS
+    if (
+        "XlaCompileError" in names
+        or "compile" in text
+        or "lowering" in text
+        or isinstance(exc, (TypeError, NotImplementedError))
+    ):
+        return FAULT_COMPILE
+    return FAULT_RUNTIME
+
+
+def _exc_chain(exc: BaseException) -> List[BaseException]:
+    out: List[BaseException] = []
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        out.append(cur)
+        seen.add(id(cur))
+        cur = cur.__cause__ or cur.__context__
+    return out
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs for the solver fault domain (docs/Robustness.md)."""
+
+    # consecutive primary failures that trip the breaker OPEN
+    failure_threshold: int = 3
+    # in-call retry budget per build_route_db (1 = no retry)
+    max_attempts: int = 2
+    # per-solve wall-clock deadline; overruns classify as FAULT_DEADLINE
+    # and count toward the breaker (the result, if any, is still served —
+    # slow-but-correct beats no-route)
+    solve_deadline_s: float = 30.0
+    # health-probe cadence while the breaker is OPEN/HALF_OPEN; failures
+    # back off exponentially from this base
+    probe_interval_s: float = 5.0
+    probe_backoff_max_s: float = 60.0
+    # hysteresis: consecutive probe successes required to close the breaker
+    probe_successes_to_close: int = 2
+    # shadow cold-audit every Nth successful primary solve; 0 disables
+    audit_interval: int = 0
+    # watchdog heartbeat name stamped around solves
+    watchdog_module: str = "decision"
+
+
+class SolverSupervisor(CountersMixin, HistogramsMixin):
+    """Drop-in SpfSolver facade: primary backend under supervision, CPU
+    oracle as the degraded path. Decision talks only to this object."""
+
+    def __init__(
+        self,
+        primary,
+        fallback,
+        config: Optional[SupervisorConfig] = None,
+        *,
+        watchdog=None,
+        log_sample_fn=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.primary = primary
+        self.fallback = fallback
+        self.config = config or SupervisorConfig()
+        self.watchdog = watchdog
+        self._log_sample_fn = log_sample_fn
+        self._clock = clock
+        self.my_node_name = primary.my_node_name
+
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.probe_streak = 0
+        self.last_fault_kind: Optional[str] = None
+        self._solves_since_audit = 0
+        self._probe_backoff = ExponentialBackoff(
+            max(self.config.probe_interval_s, 1e-3),
+            max(
+                self.config.probe_backoff_max_s,
+                self.config.probe_interval_s,
+                1e-3,
+            ),
+            clock=clock,
+        )
+        self._next_probe_at = 0.0
+        self._probe_task = None
+        # last solve inputs, kept for probes/audits off the hot path
+        self._last_inputs = None
+
+        self.counters: Dict[str, int] = {}
+        self.histograms: Dict = {}
+        self.counters["decision.spf.fallback_active"] = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle (background probe loop; optional — probes also run
+    # opportunistically from the solve path when no loop is attached)
+    # ------------------------------------------------------------------
+
+    def start(self, loop=None) -> None:
+        import asyncio
+
+        if self._probe_task is not None:
+            return
+        try:
+            loop = loop or asyncio.get_event_loop()
+        except RuntimeError:
+            return
+        self._probe_task = loop.create_task(self._probe_loop())
+
+    def stop(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
+
+    async def _probe_loop(self) -> None:
+        import asyncio
+
+        interval = max(self.config.probe_interval_s / 4.0, 0.01)
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                if self.state != CLOSED:
+                    self.maybe_probe()
+        except asyncio.CancelledError:
+            pass
+
+    # ------------------------------------------------------------------
+    # SpfSolver facade
+    # ------------------------------------------------------------------
+
+    def build_route_db(self, my_node_name, area_link_states, prefix_state):
+        self._last_inputs = (my_node_name, area_link_states, prefix_state)
+        if self.state != CLOSED:
+            # opportunistic probe for loop-less embeddings: the breaker
+            # must be able to recover even when nobody started the
+            # background task (probe_due gates the cadence)
+            if self._probe_task is None:
+                self.maybe_probe()
+        if self.state != CLOSED:
+            return self._fallback_solve(
+                my_node_name, area_link_states, prefix_state
+            )
+
+        attempts = 0
+        while True:
+            attempts += 1
+            self._touch_watchdog()
+            t0 = self._clock()
+            try:
+                db = self.primary.build_route_db(
+                    my_node_name, area_link_states, prefix_state
+                )
+            except Exception as exc:
+                self._record_failure(classify_solver_error(exc), exc)
+                if self.state != CLOSED:
+                    break
+                if attempts >= max(self.config.max_attempts, 1):
+                    # retry budget exhausted without tripping the breaker:
+                    # serve this event degraded, keep the breaker counting
+                    break
+                self._bump("decision.spf.solver_retries")
+                continue
+            finally:
+                self._touch_watchdog()
+            elapsed = self._clock() - t0
+            if elapsed > self.config.solve_deadline_s:
+                # the solve completed but blew its budget: a deadline
+                # fault feeds the breaker (repeated overruns mean the
+                # device is degrading), yet the computed routes are valid
+                # — serve them rather than discard correct work
+                self._record_failure(
+                    FAULT_DEADLINE,
+                    SolveDeadlineExceeded(
+                        f"solve took {elapsed:.3f}s "
+                        f"(deadline {self.config.solve_deadline_s}s)"
+                    ),
+                    elapsed_s=elapsed,
+                )
+            else:
+                self._record_success()
+            self._sync_backend_stats(self.primary)
+            db = self._maybe_audit(
+                db, my_node_name, area_link_states, prefix_state
+            )
+            return db
+
+        return self._fallback_solve(
+            my_node_name, area_link_states, prefix_state
+        )
+
+    # static-route pass-through: both backends ingest every push so the
+    # fallback's static MPLS state is identical the moment it must serve
+    def push_static_routes_delta(self, mpls_to_update, mpls_to_delete):
+        self.primary.push_static_routes_delta(mpls_to_update, mpls_to_delete)
+        self.fallback.push_static_routes_delta(mpls_to_update, mpls_to_delete)
+
+    def static_routes_updated(self) -> bool:
+        return self.primary.static_routes_updated()
+
+    def process_static_route_updates(self):
+        delta = self.primary.process_static_route_updates()
+        self.fallback.process_static_route_updates()  # keep state in lockstep
+        return delta
+
+    @property
+    def static_mpls_routes(self):
+        return self.primary.static_mpls_routes
+
+    def __getattr__(self, name: str):
+        # drop-in facade: introspection attributes the supervisor does not
+        # shadow (device_solves, mesh, warm_start, ...) read through to the
+        # primary backend. Only called for attributes missing on self.
+        if name.startswith("_") or name == "primary":
+            raise AttributeError(name)
+        return getattr(self.primary, name)
+
+    # ------------------------------------------------------------------
+    # breaker mechanics
+    # ------------------------------------------------------------------
+
+    def _fallback_solve(self, my_node_name, area_link_states, prefix_state):
+        self._bump("decision.spf.fallback_solves")
+        db = self.fallback.build_route_db(
+            my_node_name, area_link_states, prefix_state
+        )
+        self._sync_backend_stats(self.fallback)
+        return db
+
+    def _record_failure(
+        self, kind: str, exc: BaseException, elapsed_s: Optional[float] = None
+    ) -> None:
+        self.last_fault_kind = kind
+        self.consecutive_failures += 1
+        self._bump("decision.spf.solver_failures")
+        self._bump(f"decision.spf.solver_failures.{kind}")
+        log.warning(
+            "supervised solve failure #%d (%s): %s",
+            self.consecutive_failures,
+            kind,
+            exc,
+        )
+        if elapsed_s is not None and self.watchdog is not None:
+            note = getattr(self.watchdog, "note_slow", None)
+            if note is not None:
+                note(
+                    self.config.watchdog_module,
+                    elapsed_s,
+                    self.config.solve_deadline_s,
+                )
+        if (
+            self.state == CLOSED
+            and self.consecutive_failures >= self.config.failure_threshold
+        ):
+            self._trip()
+
+    def _record_success(self) -> None:
+        self.consecutive_failures = 0
+
+    def _trip(self) -> None:
+        log.error(
+            "solver circuit breaker TRIPPED after %d consecutive failures "
+            "(last fault: %s); serving from CPU oracle",
+            self.consecutive_failures,
+            self.last_fault_kind,
+        )
+        self.state = OPEN
+        self._bump("decision.spf.breaker_trips")
+        self.counters["decision.spf.fallback_active"] = 1
+        self.probe_streak = 0
+        self._probe_backoff.report_success()  # fresh probe schedule
+        self._next_probe_at = self._clock() + self.config.probe_interval_s
+        # the device-resident warm state is untrustworthy after a fault:
+        # dropping it forces the recovery path to rebuild from cold
+        self._invalidate_primary_warm_state()
+        self._emit_sample(
+            "SOLVER_BREAKER_TRIPPED",
+            {"fault_kind": self.last_fault_kind or ""},
+            {"consecutive_failures": self.consecutive_failures},
+        )
+
+    def _close(self) -> None:
+        log.warning(
+            "solver circuit breaker CLOSED after %d consecutive probe "
+            "successes; primary backend restored",
+            self.probe_streak,
+        )
+        self.state = CLOSED
+        self.counters["decision.spf.fallback_active"] = 0
+        self.consecutive_failures = 0
+        self.probe_streak = 0
+        self._emit_sample("SOLVER_BREAKER_CLOSED", {}, {})
+
+    # -- probes ---------------------------------------------------------
+
+    def probe_due(self) -> bool:
+        if self.state == CLOSED:
+            return False
+        if not self._probe_backoff.can_try_now():
+            return False
+        return self._clock() >= self._next_probe_at
+
+    def maybe_probe(self) -> bool:
+        """Run one health probe if the schedule says so; returns whether a
+        probe ran. Exposed for tests and loop-less embeddings."""
+        if not self.probe_due():
+            return False
+        self.probe_now()
+        return True
+
+    def probe_now(self) -> None:
+        """One TPU health-probe solve against the live LSDB (off the hot
+        path: results are discarded, only success/failure matters).
+        Hysteresis: `probe_successes_to_close` consecutive successes close
+        the breaker; one failure resets the streak and backs off."""
+        if self._last_inputs is None or self.state == CLOSED:
+            return
+        self._bump("decision.spf.probe_attempts")
+        my_node_name, area_link_states, prefix_state = self._last_inputs
+        # a probe must prove the DEVICE works, not the cache: drop any
+        # resident solve so this dispatch compiles + solves cold
+        self._invalidate_primary_warm_state()
+        self._touch_watchdog()
+        try:
+            self.primary.build_route_db(
+                my_node_name, area_link_states, prefix_state
+            )
+        except Exception as exc:
+            self._bump("decision.spf.probe_failures")
+            self.last_fault_kind = classify_solver_error(exc)
+            self.probe_streak = 0
+            self.state = OPEN
+            self._probe_backoff.report_error()
+            self._next_probe_at = (
+                self._clock()
+                + self._probe_backoff.get_time_remaining_until_retry()
+            )
+            log.warning("solver health probe failed (%s): %s",
+                        self.last_fault_kind, exc)
+            # a failed probe may have left partial device state around
+            self._invalidate_primary_warm_state()
+            return
+        finally:
+            self._touch_watchdog()
+        self._bump("decision.spf.probe_successes")
+        self._sync_backend_stats(self.primary)  # probe solve stats, live
+        self.probe_streak += 1
+        self._probe_backoff.report_success()
+        self._next_probe_at = self._clock() + self.config.probe_interval_s
+        if self.probe_streak >= self.config.probe_successes_to_close:
+            self._close()
+        else:
+            self.state = HALF_OPEN
+
+    # -- warm-state audit ------------------------------------------------
+
+    def _maybe_audit(
+        self, db, my_node_name, area_link_states, prefix_state
+    ):
+        if self.config.audit_interval <= 0:
+            return db
+        audit = getattr(self.primary, "audit_warm_state", None)
+        if audit is None:
+            return db
+        self._solves_since_audit += 1
+        if self._solves_since_audit < self.config.audit_interval:
+            return db
+        self._solves_since_audit = 0
+        self._bump("decision.spf.audit_runs")
+        mismatches = audit()
+        if not mismatches:
+            return db
+        self._bump("decision.spf.audit_mismatches", len(mismatches))
+        for m in mismatches:
+            log.error(
+                "warm-state audit mismatch in area %s (node %s): "
+                "%d diverged entries, max |delta|=%d",
+                m["area"], m["node"], m["entries"], m["max_abs_delta"],
+            )
+        self._emit_sample(
+            "WARM_STATE_AUDIT_MISMATCH",
+            {"areas": ",".join(m["area"] for m in mismatches)},
+            {
+                "mismatched_areas": len(mismatches),
+                "mismatched_entries": sum(
+                    m["entries"] for m in mismatches
+                ),
+            },
+        )
+        # self-heal: drop the diverged warm state and re-solve cold —
+        # the corrected routes replace the suspect ones this same event
+        self._invalidate_primary_warm_state()
+        self._bump("decision.spf.audit_forced_cold_solves")
+        db = self.primary.build_route_db(
+            my_node_name, area_link_states, prefix_state
+        )
+        self._sync_backend_stats(self.primary)
+        return db
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    def _invalidate_primary_warm_state(self) -> None:
+        invalidate = getattr(self.primary, "invalidate_warm_state", None)
+        if invalidate is not None:
+            invalidate()
+            # invalidations happen on background paths (trips, probes) —
+            # sync immediately so monitor surfaces read them live
+            self._sync_backend_stats(self.primary)
+
+    def _touch_watchdog(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.touch(self.config.watchdog_module)
+
+    def _sync_backend_stats(self, backend) -> None:
+        """Fold the serving backend's decision.spf.* counters/histograms
+        into this facade's dicts (Decision's sync loop reads only these)."""
+        counters = getattr(backend, "counters", None)
+        if isinstance(counters, dict):
+            for key, value in counters.items():
+                if key.startswith("decision.spf."):
+                    self.counters[key] = value
+        ensure = getattr(backend, "_ensure_histograms", None)
+        if ensure is not None:
+            for key, hist in ensure().items():
+                if key.startswith("decision.spf."):
+                    self._ensure_histograms()[key] = hist
+
+    def _emit_sample(self, event: str, strings: Dict, ints: Dict) -> None:
+        if self._log_sample_fn is None:
+            return
+        from openr_tpu.monitor.monitor import LogSample
+
+        sample = LogSample()
+        sample.add_string("event", event)
+        sample.add_string("breaker_state", self.state)
+        for k, v in strings.items():
+            sample.add_string(k, v)
+        for k, v in ints.items():
+            sample.add_int(k, v)
+        try:
+            self._log_sample_fn(sample)
+        except Exception:  # a full/closed monitor queue must not hurt solves
+            log.exception("failed to emit solver supervisor log sample")
+
+    def health(self) -> Dict:
+        """Degraded-flag surface served by ctrl getSolverHealth and
+        `breeze decision solver-health`."""
+        return {
+            "degraded": self.state != CLOSED,
+            "breaker_state": self.state,
+            "fallback_active": int(self.state != CLOSED),
+            "consecutive_failures": self.consecutive_failures,
+            "probe_streak": self.probe_streak,
+            "last_fault_kind": self.last_fault_kind,
+            "probe_attempts": self.counters.get(
+                "decision.spf.probe_attempts", 0
+            ),
+            "probe_successes": self.counters.get(
+                "decision.spf.probe_successes", 0
+            ),
+            "probe_failures": self.counters.get(
+                "decision.spf.probe_failures", 0
+            ),
+            "audit_runs": self.counters.get("decision.spf.audit_runs", 0),
+            "audit_mismatches": self.counters.get(
+                "decision.spf.audit_mismatches", 0
+            ),
+        }

@@ -47,6 +47,7 @@ from openr_tpu.ops.spf import (
     sell_fixpoint_masked,
 )
 from openr_tpu.solver.cpu import Metric, SpfSolver
+from openr_tpu.testing.faults import fault_point
 
 
 # fixed per-bucket patch width for the fused patch+solve executable; events
@@ -223,6 +224,10 @@ class _AreaSolve:
         return jax.device_put(jnp.asarray(x), NamedSharding(self.mesh, P()))
 
     def _solve(self) -> None:
+        # named fault seam: the supervisor's error-classification/breaker
+        # tests inject compile/runtime/device-loss faults here, exactly
+        # where a real XLA dispatch would raise
+        fault_point("solver.tpu.solve", self)
         me = self.me
         neighbors = sorted(
             {
@@ -271,6 +276,9 @@ class _AreaSolve:
         self._ksp: Dict[Tuple[str, int], List[Path]] = {}
         self._nh_links: Optional[List[str]] = None
         self._nh_mask: Optional[np.ndarray] = None
+        # corruption seam (ctx = this solve): the warm-state audit tests
+        # perturb the resident D here to prove divergence detection works
+        fault_point("solver.tpu.warm_d", self)
 
     def _sell_solve_resident(self, rows: np.ndarray):
         """Sliced-ELL solve against persistent device buffers; returns
@@ -314,13 +322,34 @@ class _AreaSolve:
             )
         else:
             ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
+            ov_seed_edges = np.empty(0, dtype=np.int64)
             if ov_changed:
+                # an overload toggle is a transit-mask change, but it is
+                # expressible as weight increases on the node's incident
+                # edges: for every other source, a newly-overloaded node's
+                # out-edges just rose to INF, so exactly the entries whose
+                # old shortest path witnesses one of those edges must be
+                # invalidated — the same seed shape as a metric increase.
+                # Un-overloading only ADDS paths (the old D stays an upper
+                # bound) and warm-starts as-is. Either way the toggle rides
+                # the existing warm invalidation path instead of forcing a
+                # cold solve (ROADMAP open item).
+                newly_on = np.nonzero(g.overloaded & ~st["ov_host"])[0]
+                if len(newly_on):
+                    ov_seed_edges = np.nonzero(
+                        np.isin(g.src[: g.e], newly_on)
+                    )[0]
+                    # down edges (old weight INF) are never on the old DAG
+                    ov_seed_edges = ov_seed_edges[
+                        st["w_host"][ov_seed_edges] < INF
+                    ]
                 st["ov"] = self._replicated(g.overloaded)
                 st["ov_host"] = g.overloaded.copy()
                 self.h2d_bytes += g.overloaded.nbytes
             # warm start needs the previous fixpoint to describe the same
             # problem modulo edge weights: identical source batch (a flap
-            # adjacent to me changes the rows) and identical transit mask
+            # adjacent to me changes the rows); transit-mask changes are
+            # folded into the invalidation seeds above
             rows_same = np.array_equal(st["rows"], rows)
             st["rows"] = np.array(rows)
             if (
@@ -334,11 +363,19 @@ class _AreaSolve:
             else:
                 changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
             st["w_ver"] = g.version  # snapshot is current even if no diff
-            if len(changed):
+            if len(changed) or ov_changed:
                 # classify vs the weights that produced the resident D —
                 # increases invalidate, decreases warm-start as-is
                 increased = changed[g.w[changed] > st["w_host"][changed]]
                 st["w_host"][changed] = g.w[changed]
+                # invalidation seed set: weight increases plus the
+                # out-edges of newly-overloaded nodes (duplicates are
+                # harmless — seeding is an idempotent boolean max)
+                inc_edges = (
+                    np.concatenate([increased, ov_seed_edges])
+                    if len(ov_seed_edges)
+                    else increased
+                )
                 # fused patch+solve: one dispatch carries the changed slots
                 # and returns the distances plus the patched buffers, which
                 # stay device-resident for the next event. The patch shape
@@ -352,6 +389,11 @@ class _AreaSolve:
                     changed[sell.edge_bucket[changed] == k]
                     for k in range(nb)
                 ]
+                fits_inc = all(
+                    np.count_nonzero(sell.edge_bucket[inc_edges] == k)
+                    <= _PATCH_SLOTS
+                    for k in range(nb)
+                )
                 if all(len(s_) <= _PATCH_SLOTS for s_ in per_bucket):
                     idx = np.full(
                         (nb, _PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
@@ -374,14 +416,14 @@ class _AreaSolve:
                     if (
                         self.warm_start
                         and rows_same
-                        and not ov_changed
+                        and fits_inc
                         and self._d_dev is not None
                     ):
                         inc_idx = np.full(
                             (nb, _PATCH_SLOTS, 2), 1 << 30, dtype=np.int32
                         )
                         for k in range(nb):
-                            sel = increased[sell.edge_bucket[increased] == k]
+                            sel = inc_edges[sell.edge_bucket[inc_edges] == k]
                             if len(sel):
                                 inc_idx[k, : len(sel), 0] = sell.edge_row[sel]
                                 inc_idx[k, : len(sel), 1] = sell.edge_slot[sel]
@@ -394,22 +436,26 @@ class _AreaSolve:
                         self.incremental_solves += 1
                         self.invalidation_rounds_last = int(inv_rounds)
                         return d, int(rounds)
-                    fn = _sell_solver_patched(sell.shape_key(), self.mesh)
-                    d, new_wgs, rounds = fn(*args)
-                    st["wgs"] = new_wgs
-                    self.full_solves += 1
-                    return d, int(rounds)
-                wgs = list(st["wgs"])
-                for k, sel in enumerate(per_bucket):
-                    if len(sel):
-                        wgs[k] = (
-                            wgs[k]
-                            .at[sell.edge_row[sel], sell.edge_slot[sel]]
-                            .set(jnp.asarray(g.w[sel]))
-                        )
-                        # standalone scatters: row/slot index + value uploads
-                        self.h2d_bytes += 3 * 4 * len(sel)
-                st["wgs"] = tuple(wgs)
+                    if len(changed):
+                        fn = _sell_solver_patched(sell.shape_key(), self.mesh)
+                        d, new_wgs, rounds = fn(*args)
+                        st["wgs"] = new_wgs
+                        self.full_solves += 1
+                        return d, int(rounds)
+                    # overload-only event with warm start unavailable:
+                    # nothing to patch — plain cold solve below
+                elif len(changed):
+                    wgs = list(st["wgs"])
+                    for k, sel in enumerate(per_bucket):
+                        if len(sel):
+                            wgs[k] = (
+                                wgs[k]
+                                .at[sell.edge_row[sel], sell.edge_slot[sel]]
+                                .set(jnp.asarray(g.w[sel]))
+                            )
+                            # standalone scatters: row/slot + value uploads
+                            self.h2d_bytes += 3 * 4 * len(sel)
+                    st["wgs"] = tuple(wgs)
 
         fn = _sell_solver_counted(sell.shape_key(), self.mesh)
         d, rounds = fn(
@@ -471,6 +517,24 @@ class _AreaSolve:
             return
         self.graph = refresh_graph(self.graph, self.link_state)
         self._solve()
+
+    def cold_reference_d(self) -> np.ndarray:
+        """Shadow cold solve from the HOST-side graph truth (the compiled
+        arrays kept current by refresh_graph), independent of both the
+        persistent device buffers and the resident distance state.
+
+        This is the warm-state audit comparator: a diverged device-resident
+        D (bit flip, donation bug, missed patch) differs from this
+        recomputation, while an honest warm fixpoint is bit-identical to
+        it. Runs off the hot path — no buffers are touched or reused."""
+        rows = np.array(
+            [self.graph.node_index[s] for s in self.sources], dtype=np.int32
+        )
+        s_pad = self._batch_pad(len(rows), minimum=8)
+        rows = np.concatenate(
+            [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
+        )
+        return np.array(batched_spf(self.graph, rows))
 
     # -- KSP (k-edge-disjoint shortest paths), device-batched ------------
 
@@ -734,6 +798,48 @@ class TpuSpfSolver(SpfSolver):
         stats = compile_cache_stats()
         counters["decision.spf.compile_cache_hits"] = stats["hits"]
         counters["decision.spf.compile_cache_misses"] = stats["misses"]
+
+    # -- fault domain (SolverSupervisor seams) ---------------------------
+
+    def invalidate_warm_state(self) -> None:
+        """Drop every cached device solve: the next build_route_db
+        recompiles the graph and solves cold. The supervisor calls this on
+        breaker trips and audit mismatches — after a device fault or a
+        detected divergence the resident buffers are not to be trusted."""
+        self._solves.clear()
+        self._bump("decision.spf.warm_state_invalidations")
+
+    def audit_warm_state(self) -> List[dict]:
+        """Shadow cold-audit of every resident warm solve: recompute each
+        area's distance matrix from host-side truth and compare entrywise
+        against the warm device-resident D. Returns one record per
+        diverged area (empty list = all clean)."""
+        mismatches: List[dict] = []
+        for (area, node), (_, solve) in self._solves.items():
+            cold = solve.cold_reference_d()
+            warm = solve.d
+            if warm.shape == cold.shape and np.array_equal(warm, cold):
+                continue
+            if warm.shape != cold.shape:
+                entries = -1
+                max_abs = -1
+            else:
+                diff = warm != cold
+                entries = int(diff.sum())
+                max_abs = int(
+                    np.abs(
+                        warm.astype(np.int64) - cold.astype(np.int64)
+                    ).max()
+                )
+            mismatches.append(
+                {
+                    "area": area,
+                    "node": node,
+                    "entries": entries,
+                    "max_abs_delta": max_abs,
+                }
+            )
+        return mismatches
 
     # -- SPF access seam -------------------------------------------------
 

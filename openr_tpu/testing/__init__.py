@@ -7,21 +7,33 @@ single process: Spark discovery over MockIoNetwork mailboxes, KvStore
 flooding over the in-process transport, route programming into
 MockFibHandler. This is the no-cluster multi-node trick the reference's
 OpenrSystemTest builds ring topologies with (tests/OpenrSystemTest.cpp).
+
+Also home of the deterministic fault-injection harness
+(`openr_tpu.testing.faults`): production modules (ops/spf, solver/tpu,
+fib, kvstore) import `fault_point` from that submodule directly, so this
+package __init__ resolves its heavyweight harness exports lazily (PEP 562)
+— importing the faults seam from a hot-path module must not drag the whole
+daemon stack into the import graph.
 """
 
-from openr_tpu.testing.wrapper import OpenrWrapper, VirtualNetwork
-from openr_tpu.testing.decision_harness import (
-    assert_route_delta_equal,
-    decision_route_delta,
-    lsdb_publication,
-    run_decision_backend_parity,
-)
-
-__all__ = [
-    "OpenrWrapper",
-    "VirtualNetwork",
+_WRAPPER_EXPORTS = {"OpenrWrapper", "VirtualNetwork"}
+_HARNESS_EXPORTS = {
     "assert_route_delta_equal",
     "decision_route_delta",
     "lsdb_publication",
     "run_decision_backend_parity",
-]
+}
+
+__all__ = sorted(_WRAPPER_EXPORTS | _HARNESS_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _WRAPPER_EXPORTS:
+        from openr_tpu.testing import wrapper
+
+        return getattr(wrapper, name)
+    if name in _HARNESS_EXPORTS:
+        from openr_tpu.testing import decision_harness
+
+        return getattr(decision_harness, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

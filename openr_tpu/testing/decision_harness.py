@@ -178,6 +178,182 @@ async def run_convergence_trace(
     return monitor, decision, fib
 
 
+def _fib_table(handler) -> dict:
+    """dest -> frozenset of (address, iface) actually programmed."""
+    from openr_tpu.platform import FIB_CLIENT_OPENR
+
+    return {
+        dest: frozenset((nh.address, nh.iface) for nh in route.nexthops)
+        for dest, route in handler.unicast_routes.get(
+            FIB_CLIENT_OPENR, {}
+        ).items()
+    }
+
+
+def run_fault_smoke() -> dict:
+    """FAULT_SMOKE tier-1 smoke: a short Decision(tpu)→Fib flap sequence
+    with one injected solver failure and one injected fib-program failure,
+    asserting convergence completes DEGRADED — the supervised tpu stack's
+    programmed FIB stays identical to an unfaulted CPU-oracle stack fed
+    the same publications, while the breaker serves from the fallback and
+    Fib recovers through its dirty-marking + full-resync path.
+
+    Topology size comes from FAULT_SMOKE_SIDE (grid side, default 3) so CI
+    can scale it; returns a summary dict of the degraded-path evidence.
+    """
+    import os
+
+    from openr_tpu.fib import Fib, FibConfig
+    from openr_tpu.platform import MockFibHandler
+    from openr_tpu.testing.faults import FaultInjector, injected
+    from openr_tpu.topology import build_adj_dbs, grid_edges
+
+    side = int(os.environ.get("FAULT_SMOKE_SIDE", "3"))
+    edges = grid_edges(side)
+    far = f"g{side - 1}_{side - 1}"
+    announcers = {far: ["10.1.0.0/24"], f"g0_{side - 1}": ["10.2.0.0/24"]}
+
+    def build_stack(backend, handler, **decision_kw):
+        kv_q: RWQueue = RWQueue()
+        route_q: ReplicateQueue = ReplicateQueue()
+        decision = Decision(
+            DecisionConfig(
+                my_node_name="g0_0",
+                solver_backend=backend,
+                debounce_min=0.005,
+                debounce_max=0.02,
+                **decision_kw,
+            ),
+            RQueue(kv_q),
+            route_q,
+        )
+        fib = Fib(
+            FibConfig(
+                my_node_name="g0_0",
+                dryrun=False,
+                backoff_min=0.002,
+                backoff_max=0.05,
+                backoff_seed=0,
+            ),
+            handler,
+            route_q.get_reader(),
+        )
+        return kv_q, decision, fib
+
+    async def body() -> dict:
+        tpu_handler = MockFibHandler()
+        cpu_handler = MockFibHandler()
+        # one injected solver failure with failure_threshold=1: the very
+        # first device solve trips the breaker and the event converges
+        # via the CPU fallback — degraded, never wrong
+        kv_tpu, dec_tpu, fib_tpu = build_stack(
+            "tpu",
+            tpu_handler,
+            solver_failure_threshold=1,
+            solver_max_attempts=1,
+            solver_probe_interval_s=3600.0,  # no probe flips mid-smoke
+        )
+        kv_cpu, dec_cpu, fib_cpu = build_stack("cpu", cpu_handler)
+
+        with injected(FaultInjector(seed=1)) as inj:
+            inj.arm("solver.tpu.solve", times=1)
+            inj.arm(
+                "fib.program",
+                times=1,
+                when=lambda ctx: ctx is fib_tpu,  # spare the oracle stack
+            )
+            for module in (dec_tpu, fib_tpu, dec_cpu, fib_cpu):
+                module.start()
+            loop = asyncio.get_running_loop()
+
+            async def converge(timeout=20.0):
+                deadline = loop.time() + timeout
+                while True:
+                    t_tpu, t_cpu = _fib_table(tpu_handler), _fib_table(
+                        cpu_handler
+                    )
+                    if (
+                        t_tpu
+                        and t_tpu == t_cpu
+                        and fib_tpu.has_synced_fib
+                        and not fib_tpu._sync_scheduled
+                    ):
+                        return t_tpu
+                    if loop.time() > deadline:
+                        raise TimeoutError(
+                            f"fault smoke did not converge: "
+                            f"tpu={sorted(map(str, t_tpu))} "
+                            f"cpu={sorted(map(str, t_cpu))}"
+                        )
+                    await asyncio.sleep(0.005)
+
+            try:
+                dbs = build_adj_dbs(edges)
+                kv_tpu.push(lsdb_publication(dbs.values(), announcers))
+                kv_cpu.push(lsdb_publication(dbs.values(), announcers))
+                table1 = await converge()
+
+                # flap: bump one spine link's metric and republish the
+                # two endpoint adj dbs (the incremental event path)
+                flapped = [
+                    (a, b, 7 if (a, b) == ("g0_0", "g0_1") else m)
+                    for a, b, m in edges
+                ]
+                dbs2 = build_adj_dbs(flapped)
+                flap_pub = lsdb_publication(
+                    [dbs2["g0_0"], dbs2["g0_1"]]
+                )
+                kv_tpu.push(flap_pub)
+                kv_cpu.push(flap_pub)
+                table2 = await converge()
+            finally:
+                tasks = [
+                    t
+                    for t in (
+                        dec_tpu._task,
+                        dec_cpu._task,
+                        *fib_tpu._tasks,
+                        *fib_cpu._tasks,
+                    )
+                    if t is not None
+                ]
+                for module in (fib_tpu, fib_cpu, dec_tpu, dec_cpu):
+                    module.stop()
+                if tasks:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+
+            health = dec_tpu.get_solver_health()
+            summary = {
+                "converged": bool(table1) and bool(table2),
+                "routes_programmed": len(table2),
+                "solver_faults_fired": inj.fired("solver.tpu.solve"),
+                "fib_faults_fired": inj.fired("fib.program"),
+                "fallback_active": health["fallback_active"],
+                "breaker_state": health["breaker_state"],
+                "solver_failures": dec_tpu.solver.counters.get(
+                    "decision.spf.solver_failures", 0
+                ),
+                "fib_program_failures": fib_tpu.counters.get(
+                    "fib.thrift.failure.add_del_route", 0
+                ),
+                "fib_sync_calls": fib_tpu.counters.get(
+                    "fib.sync_fib_calls", 0
+                ),
+            }
+        assert summary["solver_faults_fired"] == 1, summary
+        assert summary["fib_faults_fired"] == 1, summary
+        assert summary["fallback_active"] == 1, summary
+        assert summary["fib_program_failures"] >= 1, summary
+        assert summary["converged"], summary
+        return summary
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()
+
+
 def run_decision_backend_parity(
     my_node: str,
     publication: Publication,

@@ -114,6 +114,17 @@ class Histogram:
         out.max = self.max
         return out
 
+    def reset(self) -> None:
+        """Clear all recorded samples (the reset-on-read snapshot mode:
+        dashboards export-then-reset to turn lifetime-cumulative
+        histograms into per-window rates)."""
+        for i in range(len(self.buckets)):
+            self.buckets[i] = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
     def percentile(self, p: float) -> float:
         """p-th percentile (0..100); 0.0 when empty."""
         if self.count == 0:

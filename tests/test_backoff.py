@@ -1,0 +1,135 @@
+"""ExponentialBackoff: reference doubling semantics (the backward-compat
+default) and the opt-in decorrelated jitter used by Fib full-sync
+scheduling to break up synchronized resync storms."""
+
+import random
+
+from openr_tpu.utils.backoff import ExponentialBackoff
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestDoublingDefault:
+    def test_doubles_and_caps(self):
+        clock = FakeClock()
+        b = ExponentialBackoff(1.0, 8.0, clock=clock)
+        expected = [1.0, 2.0, 4.0, 8.0, 8.0]
+        for want in expected:
+            b.report_error()
+            assert b.get_current_backoff() == want
+        assert b.at_max_backoff()
+
+    def test_success_clears(self):
+        b = ExponentialBackoff(1.0, 8.0, clock=FakeClock())
+        b.report_error()
+        b.report_success()
+        assert b.get_current_backoff() == 0.0
+        assert b.can_try_now()
+
+    def test_time_remaining(self):
+        clock = FakeClock()
+        b = ExponentialBackoff(1.0, 8.0, clock=clock)
+        b.report_error()
+        assert b.get_time_remaining_until_retry() == 1.0
+        clock.t = 0.5
+        assert b.get_time_remaining_until_retry() == 0.5
+        clock.t = 1.5
+        assert b.can_try_now()
+
+
+class TestDecorrelatedJitter:
+    def test_bounds_hold_over_many_draws(self):
+        # every draw lands in [initial, min(max, 3*prev)] — the jitter
+        # never undercuts the floor nor overshoots the cap
+        rng = random.Random(42)
+        b = ExponentialBackoff(
+            0.008, 4.096, clock=FakeClock(), jitter=True, rng=rng
+        )
+        prev = 0.008
+        for _ in range(200):
+            b.report_error()
+            cur = b.get_current_backoff()
+            assert 0.008 <= cur <= 4.096
+            assert cur <= min(4.096, prev * 3) + 1e-12
+            prev = cur
+
+    def test_draws_are_actually_spread(self):
+        # two agents failing in lockstep with different seeds must NOT
+        # produce the same retry schedule — that is the whole point
+        def schedule(seed):
+            b = ExponentialBackoff(
+                1.0, 64.0, clock=FakeClock(),
+                jitter=True, rng=random.Random(seed),
+            )
+            out = []
+            for _ in range(8):
+                b.report_error()
+                out.append(b.get_current_backoff())
+            return out
+
+        assert schedule(1) != schedule(2)
+        # and a fixed seed is fully deterministic (replayable tests)
+        assert schedule(3) == schedule(3)
+
+    def test_success_resets_jittered_state(self):
+        b = ExponentialBackoff(
+            1.0, 8.0, clock=FakeClock(), jitter=True,
+            rng=random.Random(0),
+        )
+        b.report_error()
+        b.report_success()
+        assert b.get_current_backoff() == 0.0
+        b.report_error()
+        # after a reset the next draw is back in the first-error range
+        assert 1.0 <= b.get_current_backoff() <= 3.0
+
+    def test_default_has_no_jitter(self):
+        # backward compat: absent the opt-in flag, behavior is bit-exact
+        # deterministic doubling, no RNG consumed
+        b = ExponentialBackoff(1.0, 8.0, clock=FakeClock())
+        b.report_error()
+        b.report_error()
+        assert b.get_current_backoff() == 2.0
+
+
+class TestFibUsesJitter:
+    def test_fib_full_sync_backoff_is_jittered_by_default(self):
+        from openr_tpu.fib import Fib, FibConfig
+        from openr_tpu.messaging import RWQueue
+        from openr_tpu.platform import MockFibHandler
+
+        fib = Fib(
+            FibConfig(my_node_name="n", backoff_seed=123),
+            MockFibHandler(),
+            RWQueue(),
+        )
+        assert fib._backoff._jitter is True
+        # injectable seed → deterministic schedule across restarts
+        fib._backoff.report_error()
+        first = fib._backoff.get_current_backoff()
+        fib2 = Fib(
+            FibConfig(my_node_name="n", backoff_seed=123),
+            MockFibHandler(),
+            RWQueue(),
+        )
+        fib2._backoff.report_error()
+        assert fib2._backoff.get_current_backoff() == first
+
+    def test_fib_jitter_can_be_disabled(self):
+        from openr_tpu.fib import Fib, FibConfig
+        from openr_tpu.messaging import RWQueue
+        from openr_tpu.platform import MockFibHandler
+
+        fib = Fib(
+            FibConfig(my_node_name="n", backoff_jitter=False),
+            MockFibHandler(),
+            RWQueue(),
+        )
+        fib._backoff.report_error()
+        assert fib._backoff.get_current_backoff() == 0.008

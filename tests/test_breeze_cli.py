@@ -49,8 +49,26 @@ def ctrl_endpoint():
             hist.record(v)
         _Hists.histograms = {"decision.spf.solve_ms": hist}
         monitor.register_module("decision", _Hists())
+
+        class _FakeDecision:
+            """Solver-health surface only: `decision adj` must still error
+            (no get_adjacency_databases), which test_decision_adj pins."""
+
+            @staticmethod
+            def get_solver_health():
+                return {
+                    "degraded": True,
+                    "breaker_state": "open",
+                    "fallback_active": 1,
+                    "last_fault_kind": "device_loss",
+                }
+
         server = CtrlServer(
-            "cli-node", port=0, kvstore=store, monitor=monitor
+            "cli-node",
+            port=0,
+            kvstore=store,
+            monitor=monitor,
+            decision=_FakeDecision(),
         )
         state["loop"] = loop
         state["port"] = loop.run_until_complete(server.start())
@@ -121,6 +139,34 @@ def test_monitor_histograms(ctrl_endpoint, capsys):
     assert " 3 " in f" {line} "  # count column
     # p50 of {1, 2, 4} interpolates inside the 2.0 bucket
     assert "2." in line
+
+
+def test_decision_solver_health(ctrl_endpoint, capsys):
+    host, port = ctrl_endpoint
+    assert breeze(host, port, "decision", "solver-health") == 0
+    out = capsys.readouterr().out
+    assert "solver: DEGRADED (breaker: open)" in out
+    assert "device_loss" in out
+
+
+def test_monitor_histograms_reset(ctrl_endpoint, capsys):
+    host, port = ctrl_endpoint
+    # --reset exports the window AND clears the sources
+    assert breeze(host, port, "monitor", "histograms", "--reset") == 0
+    line = next(
+        l
+        for l in capsys.readouterr().out.splitlines()
+        if "decision.spf.solve_ms" in l
+    )
+    assert " 3 " in f" {line} "
+    # the next window starts empty
+    assert breeze(host, port, "monitor", "histograms") == 0
+    line = next(
+        l
+        for l in capsys.readouterr().out.splitlines()
+        if "decision.spf.solve_ms" in l
+    )
+    assert " 0 " in f" {line} "
 
 
 def test_connection_refused_exit_code(capsys):

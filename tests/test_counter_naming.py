@@ -127,6 +127,19 @@ def test_scanner_finds_the_counter_surface():
         "decision.debounce_ms",
         "decision.spf.solve_ms",
         "decision.spf.invalidation_rounds_last",
+        # solver fault domain (solver/supervisor.py + tpu.py)
+        "decision.spf.fallback_active",
+        "decision.spf.fallback_solves",
+        "decision.spf.solver_failures",
+        "decision.spf.solver_retries",
+        "decision.spf.breaker_trips",
+        "decision.spf.probe_attempts",
+        "decision.spf.probe_successes",
+        "decision.spf.probe_failures",
+        "decision.spf.audit_runs",
+        "decision.spf.audit_mismatches",
+        "decision.spf.audit_forced_cold_solves",
+        "decision.spf.warm_state_invalidations",
         "fib.program_ms",
         "convergence.e2e_ms",
         "kvstore.num_updates",

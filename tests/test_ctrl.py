@@ -108,6 +108,59 @@ class TestBasics:
 
         run(body())
 
+    def test_get_histograms_reset_on_read(self):
+        """reset: true turns lifetime-cumulative histograms into
+        per-window snapshots (the dashboard rate mode)."""
+        from openr_tpu.utils.counters import Histogram
+
+        async def body():
+            monitor = Monitor("test-node")
+
+            class Fake:
+                histograms = {}
+
+            hist = Histogram()
+            hist.record(2.0)
+            Fake.histograms = {"decision.spf.solve_ms": hist}
+            monitor.register_module("decision", Fake())
+            server, client = await make_server(monitor=monitor)
+            first = await client.call("getHistograms", reset=True)
+            assert first["decision.spf.solve_ms"]["count"] == 1
+            # the source was cleared: a fresh window starts empty
+            empty = await client.call("getHistograms")
+            assert empty["decision.spf.solve_ms"]["count"] == 0
+            hist.record(4.0)
+            hist.record(8.0)
+            second = await client.call("getHistograms", reset=True)
+            assert second["decision.spf.solve_ms"]["count"] == 2
+            assert second["decision.spf.solve_ms"]["min"] == 4.0
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_get_solver_health(self):
+        """The solver fault-domain degraded flag rides the ctrl surface."""
+
+        async def body():
+            class FakeDecision:
+                @staticmethod
+                def get_solver_health():
+                    return {
+                        "degraded": True,
+                        "breaker_state": "open",
+                        "fallback_active": 1,
+                    }
+
+            server, client = await make_server(decision=FakeDecision())
+            health = await client.call("getSolverHealth")
+            assert health["degraded"] is True
+            assert health["breaker_state"] == "open"
+            await client.close()
+            await server.stop()
+
+        run(body())
+
     def test_get_histograms_without_monitor_merges_modules(self):
         """Monitor-less fallback merges the attached modules' histograms
         (same shape the monitor path serves)."""

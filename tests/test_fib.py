@@ -452,3 +452,93 @@ class TestHelpers:
             )
 
         run(body())
+
+
+class TestKeepAliveFaultInjection:
+    """Agent-restart detection driven by the deterministic fault injector
+    (openr_tpu/testing/faults.py): the injector kills/restarts the stub
+    FibService agent exactly when keepAliveCheck polls it, and the module
+    must detect the restart, run a full resync, and recover."""
+
+    def test_injected_agent_restart_triggers_full_resync(self):
+        from openr_tpu.testing.faults import injected
+
+        async def body():
+            fib, handler, route_q, _ = make_fib()
+            fib.start()
+            await handler.wait_for_sync_fib()
+            route_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update=[
+                        unicast_entry("10.0.0.0/24", nh("fe80::1", "eth0")),
+                        unicast_entry("10.0.1.0/24", nh("fe80::2", "eth1")),
+                    ]
+                )
+            )
+            await wait_until(
+                lambda: len(handler.unicast_routes.get(FIB_CLIENT_OPENR, {}))
+                == 2
+            )
+            await fib.keep_alive_check()  # baseline aliveSince recorded
+
+            with injected() as inj:
+                # the agent dies and comes back empty right as the next
+                # keep-alive poll observes it
+                inj.arm(
+                    "fib.keepalive",
+                    times=1,
+                    action=lambda _fib: handler.restart(),
+                )
+                # and the first post-restart full-sync attempt fails too,
+                # so recovery must ride the (jittered) backoff retry path
+                inj.arm("fib.sync", times=1)
+                await fib.keep_alive_check()
+                assert inj.fired("fib.keepalive") == 1
+                assert handler.unicast_routes.get(FIB_CLIENT_OPENR, {}) == {}
+                assert fib.route_state.dirty_route_db
+
+                # restart detected → full resync repopulates the agent
+                await wait_until(
+                    lambda: len(
+                        handler.unicast_routes.get(FIB_CLIENT_OPENR, {})
+                    )
+                    == 2
+                    and not fib.route_state.dirty_route_db
+                )
+                assert inj.fired("fib.sync") == 1
+            assert fib.has_synced_fib
+            assert fib.counters["fib.thrift.failure.sync_fib"] == 1
+            assert fib.counters["fib.sync_fib_calls"] >= 2
+            # a later keep-alive with a stable agent schedules nothing new
+            synced_before = fib.counters["fib.sync_fib_calls"]
+            await fib.keep_alive_check()
+            await asyncio.sleep(0.05)
+            assert fib.counters["fib.sync_fib_calls"] == synced_before
+            fib.stop()
+
+        run(body())
+
+    def test_injected_keepalive_error_counts_and_loop_survives(self):
+        from openr_tpu.testing.faults import FaultInjected, injected
+
+        async def body():
+            fib, handler, route_q, _ = make_fib(keep_alive_interval=0.01)
+            fib.start()
+            await handler.wait_for_sync_fib()
+            with injected() as inj:
+                inj.arm("fib.keepalive", times=2)
+                await wait_until(lambda: inj.fired("fib.keepalive") == 2)
+                await wait_until(
+                    lambda: fib.counters.get("fib.thrift.failure.keepalive")
+                    == 2
+                )
+            # the poll loop survived the injected failures and still
+            # detects a later real restart
+            handler.restart()
+            await wait_until(
+                lambda: getattr(fib, "_latest_alive_since", None)
+                == handler._alive_since
+            )
+            fib.stop()
+
+        run(body())

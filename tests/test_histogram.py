@@ -132,3 +132,64 @@ class TestHistogramsMixin:
         h = m.histograms["fib.program_ms"]
         assert h.count == 1
         assert 0.0 <= h.max < 10_000.0
+
+
+class TestResetOnRead:
+    def test_reset_clears_all_state(self):
+        from openr_tpu.utils.counters import Histogram
+
+        h = Histogram()
+        for v in (0.5, 2.0, 300.0):
+            h.record(v)
+        h.reset()
+        assert h.count == 0
+        assert h.sum == 0.0
+        assert h.min is None and h.max is None
+        assert all(b == 0 for b in h.buckets)
+        # and it keeps recording normally afterwards
+        h.record(7.0)
+        assert h.count == 1 and h.min == 7.0
+
+    def test_monitor_reset_on_read_windows(self):
+        from openr_tpu.monitor import Monitor
+        from openr_tpu.utils.counters import Histogram
+
+        monitor = Monitor("n")
+
+        class Mod:
+            histograms = {}
+
+        hist = Histogram()
+        hist.record(1.0)
+        hist.record(2.0)
+        Mod.histograms = {"decision.debounce_ms": hist}
+        monitor.register_module("decision", Mod())
+
+        window1 = monitor.get_histograms(reset=True)
+        assert window1["decision.debounce_ms"]["count"] == 2
+        hist.record(9.0)
+        window2 = monitor.get_histograms(reset=True)
+        # only the post-reset sample: consecutive exports are disjoint
+        assert window2["decision.debounce_ms"]["count"] == 1
+        assert window2["decision.debounce_ms"]["min"] == 9.0
+        # plain reads never reset
+        assert monitor.get_histograms()["decision.debounce_ms"]["count"] == 0
+
+    def test_shared_histogram_object_merged_and_reset_once(self):
+        """Decision re-exports the solver's histograms by reference; the
+        merge must neither double-count nor double-clear them."""
+        from openr_tpu.monitor import merge_module_histograms
+        from openr_tpu.utils.counters import Histogram
+
+        shared = Histogram()
+        shared.record(3.0)
+
+        class A:
+            histograms = {"decision.spf.solve_ms": shared}
+
+        class B:
+            histograms = {"decision.spf.solve_ms": shared}
+
+        merged = merge_module_histograms([A(), B()], reset=True)
+        assert merged["decision.spf.solve_ms"].count == 1  # not 2
+        assert shared.count == 0

@@ -484,7 +484,10 @@ class TestWarmStartDifferential:
             assert (far >= INF) == down
         assert warm.incremental_solves == 2
 
-    def test_node_overload_toggle_forces_cold(self):
+    def test_node_overload_toggle_rides_warm_path(self):
+        # ROADMAP item closed: an overload toggle is expressed as weight
+        # increases on the node's out-edges and rides the existing warm
+        # invalidation path — differential against cold AND the CPU oracle
         import dataclasses
 
         from openr_tpu.solver.tpu import _AreaSolve
@@ -502,10 +505,37 @@ class TestWarmStartDifferential:
             cold = _AreaSolve(ls, "a")
             np.testing.assert_array_equal(warm.d, cold.d)
             assert_solve_matches_oracle(ls, warm)
-        # a changed transit mask invalidates the resident D wholesale:
-        # both events must re-solve cold, never warm-start
-        assert warm.incremental_solves == 0
-        assert warm.full_solves == full_before + 2
+        # overload ON invalidates via out-edge seeds (inv rounds ran);
+        # overload OFF is decrease-only and warm-starts directly
+        assert warm.incremental_solves == 2
+        assert warm.full_solves == full_before
+
+    def test_node_overload_toggle_grid_differential(self):
+        # the same toggle on a larger graph with ECMP structure: every
+        # event sequence must stay bit-identical to cold + oracle
+        import dataclasses
+
+        from openr_tpu.solver.tpu import _AreaSolve
+
+        edges = grid_edges(4)
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        warm = _AreaSolve(ls, "g0_0")
+        # overload a transit node on the diagonal, then a corner, then heal
+        for node, overloaded in (
+            ("g1_1", True),
+            ("g2_2", True),
+            ("g1_1", False),
+            ("g2_2", False),
+        ):
+            db = dataclasses.replace(dbs[node], is_overloaded=overloaded)
+            dbs[node] = db
+            ls.update_adjacency_database(db)
+            warm.refresh()
+            cold = _AreaSolve(ls, "g0_0")
+            np.testing.assert_array_equal(warm.d, cold.d)
+            assert_solve_matches_oracle(ls, warm)
+        assert warm.incremental_solves == 4
 
     def test_oversized_event_falls_back_to_cold(self, monkeypatch):
         import dataclasses
