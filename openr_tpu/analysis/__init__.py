@@ -1,0 +1,51 @@
+"""Project-specific static analysis suite (docs/Analysis.md).
+
+Four rule families encode this repo's invariants:
+
+  - trace-safety:    no host syncs / Python branches on traced values in
+                     jax.jit-reachable solver code
+  - thread-ownership: ctrl/monitor-reachable methods must not mutate
+                     @owned_by module state without a declared handover
+  - blocking-call:   no synchronous blocking inside event-loop bodies
+  - registry-drift:  counters/histograms, fault points and
+                     DecisionConfigSection knobs match their docs tables
+
+Run it:  python -m openr_tpu.analysis [paths] [--strict] [--json]
+Tier-1:  tests/test_analysis.py self-runs the suite over openr_tpu/.
+"""
+
+from openr_tpu.analysis.core import (  # noqa: F401
+    ANALYSIS_VERSION,
+    AnalysisContext,
+    Finding,
+    RULES,
+    Rule,
+    build_context,
+    render_json,
+    render_text,
+    rule_catalog,
+    run_analysis,
+    run_rules,
+)
+
+# importing the rule modules registers them in RULES
+from openr_tpu.analysis import (  # noqa: F401  (registration side effect)
+    blocking_calls,
+    registry_drift,
+    thread_ownership,
+    trace_safety,
+)
+
+
+def rule_names():
+    return [r["name"] for r in rule_catalog()]
+
+
+def get_analysis_info() -> dict:
+    """Metadata surfaced through utils/build_info.get_build_info and
+    `breeze openr version`: deployed binaries report which invariants
+    they were linted against."""
+    return {
+        "analysis_version": ANALYSIS_VERSION,
+        "analysis_rules": rule_names(),
+    }

@@ -1,0 +1,102 @@
+"""CLI driver: python -m openr_tpu.analysis [paths ...]
+
+Exit code 0 when no (non-baselined) error-severity findings remain, 1
+otherwise. With no paths, analyzes the installed openr_tpu package —
+`python -m openr_tpu.analysis` from a checkout is the pre-PR gate
+(docs/DeveloperGuide.md). `ANALYSIS_STRICT=1` (or --strict) promotes
+advisory rules (thread-ownership) to errors for local runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+from openr_tpu.analysis import (
+    ANALYSIS_VERSION,
+    render_json,
+    render_text,
+    rule_catalog,
+    run_analysis,
+)
+
+BASELINE_NAME = "analysis-baseline.txt"
+
+
+def _default_package() -> Path:
+    return Path(__file__).resolve().parent.parent  # the openr_tpu package
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m openr_tpu.analysis",
+        description="openr-tpu project static analysis suite",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        type=Path,
+        help="files/directories to analyze (default: the openr_tpu "
+        "package)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="promote advisory rules to errors (also: ANALYSIS_STRICT=1)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help=f"waived-findings file (default: <repo>/{BASELINE_NAME})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline file",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--version", action="store_true", help="print the suite version"
+    )
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(f"openr-tpu analysis v{ANALYSIS_VERSION}")
+        return 0
+    if args.list_rules:
+        for rule in rule_catalog():
+            print(
+                f"{rule['name']:<18} [{rule['severity']}] "
+                f"{rule['description']}"
+            )
+        return 0
+    paths = args.paths or [_default_package()]
+    strict = args.strict or os.environ.get("ANALYSIS_STRICT", "") == "1"
+    baseline = args.baseline
+    if baseline is None and not args.no_baseline:
+        # resolve <repo>/analysis-baseline.txt next to the package
+        from openr_tpu.analysis.core import _find_root
+
+        candidate = _find_root(paths) / BASELINE_NAME
+        if candidate.exists():
+            baseline = candidate
+    if args.no_baseline:
+        baseline = None
+    result = run_analysis(paths, strict=strict, baseline_path=baseline)
+    print(render_json(result) if args.json else render_text(result))
+    return result["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""blocking-call: no synchronous blocking inside event-loop code.
+
+Every module (Decision's kvstore consumer, KvStore's flood/full-sync
+tasks, Fib's programming/keepalive loops, the ctrl server's connection
+handlers) shares one asyncio loop: a single synchronous `time.sleep`,
+blocking socket op, or un-deadlined `Future.result()` stalls *all* of
+them — convergence, flooding and the ctrl API freeze together, and the
+Watchdog eventually aborts the process. Flagged inside any `async def`
+(including sync closures defined there, which run as loop callbacks):
+
+  - `time-sleep`: `time.sleep(...)` — use `asyncio.sleep`.
+  - `undeadlined-result`: `<future>.result()` with neither a positional
+    timeout nor a `timeout=` kwarg — an unbounded cross-thread wait.
+  - `blocking-socket`: non-awaited `.recv/.recvfrom/.accept/.sendall/
+    .makefile` calls and `socket.create_connection` /
+    `socket.gethostbyname` / `socket.getaddrinfo` / `select.select` —
+    use the loop's transports (`loop.sock_*`, streams) instead.
+  - `blocking-subprocess`: `subprocess.run/check_output/check_call/call`
+    and `os.system` — use `asyncio.create_subprocess_*`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_SOCKET_METHODS = {"recv", "recvfrom", "accept", "sendall", "makefile"}
+_BLOCKING_MODULE_CALLS = {
+    "time.sleep": ("time-sleep", "use asyncio.sleep"),
+    "socket.create_connection": (
+        "blocking-socket",
+        "use asyncio.open_connection",
+    ),
+    "socket.gethostbyname": (
+        "blocking-socket",
+        "use loop.getaddrinfo",
+    ),
+    "socket.getaddrinfo": ("blocking-socket", "use loop.getaddrinfo"),
+    "select.select": ("blocking-socket", "use loop readers/writers"),
+    "subprocess.run": (
+        "blocking-subprocess",
+        "use asyncio.create_subprocess_exec",
+    ),
+    "subprocess.check_output": (
+        "blocking-subprocess",
+        "use asyncio.create_subprocess_exec",
+    ),
+    "subprocess.check_call": (
+        "blocking-subprocess",
+        "use asyncio.create_subprocess_exec",
+    ),
+    "subprocess.call": (
+        "blocking-subprocess",
+        "use asyncio.create_subprocess_exec",
+    ),
+    "os.system": (
+        "blocking-subprocess",
+        "use asyncio.create_subprocess_shell",
+    ),
+}
+
+
+def _async_defs(tree: ast.AST) -> Iterable[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _awaited_calls(fn) -> Set[int]:
+    """id()s of Call nodes that are directly awaited (await x.recv())."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Await) and isinstance(
+            node.value, ast.Call
+        ):
+            out.add(id(node.value))
+    return out
+
+
+@register
+class BlockingCallRule(Rule):
+    name = "blocking-call"
+    severity = "error"
+    description = (
+        "no time.sleep, blocking socket ops, or un-deadlined .result() "
+        "inside async event-loop bodies"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        for sf in ctx.files:
+            for fn in _async_defs(sf.tree):
+                awaited = _awaited_calls(fn)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    yield from self._check_call(sf, fn, node, awaited)
+
+    def _check_call(self, sf, fn, node, awaited):
+        chain = dotted_name(node.func)
+        if chain in _BLOCKING_MODULE_CALLS:
+            check, fix = _BLOCKING_MODULE_CALLS[chain]
+            yield self.finding(
+                check,
+                sf,
+                node.lineno,
+                f"async '{fn.name}': blocking {chain}(...) stalls the "
+                f"whole event loop — {fix}",
+            )
+            return
+        name = call_name(node)
+        if (
+            name == "result"
+            and isinstance(node.func, ast.Attribute)
+            and not node.args
+            and not any(kw.arg == "timeout" for kw in node.keywords)
+        ):
+            yield self.finding(
+                "undeadlined-result",
+                sf,
+                node.lineno,
+                f"async '{fn.name}': .result() without a timeout is an "
+                f"unbounded blocking wait — pass timeout= or await the "
+                f"future",
+            )
+        elif (
+            name in _SOCKET_METHODS
+            and isinstance(node.func, ast.Attribute)
+            and id(node) not in awaited
+        ):
+            receiver = dotted_name(node.func.value) or ""
+            if "sock" in receiver.lower() or "conn" in receiver.lower():
+                yield self.finding(
+                    "blocking-socket",
+                    sf,
+                    node.lineno,
+                    f"async '{fn.name}': blocking socket op "
+                    f"{receiver}.{name}(...) — use loop.sock_{name} or "
+                    f"streams",
+                )

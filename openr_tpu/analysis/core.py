@@ -1,0 +1,366 @@
+"""Core of the project static-analysis suite.
+
+A small AST-based lint framework encoding this repo's invariants — the
+generalization of tests/test_counter_naming.py into a real analysis layer:
+
+  - rules register themselves in RULES (one Rule per invariant family);
+  - every rule sees the whole parsed file set (AnalysisContext), so
+    project-wide rules (registry drift, ctrl-reachability) are as natural
+    as per-file ones;
+  - findings carry (rule, check, path, line, message, severity);
+  - per-line suppression comments, a checked-in baseline file for waived
+    legacy findings, and text/JSON reporters;
+  - `ANALYSIS_STRICT=1` (or --strict) promotes advisory rules to errors.
+
+Suppression syntax (docs/Analysis.md):
+  # analysis: ignore               suppress every rule on this line
+  # analysis: ignore[rule-name]    suppress one rule (comma-list allowed)
+  # analysis: skip-file            near the top of a file: skip it entirely
+The comment may sit on the flagged line or on the line directly above it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+ANALYSIS_VERSION = "1.0.0"
+
+_IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+_SKIP_FILE_RE = re.compile(r"#\s*analysis:\s*skip-file")
+_SKIP_FILE_SCAN_LINES = 5  # skip-file must appear near the top
+
+
+@dataclass
+class Finding:
+    rule: str  # rule family (registry name)
+    check: str  # sub-check id within the family
+    path: str  # path relative to the analysis root
+    line: int
+    message: str
+    severity: str = "error"  # 'error' | 'advisory'
+
+    def key(self) -> str:
+        """Baseline identity: line numbers drift, messages are stable."""
+        return f"{self.rule}\t{self.path}\t{self.message}"
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "check": self.check,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "severity": self.severity,
+        }
+
+
+@dataclass
+class SourceFile:
+    path: Path  # absolute
+    rel: str  # root-relative, '/'-separated
+    source: str
+    tree: ast.AST
+    lines: List[str]
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a rule may look at: the parsed file set plus the repo
+    layout (docs for the registry-drift cross-checks)."""
+
+    root: Path
+    files: List[SourceFile] = field(default_factory=list)
+    docs_dir: Optional[Path] = None
+    # True when the scan covers the whole package; doc-completeness checks
+    # (e.g. "documented counter is never emitted") only make sense then —
+    # a single-file scan must not report the rest of the package as ghosts
+    full_package: bool = False
+
+    def file(self, rel_suffix: str) -> Optional[SourceFile]:
+        for sf in self.files:
+            if sf.rel.endswith(rel_suffix):
+                return sf
+        return None
+
+
+class Rule:
+    """One invariant family. Subclasses set name/description/severity and
+    implement run(ctx) -> Iterable[Finding]."""
+
+    name: str = ""
+    description: str = ""
+    severity: str = "error"  # default severity of this family's findings
+
+    def run(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, check: str, sf: SourceFile, line: int, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.name,
+            check=check,
+            path=sf.rel,
+            line=line,
+            message=message,
+            severity=self.severity,
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate + register a Rule."""
+    rule = rule_cls()
+    assert rule.name and rule.name not in RULES, rule.name
+    RULES[rule.name] = rule
+    return rule_cls
+
+
+def rule_catalog() -> List[Dict[str, str]]:
+    return [
+        {
+            "name": rule.name,
+            "severity": rule.severity,
+            "description": rule.description,
+        }
+        for rule in sorted(RULES.values(), key=lambda r: r.name)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# file collection
+# ---------------------------------------------------------------------------
+
+
+def _collect_py_files(paths: Sequence[Path]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    # dedupe, stable order
+    seen = set()
+    uniq = []
+    for p in out:
+        rp = p.resolve()
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(rp)
+    return uniq
+
+
+def _find_root(paths: Sequence[Path]) -> Path:
+    """The analysis root: the parent of the `openr_tpu` package when the
+    scanned paths live inside one (so docs/ and the baseline resolve), else
+    the common parent of the inputs."""
+    for p in paths:
+        q = p.resolve()
+        for anc in [q] + list(q.parents):
+            if anc.name == "openr_tpu" and (anc / "__init__.py").exists():
+                return anc.parent
+    first = paths[0].resolve()
+    return first if first.is_dir() else first.parent
+
+
+def build_context(
+    paths: Sequence[Path], root: Optional[Path] = None
+) -> AnalysisContext:
+    files = _collect_py_files(paths)
+    root = (root or _find_root(paths)).resolve()
+    ctx = AnalysisContext(root=root)
+    docs = root / "docs"
+    if docs.is_dir():
+        ctx.docs_dir = docs
+    for path in files:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # unparseable files are not this suite's business
+        try:
+            rel = path.relative_to(root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        ctx.files.append(
+            SourceFile(
+                path=path,
+                rel=rel,
+                source=source,
+                tree=tree,
+                lines=source.splitlines(),
+            )
+        )
+    # whole-package scans carry the monitor module; doc-completeness
+    # cross-checks key off it (see AnalysisContext.full_package)
+    ctx.full_package = any(
+        sf.rel.endswith("monitor/monitor.py") for sf in ctx.files
+    )
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+
+def _line_suppresses(line: str, rule: str) -> bool:
+    m = _IGNORE_RE.search(line)
+    if not m:
+        return False
+    if m.group(1) is None:
+        return True
+    rules = {r.strip() for r in m.group(1).split(",")}
+    return rule in rules
+
+
+def is_suppressed(sf: SourceFile, finding: Finding) -> bool:
+    if any(
+        _SKIP_FILE_RE.search(line)
+        for line in sf.lines[:_SKIP_FILE_SCAN_LINES]
+    ):
+        return True
+    idx = finding.line - 1
+    for i in (idx, idx - 1):
+        if 0 <= i < len(sf.lines) and _line_suppresses(
+            sf.lines[i], finding.rule
+        ):
+            return True
+    return False
+
+
+def load_baseline(path: Optional[Path]) -> set:
+    """Waived finding keys, one per line (tab-separated rule/path/message);
+    '#' comments and blank lines ignored. The shipped baseline is empty —
+    new waivers need a comment explaining why (docs/Analysis.md)."""
+    if path is None or not path.exists():
+        return set()
+    keys = set()
+    for line in path.read_text().splitlines():
+        line = line.rstrip("\n")
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        keys.add(line)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def run_rules(
+    ctx: AnalysisContext, strict: bool = False
+) -> Tuple[List[Finding], int]:
+    """(kept findings, suppressed count). Suppressions apply per line;
+    strict promotes advisory findings to errors."""
+    by_rel = {sf.rel: sf for sf in ctx.files}
+    kept: List[Finding] = []
+    suppressed = 0
+    for rule in RULES.values():
+        for finding in rule.run(ctx):
+            sf = by_rel.get(finding.path)
+            if sf is not None and is_suppressed(sf, finding):
+                suppressed += 1
+                continue
+            if strict and finding.severity == "advisory":
+                finding.severity = "error"
+            kept.append(finding)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept, suppressed
+
+
+def run_analysis(
+    paths: Sequence[Path],
+    root: Optional[Path] = None,
+    strict: bool = False,
+    baseline_path: Optional[Path] = None,
+) -> Dict:
+    """End-to-end run: returns a result dict (findings, counts, exit code).
+
+    Exit semantics: non-zero iff any non-baselined error-severity finding
+    remains. Advisory findings are reported but do not fail the run unless
+    strict mode promoted them.
+    """
+    ctx = build_context(paths, root=root)
+    findings, suppressed = run_rules(ctx, strict=strict)
+    baseline = load_baseline(baseline_path)
+    baselined = [f for f in findings if f.key() in baseline]
+    active = [f for f in findings if f.key() not in baseline]
+    errors = [f for f in active if f.severity == "error"]
+    return {
+        "version": ANALYSIS_VERSION,
+        "rules": [r["name"] for r in rule_catalog()],
+        "files": len(ctx.files),
+        "findings": active,
+        "errors": len(errors),
+        "advisories": len(active) - len(errors),
+        "suppressed": suppressed,
+        "baselined": len(baselined),
+        "exit_code": 1 if errors else 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+def render_text(result: Dict) -> str:
+    out = []
+    for f in result["findings"]:
+        out.append(
+            f"{f.path}:{f.line}: [{f.rule}/{f.check}] "
+            f"{f.severity}: {f.message}"
+        )
+    out.append(
+        f"analysis v{result['version']}: {result['files']} files, "
+        f"{result['errors']} error(s), {result['advisories']} advisory, "
+        f"{result['suppressed']} suppressed, "
+        f"{result['baselined']} baselined"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: Dict) -> str:
+    payload = dict(result)
+    payload["findings"] = [f.to_dict() for f in result["findings"]]
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several rules)
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains (Call links descend through the
+    callee, so `self.kvstore.db(area).set_key_vals` roots at self)."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        else:
+            return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Bare or attribute call name: f(...) -> 'f', a.b.f(...) -> 'f'."""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None

@@ -1,0 +1,337 @@
+"""thread-ownership: externally-reachable methods must not mutate owned
+module state without a declared handover.
+
+Every module's mutable attributes are owned by that module's task set
+(`@owned_by("decision-loop")` on the class, utils/ownership.py); the ctrl
+server's per-connection tasks and the monitor's drain task call into
+modules from outside that ownership. A mutation on such a path is exactly
+the class of bug SolverSupervisor's shadow audit only detects *after* the
+fact — this rule catches it before merge.
+
+Mechanics:
+  - The external surface is computed from the ctrl server itself: every
+    method name invoked on a module reference (`self.kvstore...`,
+    `self.decision...`, including chained receivers like
+    `self.kvstore.db(area).set_key_vals`) inside a class named CtrlServer,
+    plus the module attributes the Monitor reads (`counters`,
+    `histograms` — rebinding those swaps the dict under the monitor).
+  - For every class carrying a class-level `@owned_by(...)`, each method
+    whose name is on that surface is an entry point; reachability closes
+    over same-class `self.method()` calls.
+  - Flagged inside reachable methods: attribute (re)binding
+    (`self.x = ...`, `self.a.b = ...`, `self.x[...] = ...`, aug-assign,
+    `del`) and mutating container calls on self-rooted receivers
+    (`self.links.add(...)`, `.pop`, `.update`, ...).
+
+Declared handovers (not flagged):
+  - the entry method is marked shared — `# analysis: shared` on its `def`
+    line (or the line above), or a method-level `@owned_by("...")`
+    decorator. A shared method must be synchronous: it then runs
+    loop-serialized with the owner's callbacks (one asyncio loop), which
+    is the architectural reason these handovers are safe. An *async*
+    shared method is flagged regardless — it can interleave at awaits.
+  - the mutation is lexically inside `with`/`async with` on a context
+    whose name mentions a lock (`self._program_lock`, ...).
+  - the attribute's `__init__` assignment carries `# analysis: shared`.
+
+Severity is advisory by default (reachability is name-based and therefore
+heuristic); `ANALYSIS_STRICT=1` promotes it. Aliased mutation
+(`d = self.x; d[k] = v`) is out of scope — the convention is to mutate
+through `self` so the analyzer can see it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+# module references a CtrlServer/Monitor holds (composition in openr.py)
+MODULE_ATTRS = {
+    "kvstore",
+    "decision",
+    "fib",
+    "link_monitor",
+    "prefix_manager",
+    "prefix_allocator",
+    "monitor",
+    "config_store",
+    "spark",
+}
+# attributes the Monitor aggregates directly off module objects: rebinding
+# them from an external path swaps the object under the aggregator
+MONITOR_READ_ATTRS = {"counters", "histograms"}
+
+_MUTATOR_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+}
+_SHARED_RE = re.compile(r"#\s*analysis:\s*shared\b")
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _decorator_owner(node) -> Optional[str]:
+    """The owner string of an @owned_by("...") decorator, if present."""
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name.split(".")[-1] == "owned_by":
+            if isinstance(dec, ast.Call) and dec.args:
+                arg = dec.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    return arg.value
+            return "?"
+    return None
+
+
+def external_surface(ctx: AnalysisContext) -> Set[str]:
+    """Method names invoked on module references from the ctrl server."""
+    surface: Set[str] = set()
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.ClassDef) and node.name == "CtrlServer"
+            ):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ):
+                    chain = dotted_name(sub.func)
+                    if chain is None:
+                        continue
+                    parts = chain.split(".")
+                    if (
+                        len(parts) >= 3
+                        and parts[0] == "self"
+                        and parts[1] in MODULE_ATTRS
+                    ):
+                        surface.add(parts[-1])
+    return surface
+
+
+def _method_is_shared(sf: SourceFile, fn) -> bool:
+    if _decorator_owner(fn) is not None:
+        return True
+    for i in (fn.lineno - 1, fn.lineno - 2):
+        if 0 <= i < len(sf.lines) and _SHARED_RE.search(sf.lines[i]):
+            return True
+    return False
+
+
+def _shared_attrs(sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
+    """Attributes whose __init__ assignment is marked `# analysis: shared`."""
+    shared: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, _FuncDef) and node.name == "__init__":
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        sub.targets
+                        if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr_root(t)
+                        if attr and _SHARED_RE.search(
+                            sf.lines[sub.lineno - 1]
+                        ):
+                            shared.add(attr)
+    return shared
+
+
+def _self_attr_root(node: ast.AST) -> Optional[str]:
+    """First attribute name of a self-rooted chain: self.x[...] -> 'x',
+    self.a.b -> 'a'; None when not rooted at bare self."""
+    chain: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and chain:
+        return chain[-1]
+    return None
+
+
+def _lock_guarded(stack: List[ast.AST]) -> bool:
+    for node in stack:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = dotted_name(item.context_expr) or ""
+                if "lock" in name.lower():
+                    return True
+    return False
+
+
+def _walk_with_stack(fn) -> Iterable[Tuple[ast.AST, List[ast.AST]]]:
+    """(node, enclosing-statement stack), not descending into nested defs."""
+
+    def rec(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncDef):
+                continue
+            yield child, stack
+            yield from rec(child, stack + [child])
+
+    yield from rec(fn, [])
+
+
+def _mutations(fn) -> Iterable[Tuple[int, str, str]]:
+    """(line, attr, description) of owned-state mutations in one method."""
+    for node, stack in _walk_with_stack(fn):
+        if _lock_guarded(stack + [node]):
+            continue
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr_root(t)
+                if attr:
+                    yield node.lineno, attr, f"assignment to self.{attr}"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr_root(t)
+                if attr:
+                    yield node.lineno, attr, f"del of self.{attr}"
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS
+        ):
+            attr = _self_attr_root(node.func.value)
+            if attr:
+                yield (
+                    node.lineno,
+                    attr,
+                    f"self.{attr}.{node.func.attr}(...)",
+                )
+
+
+@register
+class ThreadOwnershipRule(Rule):
+    name = "thread-ownership"
+    severity = "advisory"
+    description = (
+        "ctrl/monitor-reachable methods of @owned_by classes must not "
+        "mutate owned state without a lock or a '# analysis: shared' "
+        "handover (shared methods must be synchronous)"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        surface = external_surface(ctx)
+        if not surface:
+            return  # no ctrl server in scope; nothing is reachable
+        for sf in ctx.files:
+            for cls in ast.walk(sf.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                owner = _decorator_owner(cls)
+                if owner is None:
+                    continue
+                yield from self._check_class(sf, cls, owner, surface)
+
+    def _check_class(self, sf, cls, owner, surface):
+        methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body if isinstance(n, _FuncDef)
+        }
+        shared_attrs = _shared_attrs(sf, cls)
+        # the monitor aggregates module.counters / module.histograms by
+        # reference: rebinding either outside __init__ swaps the object
+        # under the aggregator — flag it from ANY method of an owned class
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue
+            for node, _ in _walk_with_stack(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr in MONITOR_READ_ATTRS
+                        ):
+                            yield self.finding(
+                                "monitor-rebind",
+                                sf,
+                                node.lineno,
+                                f"{cls.name}.{name} rebinds "
+                                f"self.{t.attr}: the monitor holds the "
+                                f"old dict by reference — mutate in "
+                                f"place instead",
+                            )
+        for name, fn in methods.items():
+            if name not in surface or name.startswith("__"):
+                continue
+            if _method_is_shared(sf, fn):
+                # declared handover — but it only holds for synchronous
+                # methods (loop-serialized with the owner's callbacks)
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    yield self.finding(
+                        "async-shared",
+                        sf,
+                        fn.lineno,
+                        f"{cls.name}.{name} is declared shared but is "
+                        f"async: it can interleave with the "
+                        f"'{owner}' owner at every await",
+                    )
+                continue
+            # close reachability over same-class self.method() calls,
+            # stopping at declared-shared methods (already vetted)
+            seen: Set[str] = set()
+            queue = [name]
+            while queue:
+                cur = queue.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                cur_fn = methods.get(cur)
+                if cur_fn is None:
+                    continue
+                if cur != name and _method_is_shared(sf, cur_fn):
+                    continue
+                for line, attr, what in _mutations(cur_fn):
+                    if attr in shared_attrs:
+                        continue
+                    via = "" if cur == name else f" (via {cls.name}.{cur})"
+                    yield self.finding(
+                        "unowned-mutation",
+                        sf,
+                        line,
+                        f"{cls.name}.{name} is reachable from the ctrl "
+                        f"server but mutates '{owner}'-owned state: "
+                        f"{what}{via} — mark the method "
+                        f"'# analysis: shared' (sync only), take a "
+                        f"lock, or mark the attribute shared in "
+                        f"__init__",
+                    )
+                for node, _ in _walk_with_stack(cur_fn):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods
+                    ):
+                        queue.append(node.func.attr)

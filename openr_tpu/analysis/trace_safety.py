@@ -1,0 +1,315 @@
+"""trace-safety: no host syncs or Python control flow on traced values.
+
+The solver hot path (ops/spf.py, solver/tpu.py, parallel/mesh.py) lives
+inside `jax.jit`; the paper's wins die the moment a traced function forces
+an implicit host transfer (the tensorized Floyd–Warshall lesson, PAPERS.md).
+This rule finds the functions that trace — decorated with `jax.jit`, passed
+to a `jax.jit(...)`/`shard_map(...)` call, nested inside a traced function,
+or called by name from one (per module, transitively) — and flags, inside
+them:
+
+  - `python-branch`: an `if`/`while`/conditional-expression test that
+    contains a jnp/jax call (tracer-valued: `if jnp.any(...)` forces a
+    concretization error or a silent host sync) or, in *directly* jitted
+    functions where every parameter is a tracer, a bare parameter used in
+    the test. Static introspection (`x.ndim`, `x.shape`, `x.dtype`,
+    `len(x)`, `isinstance(...)`) is exempt — branching on trace-time
+    constants is the shape-bucketing idiom this codebase is built on.
+  - `host-sync`: `.item()` / `.tolist()` calls, `float()/int()/bool()` of
+    a tracer-valued expression, and any `np.*` call — numpy round-trips
+    device data through the host mid-trace.
+  - `nonstatic-carry`: a Python `list`/`dict`/`set` literal (or
+    constructor call) as the carry/init operand of
+    `lax.while_loop`/`fori_loop`/`scan` — non-static containers in carry
+    state retrace per call and defeat executable reuse.
+
+Indirectly traced functions skip the bare-parameter branch check: their
+parameters can be trace-time statics threaded from the shape key
+(`zero_end`, `starts`, `shapes` in ops/spf.py), and flagging those would
+bury the real signal. Precision over recall; the jnp-call and host-sync
+checks still apply everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_STATIC_ATTRS = {"ndim", "shape", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "range", "enumerate", "zip"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_CAST_CALLS = {"float", "int", "bool"}
+# carry/init argument position per structured-control-flow primitive
+_CARRY_ARG = {"while_loop": 2, "fori_loop": 3, "scan": 1}
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _jax_numpy_aliases(tree: ast.AST) -> Set[str]:
+    """Module aliases whose calls are tracer-valued (jax.numpy, jax, lax)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax", "jax.numpy", "jax.lax"):
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("jax", "jax.numpy", "jax.lax"):
+                for a in node.names:
+                    if a.name in ("numpy", "lax"):
+                        aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _numpy_aliases(tree: ast.AST) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _is_jit_entry(call: ast.Call) -> bool:
+    """jax.jit(...) / jit(...) / shard_map(...) call."""
+    name = call_name(call)
+    return name in ("jit", "shard_map")
+
+
+def _jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        base = dotted_name(target) or ""
+        if base.split(".")[-1] in ("jit", "shard_map"):
+            return True
+        if isinstance(dec, ast.Call):
+            # functools.partial(jax.jit, ...) and friends
+            for arg in dec.args:
+                nm = dotted_name(arg) or ""
+                if nm.split(".")[-1] in ("jit", "shard_map"):
+                    return True
+    return False
+
+
+def _collect_defs(tree: ast.AST) -> List:
+    return [n for n in ast.walk(tree) if isinstance(n, _FuncDef)]
+
+
+def _traced_functions(tree: ast.AST) -> Tuple[Set, Set]:
+    """(traced defs, directly-jitted defs) for one module.
+
+    Direct seeds: decorated with jit/shard_map, or their bare name is
+    passed as an argument to a jit/shard_map call anywhere in the module
+    (the `jax.jit(solve, in_shardings=...)` factory idiom). Traced then
+    closes over lexical nesting and same-module calls by simple name.
+    """
+    defs = _collect_defs(tree)
+    by_name: Dict[str, List] = {}
+    for fn in defs:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    jit_arg_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_entry(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    jit_arg_names.add(arg.id)
+
+    direct = {
+        fn
+        for fn in defs
+        if _jit_decorated(fn) or fn.name in jit_arg_names
+    }
+    traced = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if node is fn:
+                    continue
+                if isinstance(node, _FuncDef) and node not in traced:
+                    traced.add(node)
+                    changed = True
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in by_name
+                ):
+                    for target in by_name[node.func.id]:
+                        if target not in traced:
+                            traced.add(target)
+                            changed = True
+    return traced, direct
+
+
+class _TestScanner:
+    """Why a branch test is trace-unsafe, or None."""
+
+    def __init__(self, hot_params: Set[str], jnp_aliases: Set[str]):
+        self.hot_params = hot_params
+        self.jnp = jnp_aliases
+
+    def scan(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return None  # x.ndim / x.shape[...] are trace-time statics
+            return self.scan(node.value)
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _STATIC_CALLS:
+                return None
+            root = dotted_name(node.func)
+            if root and root.split(".")[0] in self.jnp:
+                return f"call to tracer-valued {root}(...)"
+            for child in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                reason = self.scan(child)
+                if reason:
+                    return reason
+            return self.scan(node.func) if isinstance(
+                node.func, ast.Attribute
+            ) else None
+        if isinstance(node, ast.Name):
+            if node.id in self.hot_params:
+                return f"traced parameter '{node.id}'"
+            return None
+        for child in ast.iter_child_nodes(node):
+            reason = self.scan(child)
+            if reason:
+                return reason
+        return None
+
+
+def _walk_shallow(fn):
+    """Walk a function body without descending into nested defs (they are
+    analyzed as traced functions in their own right)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FuncDef):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class TraceSafetyRule(Rule):
+    name = "trace-safety"
+    severity = "error"
+    description = (
+        "no Python branches on traced values, host syncs (.item/float/np.*)"
+        " or non-static carry containers inside jax.jit-reachable functions"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        for sf in ctx.files:
+            yield from self._run_file(sf)
+
+    def _run_file(self, sf: SourceFile):
+        jnp = _jax_numpy_aliases(sf.tree)
+        if not jnp:
+            return  # module never touches jax; nothing can trace
+        np_aliases = _numpy_aliases(sf.tree)
+        traced, direct = _traced_functions(sf.tree)
+        for fn in traced:
+            hot = (
+                {
+                    a.arg
+                    for a in (
+                        fn.args.posonlyargs
+                        + fn.args.args
+                        + fn.args.kwonlyargs
+                    )
+                    if a.arg != "self"
+                }
+                if fn in direct
+                else set()
+            )
+            scanner = _TestScanner(hot, jnp)
+            for node in _walk_shallow(fn):
+                yield from self._check_node(
+                    sf, fn, node, scanner, np_aliases, jnp
+                )
+
+    def _check_node(self, sf, fn, node, scanner, np_aliases, jnp):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            reason = scanner.scan(node.test)
+            if reason:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                yield self.finding(
+                    "python-branch",
+                    sf,
+                    node.lineno,
+                    f"traced function '{fn.name}': Python {kind} on a "
+                    f"traced value ({reason}) — use jnp.where / "
+                    f"lax.cond / lax.while_loop",
+                )
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and name in _HOST_SYNC_METHODS
+            ):
+                yield self.finding(
+                    "host-sync",
+                    sf,
+                    node.lineno,
+                    f"traced function '{fn.name}': .{name}() forces a "
+                    f"device->host sync mid-trace",
+                )
+            elif isinstance(node.func, ast.Name) and name in _CAST_CALLS:
+                reason = scanner.scan(
+                    node.args[0]
+                ) if node.args else None
+                if reason:
+                    yield self.finding(
+                        "host-sync",
+                        sf,
+                        node.lineno,
+                        f"traced function '{fn.name}': {name}() of a "
+                        f"traced value ({reason}) concretizes on host",
+                    )
+            else:
+                root = dotted_name(node.func)
+                if root and root.split(".")[0] in np_aliases:
+                    yield self.finding(
+                        "host-sync",
+                        sf,
+                        node.lineno,
+                        f"traced function '{fn.name}': numpy call "
+                        f"{root}(...) round-trips device data through "
+                        f"the host — use jnp",
+                    )
+                elif name in _CARRY_ARG and root and (
+                    root.split(".")[0] in jnp or "lax" in root.split(".")
+                ):
+                    pos = _CARRY_ARG[name]
+                    if len(node.args) > pos:
+                        carry = node.args[pos]
+                        bad = isinstance(
+                            carry, (ast.List, ast.Dict, ast.Set)
+                        ) or (
+                            isinstance(carry, ast.Call)
+                            and call_name(carry)
+                            in ("list", "dict", "set")
+                        )
+                        if bad:
+                            yield self.finding(
+                                "nonstatic-carry",
+                                sf,
+                                carry.lineno,
+                                f"traced function '{fn.name}': "
+                                f"{name} carry state is a Python "
+                                f"container — use a tuple/array pytree",
+                            )

@@ -436,8 +436,15 @@ def cmd_openr(client: BlockingCtrlClient, args) -> None:
     if args.cmd == "version":
         print(VERSION)
         print("node:", client.call("getMyNodeName"))
-        for k, v in sorted(client.call("getBuildInfo").items()):
+        build_info = client.call("getBuildInfo")
+        for k, v in sorted(build_info.items()):
             print(f"{k}: {v}")
+        if "build_analysis_version" not in build_info:
+            # older daemon: report the CLI side's own lint contract
+            from openr_tpu.utils.build_info import get_analysis_build_info
+
+            for k, v in sorted(get_analysis_build_info().items()):
+                print(f"{k} (local): {v}")
     elif args.cmd == "config":
         _print_json(client.call("getRunningConfig"))
 

@@ -49,6 +49,7 @@ from openr_tpu.types import (
 )
 from openr_tpu.utils import AsyncDebounce
 from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
+from openr_tpu.utils.ownership import owned_by
 from openr_tpu.utils import serializer
 
 import dataclasses
@@ -148,6 +149,7 @@ class _PendingUpdates:
         self.span = None
 
 
+@owned_by("decision-loop")
 class Decision(CountersMixin, HistogramsMixin):
     def __init__(
         self,
@@ -607,6 +609,7 @@ class Decision(CountersMixin, HistogramsMixin):
             if self.rib_policy.apply_action(entry):
                 self._bump("decision.rib_policy_applied")
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_rib_policy(self, policy: RibPolicy) -> None:
         """OpenrCtrl setRibPolicy (Decision.cpp:1517-1550): apply now and
         schedule re-application at expiry."""

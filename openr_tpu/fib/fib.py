@@ -47,6 +47,7 @@ from openr_tpu.types import (
 from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils import ExponentialBackoff
 from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
+from openr_tpu.utils.ownership import owned_by
 
 log = logging.getLogger(__name__)
 
@@ -156,6 +157,7 @@ class _RouteState:
     dirty_route_db: bool = False
 
 
+@owned_by("fib-loop")
 class Fib(CountersMixin, HistogramsMixin):
     def __init__(
         self,

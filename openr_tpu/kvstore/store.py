@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.messaging import ReplicateQueue
 from openr_tpu.testing.faults import fault_point
+from openr_tpu.utils.ownership import owned_by
 from openr_tpu.types import (
     KeyVals,
     Publication,
@@ -270,6 +271,7 @@ class KvStoreParams:
     use_native_store: bool = False
 
 
+@owned_by("kvstore-loop")
 class KvStoreDb(CountersMixin):
     def __init__(
         self,
@@ -390,6 +392,7 @@ class KvStoreDb(CountersMixin):
 
     # -- local writes ------------------------------------------------------
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_key_vals(self, key_vals: KeyVals) -> KeyVals:
         """Local API write (thrift setKvStoreKeyVals): merge + flood."""
         updates = merge_key_values(self.store, key_vals, self.params.filters)

@@ -29,6 +29,7 @@ from openr_tpu.messaging import QueueClosedError, RQueue
 from openr_tpu.spark.spark import NeighborEvent, NeighborEventType
 from openr_tpu.types import Adjacency, AdjacencyDatabase, adj_key
 from openr_tpu.utils import ExponentialBackoff, AsyncThrottle
+from openr_tpu.utils.ownership import owned_by
 from openr_tpu.utils.counters import CountersMixin
 from openr_tpu.utils import serializer
 
@@ -83,6 +84,7 @@ class _AdjacencyEntry:
     peer_addr: str = ""  # KvStore transport address for this neighbor
 
 
+@owned_by("link-monitor-loop")
 class LinkMonitor(CountersMixin):
     def __init__(
         self,
@@ -391,12 +393,14 @@ class LinkMonitor(CountersMixin):
     # drain / overload controls (OpenrCtrl surface)
     # ------------------------------------------------------------------
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_node_overload(self, overloaded: bool) -> None:
         if self.node_overloaded != overloaded:
             self.node_overloaded = overloaded
             self._save_state()
             self._adv_throttle()
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_link_overload(self, if_name: str, overloaded: bool) -> None:
         changed = (
             if_name not in self.overloaded_links
@@ -412,6 +416,7 @@ class LinkMonitor(CountersMixin):
             self._rebuild_adjacencies()
             self._adv_throttle()
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_link_metric(self, if_name: str, metric: Optional[int]) -> None:
         if metric is None:
             self.link_metric_overrides.pop(if_name, None)
@@ -421,6 +426,7 @@ class LinkMonitor(CountersMixin):
         self._rebuild_adjacencies()
         self._adv_throttle()
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_adjacency_metric(
         self, if_name: str, adj_node: str, metric: Optional[int]
     ) -> None:

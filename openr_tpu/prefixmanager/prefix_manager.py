@@ -39,6 +39,7 @@ from openr_tpu.types import (
     replace,
 )
 from openr_tpu.utils import AsyncThrottle, serializer
+from openr_tpu.utils.ownership import owned_by
 from openr_tpu.utils.counters import CountersMixin
 
 log = logging.getLogger(__name__)
@@ -83,6 +84,7 @@ class _Entry:
     dst_areas: Set[str]
 
 
+@owned_by("prefix-manager-loop")
 class PrefixManager(CountersMixin):
     def __init__(
         self,
@@ -173,6 +175,7 @@ class PrefixManager(CountersMixin):
             assert req.type is not None
             self.sync_prefixes_by_type(req.type, req.prefixes)
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def advertise_prefixes(
         self,
         prefixes: List[PrefixEntry],
@@ -211,6 +214,7 @@ class PrefixManager(CountersMixin):
             self._sync_throttle()
         return changed
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def withdraw_prefixes_by_type(self, ptype: PrefixType) -> bool:
         removed = bool(self.prefix_map.pop(ptype, None))
         if removed:
@@ -218,6 +222,7 @@ class PrefixManager(CountersMixin):
             self._sync_throttle()
         return removed
 
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def sync_prefixes_by_type(
         self, ptype: PrefixType, prefixes: List[PrefixEntry]
     ) -> bool:

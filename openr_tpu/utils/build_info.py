@@ -17,11 +17,27 @@ PACKAGE = "openr-tpu"
 
 
 def get_build_info() -> Dict[str, str]:
-    return {
+    info = {
         "build_package_name": PACKAGE,
         "build_package_version": VERSION,
         "build_mode": "opt",
         "build_platform": platform.platform(),
         "build_python": sys.version.split()[0],
         "build_rule": "openr_tpu",
+    }
+    info.update(get_analysis_build_info())
+    return info
+
+
+def get_analysis_build_info() -> Dict[str, str]:
+    """Which static-analysis invariants this binary was linted against
+    (the getAnalysisVersion surface: rides ctrl getBuildInfo and `breeze
+    openr version`, so deployed daemons self-report their lint contract —
+    docs/Analysis.md)."""
+    from openr_tpu.analysis import get_analysis_info
+
+    meta = get_analysis_info()
+    return {
+        "build_analysis_version": meta["analysis_version"],
+        "build_analysis_rules": ",".join(meta["analysis_rules"]),
     }

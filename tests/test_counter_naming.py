@@ -2,119 +2,47 @@
 CountersMixin/HistogramsMixin follows the `<module>.<name>` convention from
 docs/Monitoring.md — drift fails at test time, not in dashboards.
 
-The walk is AST-based: classes inheriting (transitively, by name) from the
-mixins are scanned for literal names at the emission sites —
-`self._bump("...")`, `self._observe("...")`, `self._timer("...")` and
-literal subscripts on `counters` / `histograms` /
-`_ensure_counters()` / `_ensure_histograms()`. Non-mixin counter dicts
-(e.g. MockFibHandler's per-API mock counters) are intentionally out of
-scope, exactly as the convention is.
+This test is now a thin alias onto the `registry-drift` rule of the
+project analysis suite (openr_tpu/analysis/registry_drift.py,
+docs/Analysis.md) so the naming contract lives in ONE place: the rule owns
+the AST walk (mixin users, `self._bump("...")`/`_observe`/`_timer` and
+literal subscripts on `counters`/`histograms`/`_ensure_*()`), the
+convention regex, the prefix allowlist, and — beyond what this file ever
+checked — the cross-checks against docs/Monitoring.md's tables. The test
+names below are kept for continuity; the deeper per-check coverage lives
+in tests/test_analysis.py.
 """
 
-import ast
-import re
+import functools
 from pathlib import Path
 
-PKG = Path(__file__).resolve().parent.parent / "openr_tpu"
+import openr_tpu
+from openr_tpu.analysis import RULES, build_context
+from openr_tpu.analysis.registry_drift import (
+    collect_emitted_names as _collect_emitted,
+)
 
-MIXINS = {"CountersMixin", "HistogramsMixin"}
-
-# module prefixes registered with the Monitor (openr.py) plus the
-# cross-module end-to-end namespace
-ALLOWED_PREFIXES = {
-    "decision",
-    "kvstore",
-    "fib",
-    "spark",
-    "link_monitor",
-    "prefix_manager",
-    "convergence",
-}
-
-# <module>.<name>[.<name>...], lowercase snake segments
-NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
-
-_EMIT_CALLS = {"_bump", "_observe", "_timer"}
-_DICT_ATTRS = {"counters", "histograms"}
-_ENSURE_CALLS = {"_ensure_counters", "_ensure_histograms"}
+PKG = Path(openr_tpu.__file__).resolve().parent
 
 
-def _base_names(node: ast.ClassDef):
-    for base in node.bases:
-        if isinstance(base, ast.Name):
-            yield base.id
-        elif isinstance(base, ast.Attribute):
-            yield base.attr
-
-
-def _mixin_classes(trees):
-    """Names of classes inheriting a mixin, transitively by simple name."""
-    bases = {}
-    for tree in trees.values():
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ClassDef):
-                bases[node.name] = set(_base_names(node))
-    users = set(MIXINS)
-    changed = True
-    while changed:
-        changed = False
-        for name, bs in bases.items():
-            if name not in users and bs & users:
-                users.add(name)
-                changed = True
-    return users - MIXINS
-
-
-def _is_dict_ref(node) -> bool:
-    """`self.counters` / `x.histograms` / `self._ensure_counters()` or a
-    local alias of one (`counters = self._ensure_counters()`)."""
-    if isinstance(node, ast.Attribute) and node.attr in _DICT_ATTRS:
-        return True
-    if isinstance(node, ast.Name) and node.id in _DICT_ATTRS:
-        return True
-    return (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Attribute)
-        and node.func.attr in _ENSURE_CALLS
-    )
+@functools.lru_cache(maxsize=1)
+def _ctx():
+    return build_context([PKG])
 
 
 def collect_emitted_names():
-    """(name, 'file:line') pairs from every mixin user in the package."""
-    trees = {
-        py: ast.parse(py.read_text(), filename=str(py))
-        for py in sorted(PKG.rglob("*.py"))
-    }
-    mixin_users = _mixin_classes(trees)
-    found = []
-    for py, tree in trees.items():
-        for cls in ast.walk(tree):
-            if not (
-                isinstance(cls, ast.ClassDef) and cls.name in mixin_users
-            ):
-                continue
-            for node in ast.walk(cls):
-                name = None
-                if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _EMIT_CALLS
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)
-                ):
-                    name = node.args[0].value
-                elif (
-                    isinstance(node, ast.Subscript)
-                    and _is_dict_ref(node.value)
-                    and isinstance(node.slice, ast.Constant)
-                    and isinstance(node.slice.value, str)
-                ):
-                    name = node.slice.value
-                if name is not None:
-                    rel = py.relative_to(PKG.parent)
-                    found.append((name, f"{rel}:{node.lineno}"))
-    return found
+    """Legacy shape: (name, 'file:line') pairs from every mixin user in
+    the package — kept so downstream tooling keyed on this helper keeps
+    working; the walk itself lives in the registry-drift rule."""
+    return [
+        (name, f"{sf.rel}:{line}")
+        for name, sf, line in _collect_emitted(_ctx())
+    ]
+
+
+@functools.lru_cache(maxsize=1)
+def _drift_findings():
+    return list(RULES["registry-drift"].run(_ctx()))
 
 
 def test_scanner_finds_the_counter_surface():
@@ -150,32 +78,37 @@ def test_scanner_finds_the_counter_surface():
 
 def test_counter_names_follow_convention():
     bad = [
-        (name, where)
-        for name, where in collect_emitted_names()
-        if not NAME_RE.match(name)
-        or name.split(".", 1)[0] not in ALLOWED_PREFIXES
+        (f.message, f"{f.path}:{f.line}")
+        for f in _drift_findings()
+        if f.check == "counter-name"
     ]
     assert not bad, f"counter names violating <module>.<name>: {bad}"
 
 
 def test_histogram_names_carry_a_unit_suffix():
     """Latency/size distributions must self-describe their unit."""
-    trees = {
-        py: ast.parse(py.read_text(), filename=str(py))
-        for py in sorted(PKG.rglob("*.py"))
-    }
-    bad = []
-    for py, tree in trees.items():
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in {"_observe", "_timer"}
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-            ):
-                name = node.args[0].value
-                if not name.endswith(("_ms", "_bytes")):
-                    bad.append((name, f"{py.name}:{node.lineno}"))
+    bad = [
+        (f.message, f"{f.path}:{f.line}")
+        for f in _drift_findings()
+        if f.check == "histogram-unit"
+    ]
     assert not bad, f"histogram names missing unit suffix: {bad}"
+
+
+def test_registry_docs_match_code():
+    """The naming tables in docs/Monitoring.md and the fault-point catalog
+    in docs/Robustness.md describe the shipped code — the part of the
+    contract the old standalone lint could not check."""
+    doc_checks = {
+        "doc-ghost",
+        "undocumented-histogram",
+        "undocumented-fault-point",
+        "ghost-fault-point",
+        "undocumented-config-knob",
+    }
+    bad = [
+        (f.check, f.message)
+        for f in _drift_findings()
+        if f.check in doc_checks
+    ]
+    assert not bad, bad
