@@ -21,10 +21,17 @@ delegates here):
   3. Decision config knobs. Every `DecisionConfigSection` field must be
      mentioned in docs/ (bare, or as the `--decision_<name>` flag), and
      every `solver_*`-style knob the docs name must exist as a field.
+  4. LogSample event names. Every event name stamped onto a LogSample —
+     `sample.add_string("event", <literal or module constant>)` and
+     `self._emit_sample("NAME", ...)` — must appear in the event-catalog
+     table of docs/Monitoring.md, and every cataloged event must be
+     emitted (CONVERGENCE_TRACE, FLOOD_TRACE, SOLVER_BREAKER_*,
+     WARM_STATE_AUDIT_MISMATCH, ... — both directions).
 
 Doc-name shorthand understood when parsing tables: `{a,b}` brace
 alternation, `*` suffix wildcards, and `x_sent/recv` slash alternation on
-the final `_`-separated token.
+the final `_`-separated token. Event-catalog rows are ALL_CAPS tokens and
+support the same braces and `*` suffix wildcards.
 """
 
 from __future__ import annotations
@@ -59,6 +66,10 @@ ALLOWED_PREFIXES = {
 # <module>.<name>[.<name>...], lowercase snake segments
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 _DOC_TOKEN_RE = re.compile(r"`([a-z0-9_.{},*/]+)`")
+
+# LogSample event names: SCREAMING_SNAKE (CONVERGENCE_TRACE, FLOOD_TRACE)
+EVENT_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_EVENT_DOC_TOKEN_RE = re.compile(r"`([A-Z0-9_{},*]+)`")
 
 _EMIT_CALLS = {"_bump", "_observe", "_timer"}
 _HIST_CALLS = {"_observe", "_timer"}
@@ -256,6 +267,105 @@ def _exists_in_code(
 
 
 # ---------------------------------------------------------------------------
+# LogSample event names
+# ---------------------------------------------------------------------------
+
+
+def collect_log_events(
+    ctx: AnalysisContext,
+) -> List[Tuple[str, SourceFile, int]]:
+    """(event-name, file, line) for every LogSample event emission:
+    `*.add_string("event", X)` where X is a string literal or a
+    module-level string constant, and literal first args of
+    `self._emit_sample("NAME", ...)` helpers."""
+    found: List[Tuple[str, SourceFile, int]] = []
+    for sf in ctx.files:
+        consts: Dict[str, str] = {}
+        for node in sf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                consts[node.targets[0].id] = node.value.value
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            name: Optional[str] = None
+            if (
+                node.func.attr == "add_string"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "event"
+            ):
+                arg = node.args[1]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    name = arg.value
+                elif isinstance(arg, ast.Name):
+                    name = consts.get(arg.id)
+            elif (
+                node.func.attr == "_emit_sample"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                name = node.args[0].value
+            if name is not None and EVENT_NAME_RE.match(name):
+                found.append((name, sf, node.lineno))
+    return found
+
+
+def _expand_event_token(token: str) -> List[str]:
+    m = re.match(r"^(.*)\{([^}]*)\}(.*)$", token)
+    if m:
+        out: List[str] = []
+        for alt in m.group(2).split(","):
+            out.extend(_expand_event_token(m.group(1) + alt + m.group(3)))
+        return out
+    if token.endswith("*"):
+        stem = token.rstrip("*")
+        return [stem + "*"] if EVENT_NAME_RE.match(stem) else []
+    return [token] if EVENT_NAME_RE.match(token) else []
+
+
+def _event_table_names(text: str) -> Set[str]:
+    """ALL_CAPS backticked tokens from the event-catalog table (rows of
+    markdown tables whose header mentions 'event')."""
+    names: Set[str] = set()
+    in_table = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        if "event" in stripped.lower() and "---" not in stripped:
+            header_tokens = _EVENT_DOC_TOKEN_RE.findall(stripped)
+            if not header_tokens:
+                in_table = True
+                continue
+        if not in_table:
+            continue
+        for token in _EVENT_DOC_TOKEN_RE.findall(stripped):
+            names.update(_expand_event_token(token))
+    return names
+
+
+def _event_documented(name: str, documented: Set[str]) -> bool:
+    if name in documented:
+        return True
+    return any(
+        name.startswith(d[:-1]) for d in documented if d.endswith("*")
+    )
+
+
+# ---------------------------------------------------------------------------
 # fault points + config knobs
 # ---------------------------------------------------------------------------
 
@@ -312,8 +422,8 @@ class RegistryDriftRule(Rule):
     name = "registry-drift"
     severity = "error"
     description = (
-        "counter/histogram names, fault points and DecisionConfigSection "
-        "knobs must match their docs registries "
+        "counter/histogram names, fault points, LogSample event names and "
+        "DecisionConfigSection knobs must match their docs registries "
         "(Monitoring.md / Robustness.md)"
     )
 
@@ -324,6 +434,7 @@ class RegistryDriftRule(Rule):
             # single-file scan must not report the rest as ghosts
             return
         yield from self._check_monitoring_docs(ctx)
+        yield from self._check_event_catalog(ctx)
         yield from self._check_fault_catalog(ctx)
         yield from self._check_config_knobs(ctx)
 
@@ -385,6 +496,41 @@ class RegistryDriftRule(Rule):
                 line,
                 f"histogram '{name}' is emitted but missing from the "
                 f"docs/Monitoring.md histogram table",
+            )
+
+    # -- docs/Monitoring.md LogSample event catalog ---------------------
+
+    def _check_event_catalog(self, ctx: AnalysisContext):
+        doc = ctx.docs_dir / "Monitoring.md"
+        if not doc.exists():
+            return
+        sf_doc = _doc_source(ctx, doc)
+        text = doc.read_text()
+        documented = _event_table_names(text)
+        code_events = collect_log_events(ctx)
+        emitted = {name for name, _, _ in code_events}
+        for name, sf, line in code_events:
+            if not _event_documented(name, documented):
+                yield self.finding(
+                    "undocumented-event",
+                    sf,
+                    line,
+                    f"LogSample event '{name}' is emitted but missing "
+                    f"from the docs/Monitoring.md event catalog",
+                )
+        for name in sorted(documented):
+            if name.endswith("*"):
+                stem = name[:-1]
+                if any(e.startswith(stem) for e in emitted):
+                    continue
+            elif name in emitted:
+                continue
+            yield self.finding(
+                "ghost-event",
+                sf_doc,
+                _doc_line(text, name.rstrip("*")),
+                f"docs/Monitoring.md catalogs LogSample event '{name}' "
+                f"but no code emits it",
             )
 
     # -- docs/Robustness.md fault-point catalog -------------------------

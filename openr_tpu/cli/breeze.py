@@ -229,6 +229,9 @@ def _all_shortest_paths(graph, src, dst, limit=16):
 
 
 def cmd_perf(client: BlockingCtrlClient, args) -> None:
+    if getattr(args, "cmd", None) == "report":
+        _perf_report(client, args)
+        return
     perf_db = client.call("getPerfDb")
     for blob in perf_db:
         perf = decode_obj(blob)  # PerfEvents; unix_ts already in ms
@@ -241,6 +244,66 @@ def cmd_perf(client: BlockingCtrlClient, args) -> None:
                 f"  {ev.event_descr:<40} {ev.node_name:<16} "
                 f"+{ev.unix_ts - base}ms"
             )
+
+
+def _perf_report(client: BlockingCtrlClient, args) -> None:
+    """Network-wide convergence report: collect getConvergenceReport from
+    every named node (--hosts host:port,... — or just the connected one)
+    and render the aggregate (monitor/report.py)."""
+    from openr_tpu.monitor.report import aggregate_convergence_reports
+
+    reports = [client.call("getConvergenceReport")]
+    for endpoint in [h for h in (args.hosts or "").split(",") if h]:
+        host, _, port = endpoint.rpartition(":")
+        with BlockingCtrlClient(
+            host or "127.0.0.1", int(port), ssl_context=client.ssl_context
+        ) as peer:
+            reports.append(peer.call("getConvergenceReport"))
+    agg = aggregate_convergence_reports(reports)
+
+    def ms(value: float) -> str:
+        return f"{value:.3f}"
+
+    print(
+        f"network-wide convergence: {agg['nodes']} node(s), "
+        f"{agg['spans_total']} finished span(s)"
+    )
+    e2e = agg["e2e_ms"]
+    _print_table(
+        ["Metric", "Count", "p50", "p95", "Max"],
+        [
+            [
+                "node-to-converge e2e_ms",
+                e2e["count"],
+                ms(e2e["p50"]),
+                ms(e2e["p95"]),
+                ms(e2e["max"]),
+            ]
+        ]
+        + [
+            [f"stage {stage}_ms", s["count"], ms(s["p50"]), ms(s["p95"]),
+             ms(s["max"])]
+            for stage, s in agg["stages"].items()
+        ],
+    )
+    slowest = agg.get("slowest_stage")
+    if slowest:
+        print(
+            f"slowest hop: {slowest['stage']} on {slowest['node']} "
+            f"({ms(slowest['ms'])}ms)"
+        )
+    flood = agg["flood"]
+    print(
+        f"flood: {flood['received']} received, "
+        f"{flood['duplicates']} redundant "
+        f"(ratio {flood['duplicate_ratio']:.2f}), "
+        f"max hop count {flood['hop_count_max']}, "
+        f"per-hop p50/p95/max "
+        f"{ms(flood['hop_ms']['p50'])}/{ms(flood['hop_ms']['p95'])}/"
+        f"{ms(flood['hop_ms']['max'])}ms"
+    )
+    if args.json:
+        _print_json(agg)
 
 
 def cmd_config(client: BlockingCtrlClient, args) -> None:
@@ -530,6 +593,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     perf = sub.add_parser("perf").add_subparsers(dest="cmd", required=True)
     perf.add_parser("view")
+    p = perf.add_parser("report")
+    p.add_argument(
+        "--hosts",
+        default="",
+        help="additional host:port ctrl endpoints to fold into the "
+        "network-wide report (comma-separated)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="dump the full aggregate too"
+    )
 
     cfg = sub.add_parser("config").add_subparsers(dest="cmd", required=True)
     cfg.add_parser("show")

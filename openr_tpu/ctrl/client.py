@@ -109,6 +109,9 @@ class BlockingCtrlClient:
         timeout: float = 30.0,
         ssl_context=None,
     ) -> None:
+        # kept so callers fanning out to more nodes (breeze perf report
+        # --hosts) can open peer connections with the same TLS settings
+        self.ssl_context = ssl_context
         self._sock = socket.create_connection((host, port), timeout=timeout)
         if ssl_context is not None:
             self._sock = ssl_context.wrap_socket(self._sock)

@@ -287,6 +287,18 @@ class CtrlServer:
         assert self.decision is not None, "decision module not attached"
         return self.decision.get_solver_health()
 
+    def m_getConvergenceReport(self, params) -> Dict[str, Any]:
+        """This node's convergence evidence — finished CONVERGENCE_TRACE
+        spans, FLOOD_TRACE hop samples and kvstore flood stats — for the
+        cross-node aggregation (`breeze perf report`,
+        monitor/report.py:aggregate_convergence_reports)."""
+        assert self.monitor is not None, "monitor module not attached"
+        from openr_tpu.monitor.report import node_convergence_report
+
+        return node_convergence_report(
+            self.node_name, self.monitor, kvstore=self.kvstore
+        )
+
     def m_getEventLogs(self, params) -> List[str]:
         if self.monitor is None:
             return []

@@ -105,6 +105,19 @@ class DecisionConfig:
     solver_audit_interval: int = 0
 
 
+# wall-clock PerfEvent descriptors mapped onto convergence-span stages:
+# the origin's pre-publish chain rides the advertised AdjacencyDatabase
+# (linkmonitor/link_monitor.py), the flood-hop trace rides the publication
+# itself (kvstore/store.py) — remote nodes reconstruct the monotonic span
+# from these, so every node's CONVERGENCE_TRACE covers spark→fib
+_PRE_STAGE_EVENTS = {
+    "NEIGHBOR_EVENT_RECVD": "spark.neighbor_event",
+    "ADJ_DB_ADVERTISED": "linkmonitor.adj_advertised",
+}
+_FLOOD_ORIGINATED = "KVSTORE_FLOOD_ORIGINATED"
+_FLOOD_RECEIVED = "KVSTORE_FLOOD_RECEIVED"
+
+
 class _PendingUpdates:
     """Batch tracker (Decision.h:95-207)."""
 
@@ -117,7 +130,7 @@ class _PendingUpdates:
     def apply(
         self,
         perf_events: Optional[PerfEvents],
-        pub_ts: Optional[float] = None,
+        publication: Optional[Publication] = None,
     ) -> None:
         if self.count == 0:
             # the batch's oldest event is the one convergence is measured
@@ -126,7 +139,7 @@ class _PendingUpdates:
             # convergence.e2e_ms is immune to wall-clock jumps — the
             # PerfEvents trace below stays wall-clock for cross-node
             # reporting, the span owns all local latency math
-            self.span = Span("convergence", t0=pub_ts)
+            self.span = _build_span(perf_events, publication)
             self.span.mark("decision.recv")
         self.count += 1
         self.needs_route_update = True
@@ -147,6 +160,63 @@ class _PendingUpdates:
         self.perf_events = None
         self.needs_route_update = False
         self.span = None
+
+
+def _build_span(
+    perf_events: Optional[PerfEvents],
+    publication: Optional[Publication],
+) -> Span:
+    """Seed one convergence Span with every stage known to predate the
+    local publish stamp.
+
+    On the ORIGINATING node the pre-publish chain arrives as exact
+    monotonic marks (Publication.span_stages). On REMOTE nodes the same
+    chain — plus the flood hops in between — is reconstructed from the
+    wall-clock PerfEvents: each event's monotonic time is `now_mono -
+    (now_wall - event_wall)`, exact inside one emulator host and
+    NTP-accurate across real hosts (which is the precision cross-node
+    measurement has anyway). From kvstore.publish on, every mark is live.
+    """
+    pub_ts = publication.ts_monotonic if publication is not None else None
+    stages: List = []
+    span_stages = (
+        publication.span_stages if publication is not None else None
+    )
+    wall: List = []
+    if span_stages:
+        stages.extend(span_stages)
+    elif perf_events is not None:
+        for ev in perf_events.events:
+            stage = _PRE_STAGE_EVENTS.get(ev.event_descr)
+            if stage is not None:
+                wall.append((stage, ev.unix_ts))
+    flood = publication.perf_events if publication is not None else None
+    if flood is not None:
+        hop = 0
+        for ev in flood.events:
+            if ev.event_descr == _FLOOD_ORIGINATED:
+                wall.append(("kvstore.flood.origin", ev.unix_ts))
+            elif ev.event_descr == _FLOOD_RECEIVED:
+                hop += 1
+                wall.append((f"kvstore.flood.hop{hop}", ev.unix_ts))
+    if wall:
+        now_mono = time.monotonic()
+        now_wall_ms = time.time() * 1e3
+        stages.extend(
+            (stage, now_mono - max(0.0, now_wall_ms - ts) / 1e3)
+            for stage, ts in wall
+        )
+    stages.sort(key=lambda s: s[1])
+    if pub_ts is not None:
+        # the publish stamp bounds every pre-publish stage
+        stages = [(stage, min(ts, pub_ts)) for stage, ts in stages]
+    t0 = stages[0][1] if stages else pub_ts
+    span = Span("convergence", t0=t0)
+    for stage, ts in stages:
+        span.mark(stage, ts=ts)
+    if pub_ts is not None:
+        span.mark("kvstore.publish", ts=pub_ts)
+    return span
 
 
 @owned_by("decision-loop")
@@ -327,7 +397,6 @@ class Decision(CountersMixin, HistogramsMixin):
             self.area_link_states[area] = link_state
 
         changed = False
-        pub_ts = publication.ts_monotonic
         bulk_keys = self._bulk_adj_keys(publication, link_state)
         if bulk_keys:
             changed |= self._bulk_ingest_adj(
@@ -338,7 +407,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 continue  # ttl refresh only / already bulk-ingested
             try:
                 changed |= self._process_key(
-                    key, value, area, link_state, pub_ts
+                    key, value, area, link_state, publication
                 )
             except Exception:
                 # a malformed value must not poison the rest of the batch
@@ -355,7 +424,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 node = key[len(ADJ_DB_MARKER):]
                 if link_state.delete_adjacency_database(node).topology_changed:
                     changed = True
-                    self._pending.apply(None, pub_ts)
+                    self._pending.apply(None, publication)
             elif key.startswith(PREFIX_DB_MARKER):
                 node, _, _ = parse_prefix_key(key)
                 delete_db = PrefixDatabase(
@@ -369,7 +438,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 node_db.area = area
                 if self.prefix_state.update_prefix_database(node_db):
                     changed = True
-                    self._pending.apply(None, pub_ts)
+                    self._pending.apply(None, publication)
 
         if changed:
             self._schedule_rebuild()
@@ -424,9 +493,8 @@ class Decision(CountersMixin, HistogramsMixin):
             or change.node_label_changed
         ):
             return False
-        pub_ts = publication.ts_monotonic
         for db in adj_dbs:
-            self._pending.apply(db.perf_events, pub_ts)
+            self._pending.apply(db.perf_events, publication)
         return True
 
     def _process_key(
@@ -435,7 +503,7 @@ class Decision(CountersMixin, HistogramsMixin):
         value,
         area: str,
         link_state: LinkState,
-        pub_ts: Optional[float] = None,
+        publication: Optional[Publication] = None,
     ) -> bool:
         """Apply one LSDB key; returns True if state changed."""
         changed = False
@@ -463,7 +531,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 or change.node_label_changed
             ):
                 changed = True
-                self._pending.apply(adj_db.perf_events, pub_ts)
+                self._pending.apply(adj_db.perf_events, publication)
         elif key.startswith(PREFIX_DB_MARKER):
             # cached decode: prefix dbs are never mutated by this module
             # (aggregation builds fresh node_db objects)
@@ -476,7 +544,7 @@ class Decision(CountersMixin, HistogramsMixin):
             self._bump("decision.prefix_db_update")
             if self.prefix_state.update_prefix_database(node_db):
                 changed = True
-                self._pending.apply(prefix_db.perf_events, pub_ts)
+                self._pending.apply(prefix_db.perf_events, publication)
         return changed
 
     def _update_node_prefix_database(

@@ -47,8 +47,14 @@ class KvStoreClient:
         value: bytes,
         area: str = "0",
         ttl: int = TTL_INFINITY,
+        span_stages=None,
     ) -> None:
-        """Advertise with a version higher than whatever is in the store."""
+        """Advertise with a version higher than whatever is in the store.
+
+        `span_stages` — monotonic pre-publish convergence-span marks
+        (Publication.span_stages) — ride through to the store's local
+        publication so Decision's span covers the producing module's
+        latency too (LinkMonitor's spark→advertise chain)."""
         existing = self.kvstore.get_key(key, area=area)
         version = (existing.version + 1) if existing is not None else 1
         self.kvstore.set_key(
@@ -60,6 +66,7 @@ class KvStoreClient:
                 ttl=ttl,
             ),
             area=area,
+            span_stages=span_stages,
         )
 
     def persist_key(
@@ -68,6 +75,7 @@ class KvStoreClient:
         value: bytes,
         area: str = "0",
         ttl: int = TTL_INFINITY,
+        span_stages=None,
     ) -> None:
         """Advertise and keep advertised: re-advertise if overwritten."""
         self._persisted[(area, key)] = (value, ttl)
@@ -79,7 +87,7 @@ class KvStoreClient:
         ):
             self._schedule_ttl_refresh(area, key, existing, ttl)
             return  # already ours and current
-        self.set_key(key, value, area=area, ttl=ttl)
+        self.set_key(key, value, area=area, ttl=ttl, span_stages=span_stages)
         stored = self.kvstore.get_key(key, area=area)
         if stored is not None:
             self._schedule_ttl_refresh(area, key, stored, ttl)

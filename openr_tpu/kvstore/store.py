@@ -17,6 +17,16 @@ Behavioral port of openr/kvstore/KvStore.{h,cpp}:
     merge buffer (KvStore.cpp:2648-2702).
   - peer FSM IDLE → SYNCING → INITIALIZED (KvStore.h:46-62) with
     exponential backoff on transport failure.
+
+Flood tracing (docs/Monitoring.md): every flooded publication carries a
+wall-clock PerfEvents hop trace next to the nodeIds path vector —
+KVSTORE_FLOOD_ORIGINATED at the origin, one KVSTORE_FLOOD_RECEIVED per
+hop — so each store exports per-hop flood latency (`kvstore.flood.hop_ms`),
+origin-to-here latency (`kvstore.flood.e2e_ms`), flood-buffer queue delay
+(`kvstore.flood.buffer_delay_ms`) and a redundant-flood ratio
+(`kvstore.flood.duplicates` / `kvstore.flood.received`), and emits one
+FLOOD_TRACE LogSample per received flood for the cross-node convergence
+report (monitor/report.py, ctrl getConvergenceReport).
 """
 
 from __future__ import annotations
@@ -29,18 +39,35 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.monitor.monitor import LogSample
 from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils.ownership import owned_by
 from openr_tpu.types import (
     KeyVals,
+    PerfEvents,
     Publication,
     TTL_INFINITY,
     Value,
     generate_hash,
 )
 from openr_tpu.utils import AsyncThrottle, ExponentialBackoff
-from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 from openr_tpu.kvstore.transport import KvStoreTransport
+
+# flood-hop PerfEvent descriptors (ride the KEY_SET RPC, wire.py); Decision
+# maps them onto convergence-span stages (decision.py:_FLOOD_*)
+FLOOD_ORIGINATED_EVENT = "KVSTORE_FLOOD_ORIGINATED"
+FLOOD_RECEIVED_EVENT = "KVSTORE_FLOOD_RECEIVED"
+# one LogSample per received flooded publication (docs/Monitoring.md
+# event catalog): hop count, per-hop + origin-to-here latency, duplicate flag
+FLOOD_TRACE_EVENT = "FLOOD_TRACE"
+# hop-trace length bound: the origin stamp plus the most recent hops. On
+# large-diameter topologies (a 256-node emulated ring) an unbounded trace
+# is O(diameter) per-copy per-forward — O(diameter²) allocations per
+# publication — for stamps nothing reads: per-hop latency uses the LAST
+# stamp, origin-to-here the FIRST. The nodeIds path vector stays complete
+# (it is load-bearing for loop prevention); only the timing trace is capped.
+FLOOD_TRACE_MAX_EVENTS = 17
 
 
 # ---------------------------------------------------------------------------
@@ -272,7 +299,7 @@ class KvStoreParams:
 
 
 @owned_by("kvstore-loop")
-class KvStoreDb(CountersMixin):
+class KvStoreDb(CountersMixin, HistogramsMixin):
     def __init__(
         self,
         area: str,
@@ -280,12 +307,21 @@ class KvStoreDb(CountersMixin):
         transport: KvStoreTransport,
         updates_queue: ReplicateQueue,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        histograms: Optional[Dict] = None,
+        log_sample_fn=None,
     ) -> None:
         self.area = area
         self.params = params
         self.transport = transport
         self.updates_queue = updates_queue
         self._loop = loop
+        # flood-latency histograms; the multi-area container passes ONE
+        # shared dict so per-node flood stats aggregate across areas (the
+        # monitor reads the container's `histograms` attribute)
+        self.histograms: Dict = histograms if histograms is not None else {}
+        # sink for FLOOD_TRACE LogSamples (the daemon's monitor queue push;
+        # None drops them — flood counters/histograms still record)
+        self._log_sample_fn = log_sample_fn
         self.store: KeyVals = {}
         if params.use_native_store:
             from openr_tpu.kvstore.native import (
@@ -311,6 +347,13 @@ class KvStoreDb(CountersMixin):
         self._buffer_flush = AsyncThrottle(
             params.flood_buffer_delay, self._flush_buffered, loop=loop
         )
+        # flood-buffer queue-delay bookkeeping: when the first key entered
+        # the buffer, plus the oldest buffered publication's span stages /
+        # hop trace (the merged flush re-attaches them, same oldest-event
+        # rule Decision's debounce uses)
+        self._buffer_first_ts: Optional[float] = None
+        self._buffer_span_stages: Optional[List[Tuple[str, float]]] = None
+        self._buffer_perf_events: Optional[PerfEvents] = None
         self._retry_pending: Set[str] = set()
         self._sync_tasks: Set[asyncio.Task] = set()
         self.counters: Dict[str, int] = {}
@@ -329,7 +372,7 @@ class KvStoreDb(CountersMixin):
         return self.store.get(key)
 
     def get_key_vals(self, keys: List[str]) -> Publication:
-        pub = Publication(area=self.area)
+        pub = Publication(area=self.area, ts_monotonic=time.monotonic())
         for key in keys:
             v = self.store.get(key)
             if v is not None:
@@ -341,7 +384,7 @@ class KvStoreDb(CountersMixin):
         filters: Optional[KvStoreFilters] = None,
         match_all: bool = False,
     ) -> Publication:
-        pub = Publication(area=self.area)
+        pub = Publication(area=self.area, ts_monotonic=time.monotonic())
         filters = filters or KvStoreFilters()
         match = filters.key_match_all if match_all else filters.key_match
         for key, value in self.store.items():
@@ -352,7 +395,7 @@ class KvStoreDb(CountersMixin):
     def dump_hashes(
         self, filters: Optional[KvStoreFilters] = None
     ) -> Publication:
-        pub = Publication(area=self.area)
+        pub = Publication(area=self.area, ts_monotonic=time.monotonic())
         filters = filters or KvStoreFilters()
         for key, value in self.store.items():
             if filters.key_match(key, value):
@@ -372,7 +415,7 @@ class KvStoreDb(CountersMixin):
         """3-way sync difference (KvStore.cpp:1331-1375): keyVals = keys
         where we are better/only-us; tobe_updated_keys = keys where the
         requester is better/only-them."""
-        pub = Publication(area=self.area)
+        pub = Publication(area=self.area, ts_monotonic=time.monotonic())
         pub.tobe_updated_keys = []
         for key in set(my_key_vals) | set(req_key_vals):
             mine = my_key_vals.get(key)
@@ -393,32 +436,110 @@ class KvStoreDb(CountersMixin):
     # -- local writes ------------------------------------------------------
 
     # analysis: shared — sync ctrl handler, loop-serialized with the owner
-    def set_key_vals(self, key_vals: KeyVals) -> KeyVals:
-        """Local API write (thrift setKvStoreKeyVals): merge + flood."""
+    def set_key_vals(
+        self, key_vals: KeyVals, span_stages=None
+    ) -> KeyVals:
+        """Local API write (thrift setKvStoreKeyVals): merge + flood.
+
+        `span_stages` — monotonic pre-publish convergence-span marks from
+        the producing module (LinkMonitor's spark→advertise chain) — ride
+        the local publication so Decision's span starts at the Spark event,
+        not at this store's publish stamp."""
         updates = merge_key_values(self.store, key_vals, self.params.filters)
         self._update_ttl_countdown(updates)
         if updates:
             self._bump("kvstore.updated_key_vals", len(updates))
             self.flood_publication(
-                Publication(key_vals=updates, area=self.area)
+                Publication(
+                    key_vals=updates,
+                    area=self.area,
+                    span_stages=span_stages,
+                )
             )
         return updates
 
     def handle_set_key_vals(
-        self, key_vals: KeyVals, node_ids: Optional[List[str]]
+        self,
+        key_vals: KeyVals,
+        node_ids: Optional[List[str]],
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
-        """KEY_SET arriving from a peer (flooded publication)."""
+        """KEY_SET arriving from a peer (flooded publication).
+
+        Flood-hop accounting happens here: the incoming wall-clock hop
+        trace (`perf_events`) yields this hop's latency and the
+        origin-to-here latency; the nodeIds path vector is the hop count;
+        a merge that accepts nothing is a redundant (duplicate) flood."""
+        recv_wall_ms = time.time() * 1e3
+        hop_count = len(node_ids) if node_ids else 0
+        self._bump("kvstore.flood.received")
+        self.counters["kvstore.flood.hop_count_last"] = hop_count
+        hop_ms: Optional[float] = None
+        e2e_ms: Optional[float] = None
+        if perf_events is not None and perf_events.events:
+            hop_ms = max(0.0, recv_wall_ms - perf_events.events[-1].unix_ts)
+            e2e_ms = max(0.0, recv_wall_ms - perf_events.events[0].unix_ts)
+            self._observe("kvstore.flood.hop_ms", hop_ms)
+            self._observe("kvstore.flood.e2e_ms", e2e_ms)
         if node_ids is not None and self.params.node_id in node_ids:
             self._bump("kvstore.looped_publications")
+            self._bump("kvstore.flood.duplicates")
+            self._emit_flood_trace(
+                node_ids, hop_count, len(key_vals), 0, hop_ms, e2e_ms
+            )
             return  # path-vector loop prevention (KvStore.cpp:2874-2884)
         updates = merge_key_values(self.store, key_vals, self.params.filters)
         self._update_ttl_countdown(updates)
+        if not updates:
+            self._bump("kvstore.flood.duplicates")
+        self._emit_flood_trace(
+            node_ids, hop_count, len(key_vals), len(updates), hop_ms, e2e_ms
+        )
         if updates:
+            traced = perf_events.copy() if perf_events is not None else None
+            if traced is not None:
+                traced.add_fine(self.params.node_id, FLOOD_RECEIVED_EVENT)
+                if len(traced.events) > FLOOD_TRACE_MAX_EVENTS:
+                    traced.events = [traced.events[0]] + traced.events[
+                        -(FLOOD_TRACE_MAX_EVENTS - 1):
+                    ]
             self.flood_publication(
                 Publication(
-                    key_vals=updates, area=self.area, node_ids=list(node_ids or [])
+                    key_vals=updates,
+                    area=self.area,
+                    node_ids=list(node_ids or []),
+                    perf_events=traced,
                 )
             )
+
+    def _emit_flood_trace(
+        self,
+        node_ids: Optional[List[str]],
+        hop_count: int,
+        keys: int,
+        updated: int,
+        hop_ms: Optional[float],
+        e2e_ms: Optional[float],
+    ) -> None:
+        if self._log_sample_fn is None:
+            return
+        sample = LogSample()
+        sample.add_string("event", FLOOD_TRACE_EVENT)
+        sample.add_string("area", self.area)
+        sample.add_string("origin", node_ids[0] if node_ids else "")
+        sample.add_int("hop_count", hop_count)
+        sample.add_int("keys", keys)
+        sample.add_int("updated", updated)
+        sample.add_int("duplicate", 0 if updated else 1)
+        if hop_ms is not None:
+            sample.add_double("hop_ms", hop_ms)
+        if e2e_ms is not None:
+            sample.add_double("e2e_ms", e2e_ms)
+        try:
+            self._log_sample_fn(sample)
+        except Exception:
+            # a closed monitor queue must never break the flood path
+            self._bump("kvstore.flood.trace_drops")
 
     def handle_dump(self, key_val_hashes: Optional[KeyVals]) -> Publication:
         """KEY_DUMP serving side; with hashes, serve the 3-way difference."""
@@ -426,6 +547,9 @@ class KvStoreDb(CountersMixin):
         if key_val_hashes is not None:
             pub = self.dump_difference(pub.key_vals, key_val_hashes)
         self._update_publication_ttl(pub)
+        # full-sync responses are publications too: stamp so any downstream
+        # span seeded from this object never starts from a missing stamp
+        pub.ts_monotonic = time.monotonic()
         return pub
 
     # -- flooding ----------------------------------------------------------
@@ -460,6 +584,19 @@ class KvStoreDb(CountersMixin):
             publication.node_ids = []
         publication.node_ids.append(self.params.node_id)
 
+        # hop-trace origin stamp: a publication with no inbound sender is
+        # being originated HERE — start the wall-clock flood trace every
+        # downstream hop measures per-hop latency against
+        if (
+            publication.key_vals
+            and publication.perf_events is None
+            and sender_id is None
+        ):
+            publication.perf_events = PerfEvents()
+            publication.perf_events.add_fine(
+                self.params.node_id, FLOOD_ORIGINATED_EVENT
+            )
+
         # internal subscribers (Decision et al.); the monotonic stamp seeds
         # Decision's convergence span (this store's clock — always restamp:
         # a shared in-process publication object may carry another node's)
@@ -481,6 +618,11 @@ class KvStoreDb(CountersMixin):
                     peer_name,
                     dict(publication.key_vals),
                     list(publication.node_ids),
+                    (
+                        publication.perf_events.copy()
+                        if publication.perf_events is not None
+                        else None
+                    ),
                 )
             )
 
@@ -499,6 +641,14 @@ class KvStoreDb(CountersMixin):
 
     def _buffer_publication(self, publication: Publication) -> None:
         self._bump("kvstore.rate_limit_suppress")
+        if self._buffer_first_ts is None:
+            self._buffer_first_ts = time.monotonic()
+        # the merged flush keeps the OLDEST buffered publication's span
+        # stages and hop trace (Decision's oldest-event-of-a-batch rule)
+        if self._buffer_span_stages is None:
+            self._buffer_span_stages = publication.span_stages
+        if self._buffer_perf_events is None:
+            self._buffer_perf_events = publication.perf_events
         self._publication_buffer.update(publication.key_vals.keys())
         self._publication_buffer.update(publication.expired_keys)
 
@@ -506,7 +656,19 @@ class KvStoreDb(CountersMixin):
         self._buffer_flush.cancel()
         if not self._publication_buffer:
             return
-        pub = Publication(area=self.area)
+        if self._buffer_first_ts is not None:
+            self._observe(
+                "kvstore.flood.buffer_delay_ms",
+                (time.monotonic() - self._buffer_first_ts) * 1e3,
+            )
+        pub = Publication(
+            area=self.area,
+            span_stages=self._buffer_span_stages,
+            perf_events=self._buffer_perf_events,
+        )
+        self._buffer_first_ts = None
+        self._buffer_span_stages = None
+        self._buffer_perf_events = None
         for key in self._publication_buffer:
             value = self.store.get(key)
             if value is not None:
@@ -518,7 +680,11 @@ class KvStoreDb(CountersMixin):
         self.flood_publication(pub, rate_limit=False, _from_buffer=True)
 
     async def _send_key_vals(
-        self, peer_name: str, key_vals: KeyVals, node_ids: List[str]
+        self,
+        peer_name: str,
+        key_vals: KeyVals,
+        node_ids: List[str],
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
         peer = self.peers.get(peer_name)
         if peer is None:
@@ -528,7 +694,11 @@ class KvStoreDb(CountersMixin):
             # API_ERROR peer-state path without a real transport fault
             fault_point("kvstore.flood_send", peer_name)
             await self.transport.set_key_vals(
-                peer.spec.peer_addr, self.area, key_vals, node_ids
+                peer.spec.peer_addr,
+                self.area,
+                key_vals,
+                node_ids,
+                perf_events=perf_events,
             )
             self._bump("kvstore.thrift.num_flood_pub")
         except Exception:
@@ -601,6 +771,9 @@ class KvStoreDb(CountersMixin):
             return
         my_hashes = self.dump_hashes().key_vals
         try:
+            # named fault seam: an injected dump failure exercises the
+            # full-sync retry/backoff path (docs/Robustness.md catalog)
+            fault_point("kvstore.full_sync", peer_name)
             pub = await self.transport.dump_key_vals(
                 peer.spec.peer_addr, self.area, my_hashes
             )
@@ -873,6 +1046,7 @@ class KvStore:
         transport,
         params: Optional[KvStoreParams] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        log_sample_fn=None,
     ) -> None:
         import dataclasses
 
@@ -889,9 +1063,19 @@ class KvStore:
             transport.register(node_id, self)
             transport = BoundTransport(transport, node_id)
         self.updates_queue: ReplicateQueue = ReplicateQueue()
+        # one histograms dict shared by every area db: per-node flood
+        # latency stats aggregate across areas, and the monitor (which
+        # registers this container, not the dbs) reads them live
+        self.histograms: Dict = {}
         self.dbs: Dict[str, KvStoreDb] = {
             area: KvStoreDb(
-                area, self.params, transport, self.updates_queue, loop
+                area,
+                self.params,
+                transport,
+                self.updates_queue,
+                loop,
+                histograms=self.histograms,
+                log_sample_fn=log_sample_fn,
             )
             for area in areas
         }
@@ -917,8 +1101,9 @@ class KvStore:
         key: str,
         value: Value,
         area: str = "0",
+        span_stages=None,
     ) -> None:
-        self.dbs[area].set_key_vals({key: value})
+        self.dbs[area].set_key_vals({key: value}, span_stages=span_stages)
 
     def get_key(self, key: str, area: str = "0") -> Optional[Value]:
         return self.dbs[area].get_key(key)
@@ -935,11 +1120,15 @@ class KvStore:
     # -- transport server side --------------------------------------------
 
     def handle_set_key_vals(
-        self, area: str, key_vals: KeyVals, node_ids: Optional[List[str]]
+        self,
+        area: str,
+        key_vals: KeyVals,
+        node_ids: Optional[List[str]],
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
         db = self.dbs.get(area)
         if db is not None:
-            db.handle_set_key_vals(key_vals, node_ids)
+            db.handle_set_key_vals(key_vals, node_ids, perf_events)
 
     def handle_dual_messages(self, area: str, msgs) -> None:
         db = self.dbs.get(area)

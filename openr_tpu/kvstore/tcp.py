@@ -140,6 +140,7 @@ class KvStoreTcpServer:
                 area,
                 wire.key_vals_from_json(params.get("key_vals")),
                 params.get("node_ids"),
+                wire.perf_events_from_json(params.get("perf_events")),
             )
             return {}
         if method == "kv.dump":
@@ -318,6 +319,7 @@ class TcpTransport(KvStoreTransport):
         area: str,
         key_vals: KeyVals,
         node_ids: Optional[list] = None,
+        perf_events=None,
     ) -> None:
         await self._call(
             peer_addr,
@@ -326,6 +328,7 @@ class TcpTransport(KvStoreTransport):
                 "area": area,
                 "key_vals": wire.key_vals_to_json(key_vals),
                 "node_ids": node_ids,
+                "perf_events": wire.perf_events_to_json(perf_events),
             },
         )
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import TYPE_CHECKING, Dict, Optional, Set, Tuple
 
-from openr_tpu.types import KeyVals, Publication
+from openr_tpu.types import KeyVals, PerfEvents, Publication
 
 if TYPE_CHECKING:
     from openr_tpu.kvstore.store import KvStore
@@ -31,8 +31,11 @@ class KvStoreTransport:
         area: str,
         key_vals: KeyVals,
         node_ids: Optional[list] = None,
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
-        """KEY_SET: push key/values to a peer (flooding + finalize-sync)."""
+        """KEY_SET: push key/values to a peer (flooding + finalize-sync).
+        `perf_events` is the wall-clock flood-hop trace riding next to the
+        nodeIds path vector (docs/Monitoring.md flood tracing)."""
         raise NotImplementedError
 
     async def dump_key_vals(
@@ -103,11 +106,12 @@ class InProcessTransport(KvStoreTransport):
         area: str,
         key_vals: KeyVals,
         node_ids: Optional[list],
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
         if self._delay:
             await asyncio.sleep(self._delay)
         target = self._target(caller, peer_addr)
-        target.handle_set_key_vals(area, key_vals, node_ids)
+        target.handle_set_key_vals(area, key_vals, node_ids, perf_events)
 
     async def call_dump(
         self,
@@ -160,9 +164,10 @@ class BoundTransport(KvStoreTransport):
         area: str,
         key_vals: KeyVals,
         node_ids: Optional[list] = None,
+        perf_events: Optional[PerfEvents] = None,
     ) -> None:
         await self._inner.call_set(
-            self._node_id, peer_addr, area, key_vals, node_ids
+            self._node_id, peer_addr, area, key_vals, node_ids, perf_events
         )
 
     async def dump_key_vals(

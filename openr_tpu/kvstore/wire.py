@@ -14,7 +14,14 @@ import base64
 from typing import Any, Dict, List, Optional
 
 from openr_tpu.dual.dual import DualMessage, DualMessages, DualMessageType
-from openr_tpu.types import TTL_INFINITY, KeyVals, Publication, Value
+from openr_tpu.types import (
+    TTL_INFINITY,
+    KeyVals,
+    PerfEvent,
+    PerfEvents,
+    Publication,
+    Value,
+)
 
 
 def _b64(data: Optional[bytes]) -> Optional[str]:
@@ -57,6 +64,28 @@ def key_vals_from_json(d: Optional[Dict[str, Any]]) -> KeyVals:
     return {k: value_from_json(v) for k, v in d.items()}
 
 
+def perf_events_to_json(
+    perf_events: Optional[PerfEvents],
+) -> Optional[List[List[Any]]]:
+    """Flood-hop trace as [node, event, unix_ts_ms] triples (ts may be a
+    float — sub-ms hop latencies matter inside one emulator host)."""
+    if perf_events is None:
+        return None
+    return [
+        [e.node_name, e.event_descr, e.unix_ts] for e in perf_events.events
+    ]
+
+
+def perf_events_from_json(
+    data: Optional[List[List[Any]]],
+) -> Optional[PerfEvents]:
+    if data is None:
+        return None
+    return PerfEvents(
+        [PerfEvent(str(n), str(d), ts) for n, d, ts in data]
+    )
+
+
 def publication_to_json(pub: Publication) -> Dict[str, Any]:
     return {
         "key_vals": key_vals_to_json(pub.key_vals),
@@ -64,6 +93,9 @@ def publication_to_json(pub: Publication) -> Dict[str, Any]:
         "node_ids": pub.node_ids,
         "tobe_updated_keys": pub.tobe_updated_keys,
         "area": pub.area,
+        # the wall-clock flood-hop trace crosses nodes (unlike the
+        # monotonic ts_monotonic/span_stages fields, which stay host-local)
+        "perf_events": perf_events_to_json(pub.perf_events),
     }
 
 
@@ -74,6 +106,7 @@ def publication_from_json(d: Dict[str, Any]) -> Publication:
         node_ids=d.get("node_ids"),
         tobe_updated_keys=d.get("tobe_updated_keys"),
         area=d.get("area", "0"),
+        perf_events=perf_events_from_json(d.get("perf_events")),
     )
 
 

@@ -20,6 +20,7 @@ Behavioral port of openr/link-monitor/LinkMonitor.{h,cpp}:
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -27,11 +28,24 @@ from openr_tpu.kvstore.client import KvStoreClient
 from openr_tpu.kvstore.store import KvStore, PeerSpec
 from openr_tpu.messaging import QueueClosedError, RQueue
 from openr_tpu.spark.spark import NeighborEvent, NeighborEventType
-from openr_tpu.types import Adjacency, AdjacencyDatabase, adj_key
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PerfEvent,
+    PerfEvents,
+    adj_key,
+)
 from openr_tpu.utils import ExponentialBackoff, AsyncThrottle
 from openr_tpu.utils.ownership import owned_by
-from openr_tpu.utils.counters import CountersMixin
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 from openr_tpu.utils import serializer
+
+# PerfEvent names stamped onto the advertised AdjacencyDatabase so REMOTE
+# nodes can reconstruct the origin's pre-publish span stages (wall clock —
+# the only clock that crosses nodes; Decision maps them back onto its
+# monotonic Span, decision.py:_PRE_STAGE_EVENTS)
+NEIGHBOR_EVENT_RECVD = "NEIGHBOR_EVENT_RECVD"
+ADJ_DB_ADVERTISED = "ADJ_DB_ADVERTISED"
 
 # config-store keys (LinkMonitor.h kConfigKey equivalent)
 CONFIG_KEY = "link-monitor-config"
@@ -85,7 +99,7 @@ class _AdjacencyEntry:
 
 
 @owned_by("link-monitor-loop")
-class LinkMonitor(CountersMixin):
+class LinkMonitor(CountersMixin, HistogramsMixin):
     def __init__(
         self,
         config: LinkMonitorConfig,
@@ -122,6 +136,12 @@ class LinkMonitor(CountersMixin):
         self._iface_timer: Optional[asyncio.TimerHandle] = None
         self._task: Optional[asyncio.Task] = None
         self.counters: Dict[str, int] = {}
+        self.histograms: Dict = {}
+        # oldest un-advertised Spark event stamp (monotonic): the throttled
+        # _advertise() coalesces a burst of neighbor events into one adj-db
+        # write, and the convergence span — like Decision's debounce rule —
+        # measures from the FIRST event of the burst
+        self._pending_event_ts: Optional[float] = None
 
     def loop(self) -> asyncio.AbstractEventLoop:
         return self._loop or asyncio.get_event_loop()
@@ -282,7 +302,17 @@ class LinkMonitor(CountersMixin):
                 )
                 if entry is not None:
                     entry.adjacency = self._make_adjacency(event)
+                    self._note_event_ts(event)
                     self._adv_throttle()
+
+    def _note_event_ts(self, event: NeighborEvent) -> None:
+        """Keep the oldest pending Spark event stamp for the next
+        advertisement's span stages."""
+        ts = event.ts_monotonic
+        if not ts:
+            return
+        if self._pending_event_ts is None or ts < self._pending_event_ts:
+            self._pending_event_ts = ts
 
     def _metric_for(self, event: NeighborEvent) -> int:
         adj_override = self.adj_metric_overrides.get(
@@ -336,12 +366,14 @@ class LinkMonitor(CountersMixin):
             )
         )
         self._advertise_kvstore_peers()
+        self._note_event_ts(event)
         self._adv_throttle()
 
     def _neighbor_down(self, event: NeighborEvent) -> None:
         self._bump("link_monitor.neighbor_down")
         self.adjacencies.pop((event.node_name, event.local_if_name), None)
         self._advertise_kvstore_peers()
+        self._note_event_ts(event)
         self._adv_throttle()
 
     # ------------------------------------------------------------------
@@ -368,7 +400,43 @@ class LinkMonitor(CountersMixin):
                 self.kvstore.add_peers(to_add, area=area)
 
     def _advertise(self) -> None:
-        """Build + persist 'adj:<node>' per area (advertiseAdjacencies)."""
+        """Build + persist 'adj:<node>' per area (advertiseAdjacencies).
+
+        Convergence tracing: the oldest pending Spark event stamp becomes
+        the first span stage (spark.neighbor_event), this advertisement the
+        second (linkmonitor.adj_advertised) — both handed through the
+        KvStore write as monotonic Publication.span_stages for the LOCAL
+        span, and mirrored as wall-clock PerfEvents on the AdjacencyDatabase
+        so remote nodes can reconstruct the same stages after the flood.
+        """
+        event_ts = self._pending_event_ts
+        self._pending_event_ts = None
+        adv_ts = time.monotonic()
+        span_stages = None
+        perf_events = None
+        if event_ts is not None:
+            self._observe(
+                "link_monitor.adj_advertise_ms", (adv_ts - event_ts) * 1e3
+            )
+            span_stages = [
+                ("spark.neighbor_event", event_ts),
+                ("linkmonitor.adj_advertised", adv_ts),
+            ]
+            now_wall_ms = time.time() * 1e3
+            perf_events = PerfEvents(
+                [
+                    # wall stamps derived from the monotonic deltas so both
+                    # clocks tell the same story
+                    PerfEvent(
+                        self.config.node_name,
+                        NEIGHBOR_EVENT_RECVD,
+                        now_wall_ms - (adv_ts - event_ts) * 1e3,
+                    ),
+                    PerfEvent(
+                        self.config.node_name, ADJ_DB_ADVERTISED, now_wall_ms
+                    ),
+                ]
+            )
         for area in self.config.areas:
             adjacencies = [
                 entry.adjacency
@@ -381,11 +449,13 @@ class LinkMonitor(CountersMixin):
                 is_overloaded=self.node_overloaded,
                 node_label=self.config.node_label,
                 area=area,
+                perf_events=perf_events,
             )
             self.kvstore_client.persist_key(
                 adj_key(self.config.node_name),
                 serializer.dumps(adj_db),
                 area=area,
+                span_stages=span_stages,
             )
             self._bump("link_monitor.advertise_adj_db")
 

@@ -11,6 +11,11 @@ from openr_tpu.monitor.monitor import (
     Monitor,
     merge_module_histograms,
 )
+from openr_tpu.monitor.report import (
+    aggregate_convergence_reports,
+    node_convergence_report,
+    percentile_summary,
+)
 from openr_tpu.monitor.spans import SPAN_EVENT, Span
 from openr_tpu.monitor.watchdog import Watchdog, WatchdogConfig
 
@@ -21,5 +26,8 @@ __all__ = [
     "SPAN_EVENT",
     "Watchdog",
     "WatchdogConfig",
+    "aggregate_convergence_reports",
     "merge_module_histograms",
+    "node_convergence_report",
+    "percentile_summary",
 ]

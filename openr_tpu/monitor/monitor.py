@@ -82,6 +82,11 @@ class LogSample:
     def get(self, key: str) -> Any:
         return self._values.get(key)
 
+    def values(self) -> Dict[str, Any]:
+        """Copy of the typed key→value map (the convergence-report
+        aggregation reads whole samples, not single keys)."""
+        return dict(self._values)
+
     def to_json(self) -> str:
         return json.dumps(
             {"time": int(self.timestamp), **self._values}, sort_keys=True

@@ -1,0 +1,170 @@
+"""Cross-node convergence reports.
+
+The per-node trace substrate — CONVERGENCE_TRACE spans (monitor/spans.py)
+and FLOOD_TRACE samples + `kvstore.flood.*` stats (kvstore/store.py) —
+answers "how fast did THIS node converge". The network-wide question
+("after one link flap, when did the LAST node program routes, and which
+hop was slowest?") needs an aggregation layer:
+
+  - `node_convergence_report(...)` distills one node's monitor ring and
+    kvstore flood stats into a JSON-serializable report (served by ctrl
+    `getConvergenceReport`);
+  - `aggregate_convergence_reports(...)` folds the reports of every node
+    of an emulator / VirtualNetwork run (or a `breeze perf report
+    --hosts ...` sweep) into network-wide convergence percentiles
+    (p50/p95/max node-to-converge), per-stage latency distributions with
+    slowest-hop attribution, and flood-health stats (hop latencies,
+    hop-count spread, redundant-flood ratio).
+
+This is the instrument DeltaPath (PAPERS.md) argues for: the metric that
+validates an accelerated SPF backend is event-to-network-wide-programmed-
+routes latency, not local solve time.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Optional
+
+from openr_tpu.monitor.spans import SPAN_EVENT
+
+FLOOD_TRACE_EVENT = "FLOOD_TRACE"  # mirrors kvstore/store.py (no import
+# cycle: kvstore.store already imports monitor.monitor)
+
+# span-sample keys that are not per-stage durations
+_NON_STAGE_KEYS = {"event", "span", "node_name", "total_ms"}
+
+
+def percentile_summary(values: Iterable[float]) -> Dict[str, float]:
+    """count/min/avg/p50/p95/max over a raw sample list (nearest-rank
+    percentiles — report sample sets are small, no bucketing needed)."""
+    samples = sorted(float(v) for v in values)
+    if not samples:
+        return {
+            "count": 0,
+            "min": 0.0,
+            "avg": 0.0,
+            "p50": 0.0,
+            "p95": 0.0,
+            "max": 0.0,
+        }
+
+    def rank(p: float) -> float:
+        idx = max(0, math.ceil(p / 100.0 * len(samples)) - 1)
+        return samples[min(idx, len(samples) - 1)]
+
+    return {
+        "count": len(samples),
+        "min": samples[0],
+        "avg": sum(samples) / len(samples),
+        "p50": rank(50),
+        "p95": rank(95),
+        "max": samples[-1],
+    }
+
+
+def node_convergence_report(
+    node_name: str, monitor, kvstore=None
+) -> Dict[str, Any]:
+    """One node's convergence evidence: finished spans and flood traces
+    from the monitor's event-log ring, plus the kvstore flood counters and
+    histogram exports. Everything in the result is JSON-serializable."""
+    spans: List[Dict[str, Any]] = []
+    floods: List[Dict[str, Any]] = []
+    for sample in monitor.get_event_logs():
+        event = sample.get("event")
+        if event == SPAN_EVENT:
+            spans.append(sample.values())
+        elif event == FLOOD_TRACE_EVENT:
+            floods.append(sample.values())
+    flood_stats: Dict[str, Any] = {"received": 0, "duplicates": 0}
+    if kvstore is not None:
+        counters = kvstore.counters
+        flood_stats["received"] = counters.get("kvstore.flood.received", 0)
+        flood_stats["duplicates"] = counters.get(
+            "kvstore.flood.duplicates", 0
+        )
+        flood_stats["hop_count_last"] = counters.get(
+            "kvstore.flood.hop_count_last", 0
+        )
+        histograms = getattr(kvstore, "histograms", None) or {}
+        for name in (
+            "kvstore.flood.hop_ms",
+            "kvstore.flood.e2e_ms",
+            "kvstore.flood.buffer_delay_ms",
+        ):
+            hist = histograms.get(name)
+            if hist is not None:
+                flood_stats[name.rsplit(".", 1)[-1]] = hist.to_dict()
+    received = flood_stats["received"]
+    flood_stats["duplicate_ratio"] = (
+        flood_stats["duplicates"] / received if received else 0.0
+    )
+    return {
+        "node": node_name,
+        "spans": spans,
+        "e2e_ms": [
+            s["total_ms"] for s in spans if s.get("total_ms") is not None
+        ],
+        "floods": floods,
+        "flood": flood_stats,
+    }
+
+
+def _span_stages(span: Dict[str, Any]) -> Dict[str, float]:
+    return {
+        key[: -len("_ms")]: float(value)
+        for key, value in span.items()
+        if key.endswith("_ms")
+        and key not in _NON_STAGE_KEYS
+        and isinstance(value, (int, float))
+    }
+
+
+def aggregate_convergence_reports(
+    reports: Iterable[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Fold per-node reports into the network-wide convergence view."""
+    reports = list(reports)
+    all_e2e: List[float] = []
+    node_e2e: Dict[str, Dict[str, float]] = {}
+    stage_samples: Dict[str, List[float]] = {}
+    slowest: Optional[Dict[str, Any]] = None
+    hop_ms: List[float] = []
+    hop_counts: List[int] = []
+    received = duplicates = 0
+    for report in reports:
+        node = report.get("node", "")
+        e2e = [float(v) for v in report.get("e2e_ms", [])]
+        all_e2e.extend(e2e)
+        node_e2e[node] = percentile_summary(e2e)
+        for span in report.get("spans", []):
+            for stage, ms in _span_stages(span).items():
+                stage_samples.setdefault(stage, []).append(ms)
+                if slowest is None or ms > slowest["ms"]:
+                    slowest = {"node": node, "stage": stage, "ms": ms}
+        for flood in report.get("floods", []):
+            if flood.get("hop_ms") is not None:
+                hop_ms.append(float(flood["hop_ms"]))
+            hop_counts.append(int(flood.get("hop_count", 0)))
+        flood_stats = report.get("flood", {})
+        received += int(flood_stats.get("received", 0))
+        duplicates += int(flood_stats.get("duplicates", 0))
+    return {
+        "nodes": len(reports),
+        "spans_total": sum(len(r.get("spans", [])) for r in reports),
+        "e2e_ms": percentile_summary(all_e2e),
+        "node_e2e_ms": node_e2e,
+        "stages": {
+            stage: percentile_summary(samples)
+            for stage, samples in sorted(stage_samples.items())
+        },
+        "slowest_stage": slowest,
+        "flood": {
+            "received": received,
+            "duplicates": duplicates,
+            "duplicate_ratio": duplicates / received if received else 0.0,
+            "hop_ms": percentile_summary(hop_ms),
+            "hop_count_max": max(hop_counts, default=0),
+        },
+    }

@@ -8,13 +8,19 @@ that trace: created when Decision keeps the oldest event of a debounce
 batch (seeded from the KvStore publication stamp when one rode along),
 marked at each pipeline stage —
 
-    kvstore publication → decision recv → debounce fire → route build
+    spark.neighbor_event → linkmonitor.adj_advertised
+    → [kvstore.flood.origin → kvstore.flood.hop1..k]   (remote events)
+    → kvstore.publish → decision recv → debounce fire → route build
     → fib recv → fib program
 
-— and finished by Fib once routes are programmed. Stage durations feed the
-`*_ms` histograms (decision.debounce_ms, decision.spf.solve_ms,
-fib.program_ms, convergence.e2e_ms) and the finished span is emitted as
-one CONVERGENCE_TRACE LogSample through the monitor queue.
+— and finished by Fib once routes are programmed. The pre-publish stages
+arrive either as monotonic `Publication.span_stages` marks (the local
+origin chain) or are reconstructed from wall-clock PerfEvents (flood-hop
+traces from remote nodes); from kvstore.publish on, every mark is taken
+live on this process's monotonic clock. Stage durations feed the `*_ms`
+histograms (decision.debounce_ms, decision.spf.solve_ms, fib.program_ms,
+convergence.e2e_ms) and the finished span is emitted as one
+CONVERGENCE_TRACE LogSample through the monitor queue.
 """
 
 from __future__ import annotations
@@ -42,11 +48,20 @@ class Span:
         self.t0 = time.monotonic() if t0 is None else t0
         self.marks: List[Tuple[str, float]] = []
 
-    def mark(self, stage: str) -> float:
+    def mark(self, stage: str, ts: Optional[float] = None) -> float:
         """Append a stage boundary; returns the stage's duration in ms
-        (time since the previous mark, or since t0 for the first)."""
-        now = time.monotonic()
+        (time since the previous mark, or since t0 for the first).
+
+        `ts` replays a mark that already happened at a known monotonic
+        time — the span-stage handoff (Publication.span_stages) and the
+        reconstructed flood-hop stages use it. Marks are kept monotonic:
+        a ts behind the previous mark (reconstruction jitter, cross-host
+        wall-clock skew) is clamped to it, yielding a zero-length stage
+        rather than a negative one."""
+        now = time.monotonic() if ts is None else ts
         prev = self.marks[-1][1] if self.marks else self.t0
+        if now < prev:
+            now = prev
         self.marks.append((stage, now))
         return (now - prev) * 1e3
 

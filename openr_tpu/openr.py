@@ -121,6 +121,9 @@ class OpenrDaemon:
                 use_native_store=c.kvstore_config.enable_native_store,
             ),
             loop=loop,
+            # flood-trace samples (FLOOD_TRACE) drain into the monitor's
+            # event-log ring next to the convergence traces
+            log_sample_fn=self.log_sample_queue.push,
         )
         # mutual-TLS contexts (Main.cpp:517-543): one server + one client
         # context shared by the ctrl server and the KvStore peering

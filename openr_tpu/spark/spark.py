@@ -35,6 +35,7 @@ from openr_tpu.spark.messages import (
     SparkHelloPacket,
     SparkHeartbeatMsg,
 )
+from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils import StepDetector
 from openr_tpu.utils.counters import CountersMixin
 
@@ -99,6 +100,11 @@ class NeighborEvent:
     kvstore_cmd_port: int = 0
     kvstore_host: str = ""
     openr_ctrl_thrift_port: int = 0
+    # time.monotonic() stamp of the moment Spark decided to publish this
+    # event — the first mark of the convergence span (LinkMonitor hands it
+    # through to the KvStore publication as Publication.span_stages).
+    # Host-local, like every monotonic stamp.
+    ts_monotonic: float = 0.0
 
 
 @dataclass
@@ -263,6 +269,19 @@ class Spark(CountersMixin):
     # outbound
     # ------------------------------------------------------------------
 
+    def _io_send(self, iface: str, packet: SparkHelloPacket) -> int:
+        """All outbound datagrams funnel through here so the named fault
+        point can drop them: a send fault IS a dropped packet (UDP
+        semantics) — the hello/heartbeat/handshake timers retransmit, so
+        injected loss exercises the discovery-delay and hold-expiry paths
+        without special-casing any caller."""
+        try:
+            fault_point("spark.packet_send", iface)
+        except Exception:
+            self._bump("spark.packet_send_failures")
+            return self.io.now_us()
+        return self.io.send(iface, packet)
+
     def _send_hello(
         self, iface: str, restarting: bool = False
     ) -> None:
@@ -285,7 +304,7 @@ class Spark(CountersMixin):
             restarting=restarting,
             sent_ts_in_us=self.io.now_us(),
         )
-        msg.sent_ts_in_us = self.io.send(
+        msg.sent_ts_in_us = self._io_send(
             iface, SparkHelloPacket(hello_msg=msg)
         )
         self._bump("spark.hello_packet_sent")
@@ -304,7 +323,7 @@ class Spark(CountersMixin):
     def _schedule_heartbeat(self, iface: str) -> None:
         if self._stopped or iface not in self.interfaces:
             return
-        self.io.send(
+        self._io_send(
             iface,
             SparkHelloPacket(
                 heartbeat_msg=SparkHeartbeatMsg(
@@ -325,7 +344,7 @@ class Spark(CountersMixin):
         ):
             return
         area = self.config.area_for(neighbor.node_name)
-        self.io.send(
+        self._io_send(
             neighbor.local_if,
             SparkHelloPacket(
                 handshake_msg=SparkHandshakeMsg(
@@ -356,6 +375,13 @@ class Spark(CountersMixin):
 
     def _on_packet(self, received: ReceivedPacket) -> None:
         if self._stopped or received.if_name not in self.interfaces:
+            return
+        try:
+            # named fault seam: an injected receive fault is a dropped
+            # datagram — peers' retransmit timers carry discovery forward
+            fault_point("spark.packet_recv", received)
+        except Exception:
+            self._bump("spark.packet_recv_failures")
             return
         packet = received.packet
         if packet.hello_msg is not None:
@@ -473,7 +499,7 @@ class Spark(CountersMixin):
             SparkNeighState.ESTABLISHED,
         ):
             area = self.config.area_for(msg.node_name)
-            self.io.send(
+            self._io_send(
                 iface,
                 SparkHelloPacket(
                     handshake_msg=SparkHandshakeMsg(
@@ -577,6 +603,7 @@ class Spark(CountersMixin):
     ) -> None:
         self.neighbor_events_queue.push(
             NeighborEvent(
+                ts_monotonic=time.monotonic(),
                 event_type=event_type,
                 node_name=neighbor.node_name,
                 local_if_name=neighbor.local_if,

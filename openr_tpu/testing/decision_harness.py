@@ -354,6 +354,137 @@ def run_fault_smoke() -> dict:
         loop.close()
 
 
+# stage-duration keys every node's flap span must carry (the spark→fib
+# chain; flood-hop stages are topology-dependent and checked separately)
+TRACE_SMOKE_STAGES = (
+    "spark.neighbor_event_ms",
+    "linkmonitor.adj_advertised_ms",
+    "kvstore.publish_ms",
+    "decision.recv_ms",
+    "decision.debounce_ms",
+    "decision.route_build_ms",
+    "fib.recv_ms",
+    "fib.program_ms",
+)
+
+
+def run_trace_smoke() -> dict:
+    """TRACE_SMOKE tier-1 smoke (the observability sibling of
+    run_fault_smoke): an N-node line-topology emulator run
+    (TRACE_SMOKE_NODES, default 5) converges, one link flaps, and the
+    network-wide trace substrate must hold up end to end —
+
+      - every node finishes a COMPLETE spark→fib convergence span
+        (locally-stamped monotonic stages on the flap endpoints,
+        flood-reconstructed stages on remote nodes);
+      - flood hop counts match topology distance on the line (node i
+        receives the flap origin's publication after exactly i-1 hops);
+      - the aggregated report (VirtualNetwork.convergence_report, the
+        `breeze perf report` math) carries sane network-wide percentiles
+        with slowest-hop attribution.
+
+    Returns a summary dict of the evidence.
+    """
+    import os
+
+    from openr_tpu.monitor.report import aggregate_convergence_reports
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    n = max(3, int(os.environ.get("TRACE_SMOKE_NODES", "5")))
+
+    def complete_span(report: dict) -> bool:
+        return any(
+            all(span.get(stage) is not None for stage in TRACE_SMOKE_STAGES)
+            for span in report["spans"]
+        )
+
+    async def body() -> dict:
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(f"n{i}", loopback_prefix=f"10.{i}.0.0/24")
+        await net.start_all()
+        for i in range(n - 1):
+            net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+        def converged() -> bool:
+            for i in range(n):
+                got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+                want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+                if not want.issubset(got):
+                    return False
+            return True
+
+        try:
+            await wait_until(converged, timeout=60.0)
+
+            # the flap: sever n0–n1; n1's adjacency withdrawal floods down
+            # the line and every node reprograms (withdraws 10.0.0.0/24)
+            net.fail_link("n0", "if0r", "n1", "if1l")
+
+            def withdrawn() -> bool:
+                for i in range(1, n):
+                    got = net.wrappers[f"n{i}"].programmed_prefixes()
+                    if "10.0.0.0/24" in got:
+                        return False
+                return True
+
+            await wait_until(withdrawn, timeout=60.0)
+            # spans finish asynchronously of route state: poll the monitor
+            # rings until every node shows a complete spark→fib span
+            await wait_until(
+                lambda: all(complete_span(r) for r in net.node_reports()),
+                timeout=30.0,
+            )
+
+            reports = {r["node"]: r for r in net.node_reports()}
+            hop_evidence = {}
+            for i in range(2, n):
+                node = f"n{i}"
+                hops = [
+                    f["hop_count"]
+                    for f in reports[node]["floods"]
+                    if f.get("origin") == "n1"
+                ]
+                assert (i - 1) in hops, (node, sorted(set(hops)))
+                hop_evidence[node] = i - 1
+                # remote nodes measured per-hop flood latency
+                assert any(
+                    f.get("hop_ms") is not None
+                    for f in reports[node]["floods"]
+                ), node
+
+            agg = aggregate_convergence_reports(reports.values())
+        finally:
+            await net.stop_all()
+
+        assert agg["nodes"] == n, agg
+        assert agg["spans_total"] >= n, agg
+        e2e = agg["e2e_ms"]
+        assert 0.0 < e2e["p50"] <= e2e["p95"] <= e2e["max"], e2e
+        assert agg["slowest_stage"] is not None, agg
+        assert agg["flood"]["received"] > 0, agg
+        assert agg["flood"]["hop_count_max"] >= n - 2, agg
+        for stage in ("decision.route_build", "fib.program"):
+            assert stage in agg["stages"], sorted(agg["stages"])
+        return {
+            "nodes": n,
+            "spans_total": agg["spans_total"],
+            "e2e_p50_ms": e2e["p50"],
+            "e2e_p95_ms": e2e["p95"],
+            "e2e_max_ms": e2e["max"],
+            "slowest_stage": agg["slowest_stage"],
+            "flood_received": agg["flood"]["received"],
+            "flood_duplicate_ratio": agg["flood"]["duplicate_ratio"],
+            "hop_evidence": hop_evidence,
+        }
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()
+
+
 def run_decision_backend_parity(
     my_node: str,
     publication: Publication,

@@ -208,3 +208,6 @@ def injected(injector: Optional[FaultInjector] = None):
 #   fib.sync              full-state syncFib push (fib/fib.py)
 #   fib.keepalive         agent aliveSince poll, ctx=Fib (fib/fib.py)
 #   kvstore.flood_send    per-peer flood RPC, ctx=peer name (kvstore/store.py)
+#   kvstore.full_sync     3-way full-sync dump RPC, ctx=peer name
+#   spark.packet_send     outbound datagram seam, ctx=iface (spark/spark.py)
+#   spark.packet_recv     inbound datagram seam, ctx=ReceivedPacket

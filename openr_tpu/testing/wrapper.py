@@ -13,10 +13,17 @@ from openr_tpu.openr import OpenrDaemon
 from openr_tpu.platform import MockFibHandler
 from openr_tpu.spark.io_provider import MockIoNetwork
 from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
+from openr_tpu.utils.ownership import owned_by
 
 
+@owned_by("emulator-loop")
 class VirtualNetwork:
-    """Shared fabric: Spark packet network + KvStore transport."""
+    """Shared fabric: Spark packet network + KvStore transport.
+
+    Owned by the emulating test's event loop: topology mutations
+    (add_node/connect/fail_link) must run on the loop the daemons run on —
+    the thread-ownership analyzer (docs/Analysis.md) enforces that no
+    ctrl-reachable path mutates this state from outside."""
 
     def __init__(self) -> None:
         self.io_network = MockIoNetwork()
@@ -59,6 +66,29 @@ class VirtualNetwork:
         for wrapper in reversed(list(self.wrappers.values())):
             await wrapper.stop()
 
+    # -- network-wide observability ---------------------------------------
+
+    def node_reports(self) -> List[dict]:
+        """Per-node convergence reports (the in-process equivalent of
+        calling ctrl getConvergenceReport on every daemon)."""
+        from openr_tpu.monitor.report import node_convergence_report
+
+        return [
+            node_convergence_report(
+                name, wrapper.daemon.monitor, kvstore=wrapper.daemon.kvstore
+            )
+            for name, wrapper in self.wrappers.items()
+        ]
+
+    def convergence_report(self) -> dict:
+        """Network-wide convergence report over all emulated nodes —
+        p50/p95/max node-to-converge, per-stage distributions with
+        slowest-hop attribution, flood-health stats (what `breeze perf
+        report --hosts ...` computes for real deployments)."""
+        from openr_tpu.monitor.report import aggregate_convergence_reports
+
+        return aggregate_convergence_reports(self.node_reports())
+
 
 # tightened timers for in-process convergence (OpenrSystemTest.cpp:23-35)
 _FAST_TIMERS = {
@@ -80,6 +110,7 @@ _FAST_TIMERS = {
 }
 
 
+@owned_by("emulator-loop")
 class OpenrWrapper:
     def __init__(
         self,

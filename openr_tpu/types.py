@@ -142,11 +142,17 @@ class MplsRoute:
 
 @dataclass(frozen=True)
 class PerfEvent:
-    """openr/if/Lsdb.thrift PerfEvent:23 — (node, event-name, unix ts ms)."""
+    """openr/if/Lsdb.thrift PerfEvent:23 — (node, event-name, unix ts ms).
+
+    unix_ts is wall-clock milliseconds; the reference truncates to int, but
+    sub-ms producers (the KvStore flood-hop trace, LinkMonitor's
+    adjacency-advertise stamps) may stamp floats — consumers only subtract
+    stamps, so both representations interoperate.
+    """
 
     node_name: str
     event_descr: str
-    unix_ts: int
+    unix_ts: float
 
 
 @dataclass
@@ -159,6 +165,11 @@ class PerfEvents:
         self.events.append(
             PerfEvent(node_name, descr, int(time.time() * 1000))
         )
+
+    def add_fine(self, node_name: str, descr: str) -> None:
+        """Stamp with sub-ms (float) resolution — per-hop flood latencies
+        inside one emulator host are well under a millisecond."""
+        self.events.append(PerfEvent(node_name, descr, time.time() * 1000.0))
 
     def copy(self) -> "PerfEvents":
         return PerfEvents(list(self.events))
@@ -393,6 +404,18 @@ class Publication:
     # span (monitor/spans.py). Host-local only: never serialized (wire.py
     # rebuilds publications without it) and meaningless across processes.
     ts_monotonic: Optional[float] = None
+    # monotonic (stage, ts) marks that happened BEFORE the publish stamp —
+    # spark.neighbor_event → linkmonitor.adj_advertised, handed through the
+    # module chain on the originating node so Decision's span covers
+    # hello-to-programmed-route. Host-local like ts_monotonic: never
+    # serialized, dropped at process boundaries.
+    span_stages: Optional[List[Tuple[str, float]]] = None
+    # wall-clock flood-hop trace (KVSTORE_FLOOD_ORIGINATED + one
+    # KVSTORE_FLOOD_RECEIVED per hop): unlike the two fields above this DOES
+    # cross nodes — it rides the KEY_SET RPC next to node_ids (wire.py), so
+    # every hop can measure per-hop flood latency and remote nodes can
+    # reconstruct the origin stages of their convergence spans.
+    perf_events: Optional[PerfEvents] = None
 
 
 # ---------------------------------------------------------------------------

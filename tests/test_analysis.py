@@ -392,6 +392,14 @@ _DRIFT_MONITORING = """# Monitoring
 | histogram | stage |
 |---|---|
 | `fib.work_ms` | emitted and documented |
+
+## Event logs
+
+| event | emitted by |
+|---|---|
+| `GOOD_TRACE` | emitted and documented |
+| `PHANTOM_EVENT` | documented but never emitted |
+| `BRACE_{UP,DOWN}` | brace family, UP emitted below |
 """
 
 _DRIFT_ROBUSTNESS = """# Robustness
@@ -403,6 +411,9 @@ _DRIFT_ROBUSTNESS = """# Robustness
 """
 
 _DRIFT_CODE = '''
+GOOD_EVENT = "GOOD_TRACE"
+
+
 def fault_point(name, ctx=None):
     pass
 
@@ -421,6 +432,11 @@ class Widget(CountersMixin):
         self._observe("fib.bad_unit", 1.0)
         fault_point("fib.io")
         fault_point("fib.rogue")
+
+    def emit(self, sample):
+        sample.add_string("event", GOOD_EVENT)
+        sample.add_string("event", "ROGUE_EVENT")
+        self._emit_sample("BRACE_UP", {}, {})
 '''
 
 _DRIFT_CONFIG = '''
@@ -480,11 +496,18 @@ def test_registry_drift_fixture_violations(tmp_path):
         "documented_knob" in m
         for m in by_check["undocumented-config-knob"]
     )
+    # LogSample event catalog, both directions: a literal AND a
+    # module-constant emission must resolve; brace rows expand
+    assert any("ROGUE_EVENT" in m for m in by_check["undocumented-event"])
+    assert any("PHANTOM_EVENT" in m for m in by_check["ghost-event"])
+    assert any("BRACE_DOWN" in m for m in by_check["ghost-event"])
     # the consistent names stay quiet
     joined = " ".join(m for ms in by_check.values() for m in ms)
     assert "fib.good_counter" not in joined
     assert "'fib.work_ms'" not in joined
     assert "'fib.io'" not in joined
+    assert "GOOD_TRACE" not in joined
+    assert "'BRACE_UP'" not in joined
 
 
 def test_registry_drift_doc_checks_skip_partial_scans(tmp_path):

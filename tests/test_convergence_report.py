@@ -1,0 +1,491 @@
+"""Cross-node convergence tracing (ISSUE 5): KvStore flood-hop traces
+(per-hop PerfEvent stamps, hop counts, duplicate accounting, buffer
+delay), publication-stamp completeness, the report aggregation layer
+(monitor/report.py), and its ctrl/breeze surfaces."""
+
+import asyncio
+import json
+
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreParams,
+    PeerSpec,
+)
+from openr_tpu.kvstore import wire
+from openr_tpu.kvstore.store import (
+    FLOOD_ORIGINATED_EVENT,
+    FLOOD_RECEIVED_EVENT,
+    FLOOD_TRACE_EVENT,
+)
+from openr_tpu.monitor import LogSample, Monitor
+from openr_tpu.monitor.report import (
+    aggregate_convergence_reports,
+    node_convergence_report,
+    percentile_summary,
+)
+from openr_tpu.monitor.spans import SPAN_EVENT
+from openr_tpu.types import PerfEvents, Publication, Value
+
+
+def run(coro, timeout=60.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def v(version=1, originator="node1", value=b"data"):
+    return Value(version, originator, value)
+
+
+def make_stores(names, log_sinks=None, **params_kw):
+    transport = InProcessTransport()
+    stores = {}
+    for name in names:
+        stores[name] = KvStore(
+            name,
+            ["0"],
+            transport,
+            params=KvStoreParams(node_id=name, **params_kw),
+            log_sample_fn=(
+                log_sinks[name].append if log_sinks is not None else None
+            ),
+        )
+    return stores, transport
+
+
+async def settle(delay=0.05):
+    await asyncio.sleep(delay)
+
+
+class TestFloodHopTrace:
+    def test_chain_flood_carries_hop_trace(self):
+        """a → b → c: c receives the publication with the origin stamp and
+        one per-hop stamp, measures hop latency, and records hop count 2."""
+        sinks = {n: [] for n in ("a", "b", "c")}
+
+        async def body():
+            stores, _ = make_stores(["a", "b", "c"], log_sinks=sinks)
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a"), "c": PeerSpec("c")})
+            stores["c"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            for sink in sinks.values():
+                sink.clear()
+            stores["a"].set_key("k", v(originator="a", value=b"flood"))
+            await settle()
+            return stores
+
+        stores = run(body())
+        # b is one hop from the origin, c two
+        assert stores["b"].db().counters["kvstore.flood.hop_count_last"] == 1
+        assert stores["c"].db().counters["kvstore.flood.hop_count_last"] == 2
+        # per-hop + e2e latency histograms recorded on both receivers
+        for name in ("b", "c"):
+            hists = stores[name].histograms
+            assert hists["kvstore.flood.hop_ms"].count >= 1
+            assert hists["kvstore.flood.e2e_ms"].count >= 1
+        # c's FLOOD_TRACE names the origin and the 2-hop path
+        traces = [
+            s for s in sinks["c"] if s.get("event") == FLOOD_TRACE_EVENT
+        ]
+        assert traces, sinks["c"]
+        flap = [t for t in traces if t.get("origin") == "a"]
+        assert flap and flap[-1].get("hop_count") == 2
+        assert flap[-1].get("hop_ms") is not None
+        assert flap[-1].get("e2e_ms") >= flap[-1].get("hop_ms") - 1e-6
+
+    def test_ring_duplicate_floods_counted(self):
+        """In a full mesh of three stores the same update arrives over
+        multiple paths: the extra arrivals are redundant floods (path
+        vector loops or empty merges) and must show up in the duplicate
+        ratio."""
+
+        async def body():
+            stores, _ = make_stores(["a", "b", "c"])
+            ring = {"a": ["b", "c"], "b": ["a", "c"], "c": ["a", "b"]}
+            for name, peers in ring.items():
+                stores[name].add_peers({p: PeerSpec(p) for p in peers})
+            await settle()
+            stores["a"].set_key("k", v(originator="a", value=b"ring"))
+            await settle()
+            return stores
+
+        stores = run(body())
+        received = sum(
+            s.counters.get("kvstore.flood.received", 0)
+            for s in stores.values()
+        )
+        duplicates = sum(
+            s.counters.get("kvstore.flood.duplicates", 0)
+            for s in stores.values()
+        )
+        assert received > 0
+        assert 0 < duplicates < received
+
+    def test_rate_limited_buffer_records_queue_delay(self):
+        async def body():
+            stores, _ = make_stores(
+                ["a", "b"],
+                flood_rate=2.0,
+                flood_burst=2.0,
+                flood_buffer_delay=0.03,
+            )
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a")})
+            await settle()
+            for i in range(10):
+                stores["a"].set_key(f"k{i}", v(originator="a", value=b"x"))
+            await settle(0.4)
+            return stores
+
+        stores = run(body())
+        hist = stores["a"].histograms["kvstore.flood.buffer_delay_ms"]
+        assert hist.count >= 1
+        assert hist.max > 0.0
+
+    def test_origin_stamp_only_at_origin(self):
+        """A forwarded publication must not be re-stamped as originated:
+        the trace c receives starts with a's origin event followed by b's
+        receive event, in stamp order."""
+        captured = {}
+
+        async def body():
+            stores, transport = make_stores(["a", "b", "c"])
+            original = transport.call_set
+
+            async def spy(caller, peer_addr, area, kv, node_ids, perf=None):
+                if caller == "b" and peer_addr == "c":
+                    captured["perf"] = perf
+                await original(caller, peer_addr, area, kv, node_ids, perf)
+
+            transport.call_set = spy
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a"), "c": PeerSpec("c")})
+            stores["c"].add_peers({"b": PeerSpec("b")})
+            await settle()
+            stores["a"].set_key("k", v(originator="a", value=b"flood"))
+            await settle()
+
+        run(body())
+        perf = captured["perf"]
+        descrs = [(e.node_name, e.event_descr) for e in perf.events]
+        assert descrs == [
+            ("a", FLOOD_ORIGINATED_EVENT),
+            ("b", FLOOD_RECEIVED_EVENT),
+        ]
+        assert perf.events[0].unix_ts <= perf.events[1].unix_ts
+
+
+class TestFloodTraceBound:
+    def test_trace_keeps_origin_plus_recent_hops(self):
+        """The timing trace is capped (origin + most recent hops) so
+        large-diameter topologies don't pay O(diameter²) per publication;
+        hop COUNTS come from the uncapped nodeIds vector."""
+        from openr_tpu.kvstore.store import FLOOD_TRACE_MAX_EVENTS
+
+        stores, _ = make_stores(["z"])
+        db = stores["z"].db()
+        perf = PerfEvents()
+        perf.add_fine("origin", FLOOD_ORIGINATED_EVENT)
+        for i in range(FLOOD_TRACE_MAX_EVENTS + 10):
+            perf.add_fine(f"hop{i}", FLOOD_RECEIVED_EVENT)
+        node_ids = ["origin"] + [
+            f"hop{i}" for i in range(FLOOD_TRACE_MAX_EVENTS + 10)
+        ]
+        # db has no peers, so observe the capped trace on the internal
+        # publication instead of a peer forward
+        reader = stores["z"].updates_queue.get_reader()
+        db.handle_set_key_vals(
+            {"k": v(originator="origin")}, node_ids, perf
+        )
+        pub = reader.try_get()
+        assert pub is not None
+        traced = pub.perf_events
+        assert len(traced.events) == FLOOD_TRACE_MAX_EVENTS
+        # origin stamp survives; the newest hop is this store's own stamp
+        assert traced.events[0].event_descr == FLOOD_ORIGINATED_EVENT
+        assert traced.events[-1].node_name == "z"
+        # the exact hop count rode the path vector, uncapped
+        assert db.counters["kvstore.flood.hop_count_last"] == len(node_ids)
+
+
+class TestPublicationStamps:
+    """Satellite: every publication-emitting path stamps ts_monotonic so
+    downstream spans never seed from a missing stamp."""
+
+    def test_dump_and_sync_responses_are_stamped(self):
+        stores, _ = make_stores(["a"])
+        db = stores["a"].db()
+        db.set_key_vals({"k": v(originator="a")})
+        assert db.dump_all().ts_monotonic is not None
+        assert db.dump_hashes().ts_monotonic is not None
+        assert db.get_key_vals(["k"]).ts_monotonic is not None
+        # full-sync response (3-way difference) path
+        hashes = db.dump_hashes().key_vals
+        assert db.handle_dump(hashes).ts_monotonic is not None
+        assert db.handle_dump(None).ts_monotonic is not None
+
+    def test_internal_publications_are_stamped(self):
+        async def body():
+            stores, _ = make_stores(["a", "b"])
+            reader = stores["b"].updates_queue.get_reader()
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"a": PeerSpec("a")})
+            await settle()
+            stores["a"].set_key("k", v(originator="a"))
+            await settle()
+            pubs = []
+            while True:
+                pub = reader.try_get()
+                if pub is None:
+                    break
+                pubs.append(pub)
+            assert pubs
+            assert all(p.ts_monotonic is not None for p in pubs)
+
+        run(body())
+
+
+class TestWireRoundTrip:
+    def test_perf_events_survive_publication_json(self):
+        perf = PerfEvents()
+        perf.add_fine("a", FLOOD_ORIGINATED_EVENT)
+        perf.add_fine("b", FLOOD_RECEIVED_EVENT)
+        pub = Publication(
+            key_vals={"k": v(originator="a")},
+            node_ids=["a", "b"],
+            perf_events=perf,
+        )
+        decoded = wire.publication_from_json(
+            json.loads(json.dumps(wire.publication_to_json(pub)))
+        )
+        assert decoded.node_ids == ["a", "b"]
+        got = [
+            (e.node_name, e.event_descr, e.unix_ts)
+            for e in decoded.perf_events.events
+        ]
+        want = [
+            (e.node_name, e.event_descr, e.unix_ts) for e in perf.events
+        ]
+        assert got == want
+
+    def test_absent_trace_stays_absent(self):
+        pub = Publication(key_vals={"k": v()})
+        decoded = wire.publication_from_json(wire.publication_to_json(pub))
+        assert decoded.perf_events is None
+
+
+# ---------------------------------------------------------------------------
+# report aggregation
+# ---------------------------------------------------------------------------
+
+
+def _span_sample(node, total_ms, stages):
+    sample = LogSample()
+    sample.add_string("event", SPAN_EVENT)
+    sample.add_string("span", "convergence")
+    sample.add_string("node_name", node)
+    for stage, ms in stages.items():
+        sample.add_double(f"{stage}_ms", ms)
+    sample.add_double("total_ms", total_ms)
+    return sample
+
+
+def _flood_sample(origin, hop_count, hop_ms):
+    sample = LogSample()
+    sample.add_string("event", FLOOD_TRACE_EVENT)
+    sample.add_string("origin", origin)
+    sample.add_int("hop_count", hop_count)
+    sample.add_int("keys", 1)
+    sample.add_int("updated", 1)
+    sample.add_int("duplicate", 0)
+    sample.add_double("hop_ms", hop_ms)
+    sample.add_double("e2e_ms", hop_ms * hop_count)
+    return sample
+
+
+class TestPercentileSummary:
+    def test_empty(self):
+        summary = percentile_summary([])
+        assert summary["count"] == 0 and summary["p95"] == 0.0
+
+    def test_order_and_bounds(self):
+        summary = percentile_summary(range(1, 101))
+        assert summary["count"] == 100
+        assert summary["min"] == 1 and summary["max"] == 100
+        assert summary["p50"] == 50 and summary["p95"] == 95
+        assert summary["p50"] <= summary["p95"] <= summary["max"]
+
+
+class TestReportAggregation:
+    def _monitor(self, node, samples):
+        monitor = Monitor(node)
+        for sample in samples:
+            monitor.add_event_log(sample)
+        return monitor
+
+    def test_node_report_collects_spans_and_floods(self):
+        monitor = self._monitor(
+            "n1",
+            [
+                _span_sample("n1", 12.0, {"decision.recv": 1.0}),
+                _flood_sample("n0", 2, 0.5),
+                LogSample().add_string("event", "SOLVER_BREAKER_TRIPPED"),
+            ],
+        )
+        report = node_convergence_report("n1", monitor)
+        assert len(report["spans"]) == 1
+        assert report["e2e_ms"] == [12.0]
+        assert len(report["floods"]) == 1
+        assert report["flood"]["duplicate_ratio"] == 0.0
+
+    def test_aggregate_percentiles_and_slowest_stage(self):
+        reports = []
+        for i, node in enumerate(("n0", "n1", "n2")):
+            monitor = self._monitor(
+                node,
+                [
+                    _span_sample(
+                        node,
+                        10.0 * (i + 1),
+                        {
+                            "decision.route_build": 2.0,
+                            "fib.program": 5.0 * (i + 1),
+                        },
+                    ),
+                    _flood_sample("n0", i, 0.25 * (i + 1)),
+                ],
+            )
+            reports.append(node_convergence_report(node, monitor))
+        agg = aggregate_convergence_reports(reports)
+        assert agg["nodes"] == 3 and agg["spans_total"] == 3
+        assert agg["e2e_ms"]["p50"] == 20.0
+        assert agg["e2e_ms"]["max"] == 30.0
+        assert agg["slowest_stage"] == {
+            "node": "n2",
+            "stage": "fib.program",
+            "ms": 15.0,
+        }
+        assert set(agg["stages"]) == {"decision.route_build", "fib.program"}
+        assert agg["flood"]["hop_count_max"] == 2
+        assert agg["flood"]["hop_ms"]["count"] == 3
+        # per-node breakdown present for dashboards
+        assert agg["node_e2e_ms"]["n1"]["max"] == 20.0
+
+
+class TestCtrlAndBreezeSurfaces:
+    def test_ctrl_get_convergence_report(self):
+        from openr_tpu.ctrl.server import CtrlServer
+
+        stores, _ = make_stores(["a"])
+        monitor = Monitor("a")
+        monitor.add_event_log(
+            _span_sample("a", 7.0, {"decision.recv": 1.0})
+        )
+        server = CtrlServer("a", kvstore=stores["a"], monitor=monitor)
+        report = server.m_getConvergenceReport({})
+        assert report["node"] == "a"
+        assert report["e2e_ms"] == [7.0]
+        # the report must be JSON-serializable (it rides the ctrl wire)
+        json.dumps(report)
+
+    def test_breeze_perf_report_renders(self, capsys):
+        from openr_tpu.cli.breeze import build_parser, cmd_perf
+
+        report = {
+            "node": "a",
+            "spans": [
+                {
+                    "decision.route_build_ms": 2.0,
+                    "fib.program_ms": 3.0,
+                    "total_ms": 9.0,
+                }
+            ],
+            "e2e_ms": [9.0],
+            "floods": [{"hop_count": 2, "hop_ms": 0.4}],
+            "flood": {"received": 4, "duplicates": 1},
+        }
+
+        class StubClient:
+            ssl_context = None
+
+            def call(self, method, **params):
+                assert method == "getConvergenceReport"
+                return report
+
+        args = build_parser().parse_args(
+            ["--port", "1", "perf", "report", "--json"]
+        )
+        cmd_perf(StubClient(), args)
+        out = capsys.readouterr().out
+        assert "network-wide convergence: 1 node(s)" in out
+        assert "node-to-converge e2e_ms" in out
+        assert "stage fib.program_ms" in out
+        assert "slowest hop: fib.program on a" in out
+        assert "max hop count 2" in out
+        assert '"nodes": 1' in out  # --json dump
+
+    def test_breeze_perf_report_against_live_emulator(self):
+        """ISSUE 5 acceptance surface, end to end over real sockets: an
+        emulator run, `breeze perf report --hosts <peer>` against the live
+        ctrl servers, network-wide percentiles out."""
+        import contextlib
+        import io
+
+        from openr_tpu.cli import breeze
+        from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+        async def body():
+            net = VirtualNetwork()
+            a = net.add_node("ra", loopback_prefix="10.91.0.0/24")
+            b = net.add_node("rb", loopback_prefix="10.92.0.0/24")
+            await net.start_all()
+            net.connect("ra", "eth0", "rb", "eth0")
+            await wait_until(
+                lambda: "10.92.0.0/24" in a.programmed_prefixes()
+                and "10.91.0.0/24" in b.programmed_prefixes(),
+                timeout=30,
+            )
+
+            def has_span(wrapper):
+                return any(
+                    s.get("event") == SPAN_EVENT
+                    for s in wrapper.daemon.monitor.get_event_logs()
+                )
+
+            await wait_until(
+                lambda: has_span(a) and has_span(b), timeout=30
+            )
+            loop = asyncio.get_running_loop()
+
+            def collect() -> str:
+                # the blocking CLI client must not run on the loop thread
+                # that serves the ctrl sockets — executor it is
+                args = breeze.build_parser().parse_args(
+                    [
+                        "--port", str(a.ctrl_port),
+                        "perf", "report",
+                        "--hosts", f"127.0.0.1:{b.ctrl_port}",
+                    ]
+                )
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    with breeze.BlockingCtrlClient(
+                        "127.0.0.1", a.ctrl_port
+                    ) as client:
+                        breeze.cmd_perf(client, args)
+                return buf.getvalue()
+
+            try:
+                return await loop.run_in_executor(None, collect)
+            finally:
+                await net.stop_all()
+
+        out = run(body())
+        assert "network-wide convergence: 2 node(s)" in out
+        assert "node-to-converge e2e_ms" in out
+        assert "stage fib.program_ms" in out
+        assert "slowest hop:" in out
+        assert "flood:" in out

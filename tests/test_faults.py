@@ -194,6 +194,152 @@ class TestThreadedFaultPoints:
 
         asyncio.new_event_loop().run_until_complete(body())
 
+    def test_kvstore_full_sync_seam(self):
+        """An injected full-sync dump failure rides the retry/backoff FSM:
+        the failure counter bumps, the peer drops to IDLE, and the retry
+        task eventually syncs anyway."""
+        from openr_tpu.kvstore import (
+            InProcessTransport,
+            KvStore,
+            KvStoreParams,
+            PeerSpec,
+        )
+        from openr_tpu.types import TTL_INFINITY, Value
+
+        async def body():
+            transport = InProcessTransport()
+            stores = {
+                name: KvStore(
+                    name,
+                    ["0"],
+                    transport,
+                    params=KvStoreParams(node_id=name),
+                )
+                for name in ("a", "b")
+            }
+            stores["b"].set_key("k", Value(1, "b", b"x", TTL_INFINITY, 0))
+            with injected() as inj:
+                inj.arm("kvstore.full_sync", times=1)
+                stores["a"].add_peers({"b": PeerSpec("b")})
+                deadline = asyncio.get_event_loop().time() + 5.0
+                while stores["a"].get_key("k") is None:
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                assert inj.fired("kvstore.full_sync") == 1
+            assert (
+                stores["a"].db().counters.get("kvstore.full_sync_failure")
+                == 1
+            )
+
+        asyncio.new_event_loop().run_until_complete(body())
+
+    def test_spark_packet_seams_drop_datagrams(self):
+        """Injected packet-I/O faults are dropped datagrams: counted, not
+        raised into Spark's timer callbacks."""
+        from openr_tpu.messaging import ReplicateQueue
+        from openr_tpu.spark.io_provider import MockIoNetwork
+        from openr_tpu.spark.spark import Spark, SparkConfig
+
+        async def body():
+            network = MockIoNetwork()
+            network.connect(("a", "eth0"), ("b", "eth0"), latency_ms=0.1)
+            sparks = {
+                name: Spark(
+                    SparkConfig(
+                        node_name=name,
+                        fastinit_hello_time=0.02,
+                        keepalive_time=0.05,
+                    ),
+                    network.provider(name),
+                    ReplicateQueue(),
+                )
+                for name in ("a", "b")
+            }
+            with injected() as inj:
+                inj.arm("spark.packet_send", times=3)
+                inj.arm("spark.packet_recv", times=2)
+                for spark in sparks.values():
+                    spark.update_interfaces(["eth0"])
+                deadline = asyncio.get_event_loop().time() + 10.0
+                while not (
+                    sparks["a"].get_neighbors() and sparks["b"].get_neighbors()
+                ):
+                    assert asyncio.get_event_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                assert inj.fired("spark.packet_send") == 3
+                assert inj.fired("spark.packet_recv") == 2
+            counters = {}
+            for spark in sparks.values():
+                for key, value in spark.counters.items():
+                    counters[key] = counters.get(key, 0) + value
+                spark.stop()
+            assert counters.get("spark.packet_send_failures", 0) == 3
+            assert counters.get("spark.packet_recv_failures", 0) == 2
+            # despite the losses, discovery proceeded (retransmit timers)
+            assert counters["spark.hello_packet_recv"] > 0
+
+        asyncio.new_event_loop().run_until_complete(body())
+
+
+class TestChaosSchedule:
+    """Satellite: a randomized multi-point FaultInjector schedule — seeded
+    probability arms on the Spark packet seams, KvStore flood sends and
+    full-syncs, all with bounded budgets — over a whole-stack 3-node
+    emulator run that must converge anyway (drops retransmit, flood
+    failures ride the peer FSM retry, failed syncs back off and retry)."""
+
+    def test_randomized_multi_point_schedule_converges(self):
+        from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+        async def body():
+            with injected(FaultInjector(seed=1234)) as inj:
+                inj.arm("spark.packet_send", probability=0.2, times=8)
+                inj.arm("spark.packet_recv", probability=0.2, times=8)
+                inj.arm("kvstore.flood_send", probability=0.3, times=5)
+                inj.arm("kvstore.full_sync", probability=0.3, times=3)
+                net = VirtualNetwork()
+                for i in range(3):
+                    net.add_node(
+                        f"c{i}", loopback_prefix=f"10.25{i}.0.0/24"
+                    )
+                await net.start_all()
+                net.connect("c0", "r", "c1", "l")
+                net.connect("c1", "r", "c2", "l")
+
+                def converged():
+                    for i in range(3):
+                        got = set(
+                            net.wrappers[f"c{i}"].programmed_prefixes()
+                        )
+                        want = {
+                            f"10.25{j}.0.0/24" for j in range(3) if j != i
+                        }
+                        if not want.issubset(got):
+                            return False
+                    return True
+
+                try:
+                    await wait_until(converged, timeout=60.0)
+                    # the chaos arms actually exercised their seams
+                    hits = {
+                        point: inj.hits(point)
+                        for point in (
+                            "spark.packet_send",
+                            "spark.packet_recv",
+                            "kvstore.flood_send",
+                            "kvstore.full_sync",
+                        )
+                    }
+                    assert all(count > 0 for count in hits.values()), hits
+                    fired = sum(
+                        inj.fired(point) for point in hits
+                    )
+                    assert fired > 0, "no chaos fault ever fired"
+                finally:
+                    await net.stop_all()
+
+        asyncio.new_event_loop().run_until_complete(body())
+
 
 def test_fault_smoke(monkeypatch):
     """FAULT_SMOKE=1 tier-1 smoke: Decision(tpu, supervised)→Fib flap

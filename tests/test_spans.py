@@ -61,6 +61,111 @@ class TestSpan:
         assert sample.get("fib.program_ms") >= 0.0
         assert sample.get("total_ms") >= 0.0
 
+    def test_explicit_ts_replays_past_marks(self):
+        t0 = time.monotonic() - 0.100
+        span = Span("convergence", t0=t0)
+        first = span.mark("spark.neighbor_event", ts=t0)
+        mid = span.mark("linkmonitor.adj_advertised", ts=t0 + 0.040)
+        last = span.mark("kvstore.publish")
+        assert first == 0.0
+        assert 39.0 <= mid <= 41.0
+        assert last >= 55.0  # ~60ms of real elapsed time remain
+
+    def test_out_of_order_ts_clamps_to_previous_mark(self):
+        span = Span("convergence")
+        span.mark("a")
+        behind = span.mark("b", ts=span.marks[0][1] - 1.0)
+        assert behind == 0.0
+        durations = span.stage_durations_ms()
+        assert durations["b"] == 0.0
+        assert span.marks[1][1] == span.marks[0][1]
+
+
+class TestSpanSeeding:
+    """Decision's span construction from pre-publish stages: exact
+    monotonic span_stages on the origin node, wall-clock reconstruction
+    (origin PerfEvents + flood hop trace) on remote nodes."""
+
+    def _stages(self, span):
+        return [stage for stage, _ in span.marks]
+
+    def test_local_span_stages_prefix_the_span(self):
+        from openr_tpu.decision.decision import _build_span
+        from openr_tpu.types import Publication
+
+        now = time.monotonic()
+        pub = Publication(
+            ts_monotonic=now,
+            span_stages=[
+                ("spark.neighbor_event", now - 0.050),
+                ("linkmonitor.adj_advertised", now - 0.020),
+            ],
+        )
+        span = _build_span(None, pub)
+        assert self._stages(span) == [
+            "spark.neighbor_event",
+            "linkmonitor.adj_advertised",
+            "kvstore.publish",
+        ]
+        durations = span.stage_durations_ms()
+        assert durations["spark.neighbor_event"] == 0.0  # == t0
+        assert 29.0 <= durations["linkmonitor.adj_advertised"] <= 31.0
+        assert 19.0 <= durations["kvstore.publish"] <= 21.0
+
+    def test_remote_span_reconstructed_from_wall_clock_traces(self):
+        from openr_tpu.decision.decision import _build_span
+        from openr_tpu.kvstore.store import (
+            FLOOD_ORIGINATED_EVENT,
+            FLOOD_RECEIVED_EVENT,
+        )
+        from openr_tpu.types import PerfEvent, PerfEvents, Publication
+
+        now_wall = time.time() * 1e3
+        value_perf = PerfEvents(
+            [
+                PerfEvent("n1", "NEIGHBOR_EVENT_RECVD", now_wall - 50.0),
+                PerfEvent("n1", "ADJ_DB_ADVERTISED", now_wall - 40.0),
+            ]
+        )
+        flood = PerfEvents(
+            [
+                PerfEvent("n1", FLOOD_ORIGINATED_EVENT, now_wall - 30.0),
+                PerfEvent("n2", FLOOD_RECEIVED_EVENT, now_wall - 20.0),
+                PerfEvent("n3", FLOOD_RECEIVED_EVENT, now_wall - 10.0),
+            ]
+        )
+        pub = Publication(
+            ts_monotonic=time.monotonic(), perf_events=flood
+        )
+        span = _build_span(value_perf, pub)
+        assert self._stages(span) == [
+            "spark.neighbor_event",
+            "linkmonitor.adj_advertised",
+            "kvstore.flood.origin",
+            "kvstore.flood.hop1",
+            "kvstore.flood.hop2",
+            "kvstore.publish",
+        ]
+        durations = span.stage_durations_ms()
+        # the 10ms wall-clock gaps survive the monotonic reconstruction
+        for stage in (
+            "linkmonitor.adj_advertised",
+            "kvstore.flood.origin",
+            "kvstore.flood.hop1",
+            "kvstore.flood.hop2",
+        ):
+            assert 8.0 <= durations[stage] <= 12.0, (stage, durations)
+        assert span.elapsed_ms() >= 45.0
+
+    def test_no_stages_falls_back_to_publish_stamp(self):
+        from openr_tpu.decision.decision import _build_span
+        from openr_tpu.types import Publication
+
+        now = time.monotonic()
+        span = _build_span(None, Publication(ts_monotonic=now))
+        assert self._stages(span) == ["kvstore.publish"]
+        assert span.t0 == now
+
 
 def _flap_publication(edges, metric, nodes=("g0_0", "g0_1"), version=2):
     """Publication re-announcing `nodes` adj dbs with the (g0_0, g0_1)

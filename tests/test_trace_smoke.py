@@ -1,0 +1,24 @@
+"""TRACE_SMOKE tier-1 harness entry (the observability sibling of the
+FAULT_SMOKE test in test_faults.py): a 5-node line-topology emulator run
+with one link flap must yield a complete spark→fib convergence span on
+every node, flood hop counts matching topology distance, and a sane
+network-wide convergence report (ISSUE 5 acceptance)."""
+
+def test_trace_smoke(monkeypatch):
+    monkeypatch.setenv("TRACE_SMOKE", "1")
+    monkeypatch.setenv("TRACE_SMOKE_NODES", "5")
+    from openr_tpu.testing.decision_harness import run_trace_smoke
+
+    summary = run_trace_smoke()
+    assert summary["nodes"] == 5
+    # at least one finished span per node (cold convergence + the flap)
+    assert summary["spans_total"] >= 5
+    assert 0.0 < summary["e2e_p50_ms"] <= summary["e2e_max_ms"]
+    # slowest-hop attribution names a real (node, stage) pair
+    assert summary["slowest_stage"]["node"].startswith("n")
+    assert summary["slowest_stage"]["ms"] > 0.0
+    # the line topology's flood distances: n2/n3/n4 saw n1's flap
+    # publication after exactly 1/2/3 hops
+    assert summary["hop_evidence"] == {"n2": 1, "n3": 2, "n4": 3}
+    assert summary["flood_received"] > 0
+    assert 0.0 <= summary["flood_duplicate_ratio"] < 1.0
