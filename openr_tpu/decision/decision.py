@@ -31,6 +31,7 @@ from openr_tpu.monitor.spans import Span
 from openr_tpu.solver import (
     DecisionRouteDb,
     DecisionRouteUpdate,
+    DeltaRouteBuilder,
     SolverSupervisor,
     SpfSolver,
     SupervisorConfig,
@@ -119,13 +120,18 @@ _FLOOD_RECEIVED = "KVSTORE_FLOOD_RECEIVED"
 
 
 class _PendingUpdates:
-    """Batch tracker (Decision.h:95-207)."""
+    """Batch tracker (Decision.h:95-207), extended with the DeltaPath dirty
+    set: the prefixes whose advertisements this batch touched, and whether
+    anything in the batch disqualifies the partial route rebuild (label
+    moves, adjacency changes incident to me, structural deletes)."""
 
     def __init__(self) -> None:
         self.count = 0
         self.perf_events: Optional[PerfEvents] = None
         self.needs_route_update = False
         self.span: Optional[Span] = None
+        self.dirty_prefixes: Set = set()
+        self.force_full = False
 
     def apply(
         self,
@@ -160,6 +166,8 @@ class _PendingUpdates:
         self.perf_events = None
         self.needs_route_update = False
         self.span = None
+        self.dirty_prefixes = set()
+        self.force_full = False
 
 
 def _build_span(
@@ -284,6 +292,10 @@ class Decision(CountersMixin, HistogramsMixin):
         self._full_db_entries: Dict[tuple, Dict] = {}
         self.route_db = DecisionRouteDb()
         self.rib_policy: Optional[RibPolicy] = None
+        # DeltaPath: builds DecisionRouteUpdates directly from the device
+        # delta's changed destinations when the event qualifies, falling
+        # back to the classic full build + get_route_delta diff
+        self._delta_builder = DeltaRouteBuilder(self.solver)
         self._pending = _PendingUpdates()
         self._rebuild_debounce = AsyncDebounce(
             config.debounce_min,
@@ -350,6 +362,7 @@ class Decision(CountersMixin, HistogramsMixin):
     def _end_cold_start(self) -> None:
         self._cold_start_until = None
         self._pending.needs_route_update = True
+        self._pending.force_full = True
         self.rebuild_routes()
 
     async def _run(self) -> None:
@@ -424,6 +437,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 node = key[len(ADJ_DB_MARKER):]
                 if link_state.delete_adjacency_database(node).topology_changed:
                     changed = True
+                    self._pending.force_full = True  # structural delete
                     self._pending.apply(None, publication)
             elif key.startswith(PREFIX_DB_MARKER):
                 node, _, _ = parse_prefix_key(key)
@@ -436,8 +450,10 @@ class Decision(CountersMixin, HistogramsMixin):
                 if node_db is None:
                     continue
                 node_db.area = area
-                if self.prefix_state.update_prefix_database(node_db):
+                dirty = self.prefix_state.update_prefix_database(node_db)
+                if dirty:
                     changed = True
+                    self._pending.dirty_prefixes |= dirty
                     self._pending.apply(None, publication)
 
         if changed:
@@ -487,6 +503,7 @@ class Decision(CountersMixin, HistogramsMixin):
         change = link_state.bulk_update_adjacency_databases(adj_dbs)
         self._bump("decision.adj_db_update", len(adj_dbs))
         self._bump("decision.bulk_adj_ingests")
+        self._pending.force_full = True  # cold-start ingest
         if not (
             change.topology_changed
             or change.link_attributes_changed
@@ -531,6 +548,21 @@ class Decision(CountersMixin, HistogramsMixin):
                 or change.node_label_changed
             ):
                 changed = True
+                # DeltaPath qualification: a label move re-arbitrates the
+                # whole node-label table, and an adjacency update touching
+                # my own links changes route inputs (nexthop addresses,
+                # link up/down, my triangle weights) that no distance
+                # column reflects — those batches take the full rebuild
+                me = self.config.my_node_name
+                if (
+                    change.node_label_changed
+                    or adj_db.this_node_name == me
+                    or any(
+                        adj.other_node_name == me
+                        for adj in adj_db.adjacencies
+                    )
+                ):
+                    self._pending.force_full = True
                 self._pending.apply(adj_db.perf_events, publication)
         elif key.startswith(PREFIX_DB_MARKER):
             # cached decode: prefix dbs are never mutated by this module
@@ -542,8 +574,10 @@ class Decision(CountersMixin, HistogramsMixin):
                 return False
             node_db.area = area
             self._bump("decision.prefix_db_update")
-            if self.prefix_state.update_prefix_database(node_db):
+            dirty = self.prefix_state.update_prefix_database(node_db)
+            if dirty:
                 changed = True
+                self._pending.dirty_prefixes |= dirty
                 self._pending.apply(prefix_db.perf_events, publication)
         return changed
 
@@ -601,13 +635,21 @@ class Decision(CountersMixin, HistogramsMixin):
     # ------------------------------------------------------------------
 
     def rebuild_routes(self) -> None:
-        """Debounced batch solve + delta emission (Decision.cpp:1771-1814)."""
+        """Debounced batch solve + delta emission (Decision.cpp:1771-1814).
+
+        DeltaPath: when every LSDB event in the batch rode the device
+        delta-extraction path, the DecisionRouteUpdate is built directly
+        from the changed destinations (DeltaRouteBuilder) — no full table
+        rebuild, no full-db diff — and streamed into Fib's incremental
+        programming path like any other update."""
         if self._cold_start_until is not None:
             return
         if not self._pending.needs_route_update:
             return
         perf_events = self._pending.perf_events
         span = self._pending.span
+        dirty_prefixes = self._pending.dirty_prefixes
+        force_full = self._pending.force_full or not self.have_computed_routes
         self._bump("decision.batched_updates", self._pending.count)
         self._pending.reset()
         self._bump("decision.route_build_runs")
@@ -617,10 +659,14 @@ class Decision(CountersMixin, HistogramsMixin):
 
         t0 = time.perf_counter()
         try:
-            new_db = self.solver.build_route_db(
+            new_db, delta, used_delta = self._delta_builder.build(
                 self.config.my_node_name,
                 self.area_link_states,
                 self.prefix_state,
+                self.route_db,
+                dirty_prefixes=dirty_prefixes,
+                force_full=force_full,
+                policy_fn=self._rib_policy_entry_fn(),
             )
         except Exception:
             # rebuild_routes runs from a loop timer callback: an uncaught
@@ -635,15 +681,21 @@ class Decision(CountersMixin, HistogramsMixin):
             logging.getLogger(__name__).exception("route build failed")
             self._bump("decision.route_build_errors")
             self._pending.needs_route_update = True
+            # the dirty snapshot was consumed: the retry must not trust it
+            self._pending.force_full = True
             if self._retry_timer is not None:
                 self._retry_timer.cancel()
             self._retry_timer = self.loop().call_later(
                 self.config.debounce_max, self._retry_rebuild
             )
             return
-        self._observe(
-            "decision.route_build_ms", (time.perf_counter() - t0) * 1e3
-        )
+        build_ms = (time.perf_counter() - t0) * 1e3
+        self._observe("decision.route_build_ms", build_ms)
+        if used_delta:
+            self._bump("decision.route_build_delta_runs")
+            self._observe("decision.route_build_delta_ms", build_ms)
+        if self._delta_builder.last_error is not None:
+            self._bump("decision.route_build_delta_errors")
         if span is not None:
             span.mark("decision.route_build")
         # surface the solver's SPF convergence counters (warm vs cold solve
@@ -660,8 +712,13 @@ class Decision(CountersMixin, HistogramsMixin):
                 self._ensure_histograms()[key] = hist
         if new_db is None:
             return
-        self._apply_rib_policy(new_db)
-        delta = get_route_delta(new_db, self.route_db)
+        if used_delta:
+            corrected = self._verify_delta_build(new_db)
+            if corrected is not None:
+                # shadow audit caught a divergence: serve the corrected
+                # full rebuild (the partial update is superseded)
+                delta = get_route_delta(corrected, self.route_db)
+                new_db = corrected
         self.route_db = new_db
         self.have_computed_routes = True
         if not delta.empty():
@@ -670,17 +727,40 @@ class Decision(CountersMixin, HistogramsMixin):
             self.route_updates_queue.push(delta)
             self._bump("decision.route_updates_published")
 
-    def _apply_rib_policy(self, route_db: DecisionRouteDb) -> None:
+    def _rib_policy_entry_fn(self):
+        """Per-entry RibPolicy hook for the route builder (applied to every
+        computed entry before diffing, on both the full and delta paths)."""
         if self.rib_policy is None or not self.rib_policy.is_active():
-            return
-        for entry in route_db.unicast_entries.values():
-            if self.rib_policy.apply_action(entry):
+            return None
+
+        def apply(entry) -> None:
+            if self.rib_policy is not None and self.rib_policy.apply_action(
+                entry
+            ):
                 self._bump("decision.rib_policy_applied")
+
+        return apply
+
+    def _verify_delta_build(self, new_db) -> Optional[DecisionRouteDb]:
+        """Run the supervisor's route-delta shadow audit when available.
+        Skipped while a RibPolicy is active: the audit's comparator is a
+        raw full rebuild, which would flag every policy-transformed entry
+        as divergence."""
+        verify = getattr(self.solver, "verify_route_delta", None)
+        if verify is None or self._rib_policy_entry_fn() is not None:
+            return None
+        return verify(
+            new_db,
+            self.config.my_node_name,
+            self.area_link_states,
+            self.prefix_state,
+        )
 
     # analysis: shared — sync ctrl handler, loop-serialized with the owner
     def set_rib_policy(self, policy: RibPolicy) -> None:
         """OpenrCtrl setRibPolicy (Decision.cpp:1517-1550): apply now and
-        schedule re-application at expiry."""
+        schedule re-application at expiry. A policy change transforms
+        entries everywhere, so the rebuild is forced down the full path."""
         self.rib_policy = policy
         if self._rib_policy_timer is not None:
             self._rib_policy_timer.cancel()
@@ -688,14 +768,17 @@ class Decision(CountersMixin, HistogramsMixin):
             max(0.0, policy.get_ttl_duration()), self._on_rib_policy_expiry
         )
         self._pending.needs_route_update = True
+        self._pending.force_full = True
         self.rebuild_routes()
 
     def get_rib_policy(self) -> Optional[RibPolicy]:
         return self.rib_policy
 
     def _on_rib_policy_expiry(self) -> None:
-        # re-emit routes without the expired policy
+        # re-emit routes without the expired policy (full path: the expiry
+        # un-transforms entries everywhere)
         self._pending.needs_route_update = True
+        self._pending.force_full = True
         self.rebuild_routes()
 
     # ------------------------------------------------------------------
@@ -752,6 +835,7 @@ class Decision(CountersMixin, HistogramsMixin):
                 changed = True
         if changed:
             self._pending.needs_route_update = True
+            self._pending.force_full = True  # hold expiry flips visibility
             self._pending.count += 1
             self._schedule_rebuild()
 

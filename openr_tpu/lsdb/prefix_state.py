@@ -15,6 +15,7 @@ from openr_tpu.types import (
     NextHop,
     PrefixDatabase,
     PrefixEntry,
+    PrefixForwardingType,
     PrefixType,
 )
 
@@ -29,6 +30,11 @@ class PrefixState:
         self._node_to_prefixes: Dict[str, Dict[str, Set[IpPrefix]]] = {}
         self._node_host_loopbacks_v4: Dict[str, str] = {}
         self._node_host_loopbacks_v6: Dict[str, str] = {}
+        # prefixes with any SR_MPLS-forwarding advertisement: their routes
+        # (KSP2 path traces, label stacks) can move on ANY edge change, so
+        # the DeltaPath partial rebuild must always recompute them — kept
+        # as an index so the delta path never scans the full table
+        self._mpls_fwd_prefixes: Set[IpPrefix] = set()
 
     @property
     def prefixes(self) -> PrefixEntries:
@@ -83,6 +89,20 @@ class PrefixState:
                 if not areas:
                     del self._node_to_prefixes[node]
 
+        # maintain the SR_MPLS-forwarding index for exactly the prefixes
+        # this update touched (O(announcers-of-changed-prefixes))
+        for prefix in changed:
+            by_originator = self._prefixes.get(prefix)
+            is_mpls = by_originator is not None and any(
+                entry.forwarding_type == PrefixForwardingType.SR_MPLS
+                for areas_ in by_originator.values()
+                for entry in areas_.values()
+            )
+            if is_mpls:
+                self._mpls_fwd_prefixes.add(prefix)
+            else:
+                self._mpls_fwd_prefixes.discard(prefix)
+
         return changed
 
     def _delete_loopback_prefix(self, prefix: IpPrefix, node: str) -> None:
@@ -135,3 +155,19 @@ class PrefixState:
 
     def has_prefix(self, prefix: IpPrefix) -> bool:
         return prefix in self._prefixes
+
+    def prefixes_for_nodes(self, nodes: Set[str]) -> Set[IpPrefix]:
+        """Prefixes advertised (in any area) by any of `nodes` — the
+        DeltaPath dirty set of a changed-destination list, read off the
+        node index in O(changes) instead of scanning the table."""
+        out: Set[IpPrefix] = set()
+        for node in nodes:
+            for prefixes in self._node_to_prefixes.get(node, {}).values():
+                out.update(prefixes)
+        return out
+
+    @property
+    def mpls_forwarding_prefixes(self) -> Set[IpPrefix]:
+        """Prefixes with any SR_MPLS-forwarding advertisement (their KSP2
+        path traces can change on edges no distance column reflects)."""
+        return self._mpls_fwd_prefixes

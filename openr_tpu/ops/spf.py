@@ -37,29 +37,20 @@ from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 from openr_tpu.testing.faults import fault_point
 
 
-def _bf_fixpoint_vw_core(
-    sources: jnp.ndarray,  # int32 [S]
-    src_e: jnp.ndarray,  # int32 [E]
-    dst_e: jnp.ndarray,  # int32 [E]
-    w_rows: jnp.ndarray,  # int32 [S, E] or [1, E] (broadcast) edge weights
-    overloaded: jnp.ndarray,  # bool [N]
-) -> jnp.ndarray:
-    """Distance matrix D [S, N]; each batch row may solve with its own
-    edge-weight vector. Per-row weights are the device form of the
-    reference's penalized re-solves: KSP's link-ignore runSpf
-    (LinkState.cpp:760-789, ignore set ≙ INF weights) and
-    multi-metric/multi-topology SPF become extra batch rows of one solve
-    instead of sequential Dijkstra runs."""
+def _bf_allow(sources: jnp.ndarray, overloaded: jnp.ndarray) -> jnp.ndarray:
+    """Row-major [S, N] transit mask: transit allowed through u for source
+    row i unless u is overloaded and u is not the source itself."""
     n = overloaded.shape[0]
-    s = sources.shape[0]
     node_ids = jnp.arange(n, dtype=jnp.int32)
+    return (~overloaded)[None, :] | (node_ids[None, :] == sources[:, None])
 
-    d0 = jnp.full((s, n), INF, dtype=jnp.int32)
-    d0 = d0.at[jnp.arange(s), sources].set(0)
 
-    # transit allowed through u for source row i unless u is overloaded and
-    # u is not the source itself
-    allow = (~overloaded)[None, :] | (node_ids[None, :] == sources[:, None])
+def _bf_relax(d0, allow, src_e, dst_e, w_rows):
+    """Edge-list min-plus relaxation from row-major initial state d0 to the
+    fixpoint; returns (d [S, N], rounds). Like _sell_relax, any entrywise
+    upper bound of the true distances with the source diagonal pinned to 0
+    is a valid d0, which is what makes the edge-list warm path sound."""
+    n = d0.shape[1]
 
     def body(state):
         d, _, it = state
@@ -77,7 +68,29 @@ def _bf_fixpoint_vw_core(
         _, changed, it = state
         return changed & (it < n)
 
-    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    d, _, rounds = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    return d, rounds
+
+
+def _bf_fixpoint_vw_core(
+    sources: jnp.ndarray,  # int32 [S]
+    src_e: jnp.ndarray,  # int32 [E]
+    dst_e: jnp.ndarray,  # int32 [E]
+    w_rows: jnp.ndarray,  # int32 [S, E] or [1, E] (broadcast) edge weights
+    overloaded: jnp.ndarray,  # bool [N]
+) -> jnp.ndarray:
+    """Distance matrix D [S, N]; each batch row may solve with its own
+    edge-weight vector. Per-row weights are the device form of the
+    reference's penalized re-solves: KSP's link-ignore runSpf
+    (LinkState.cpp:760-789, ignore set ≙ INF weights) and
+    multi-metric/multi-topology SPF become extra batch rows of one solve
+    instead of sequential Dijkstra runs."""
+    n = overloaded.shape[0]
+    s = sources.shape[0]
+    d0 = jnp.full((s, n), INF, dtype=jnp.int32)
+    d0 = d0.at[jnp.arange(s), sources].set(0)
+    allow = _bf_allow(sources, overloaded)
+    d, _ = _bf_relax(d0, allow, src_e, dst_e, w_rows)
     return d
 
 
@@ -414,16 +427,23 @@ def _sell_solver_warm(key: Tuple, mesh=None):
     """Warm-start incremental patch-and-solve, one dispatch per LSDB event.
 
     (sources, nbrs, wgs, overloaded, patch_idx, patch_vals, inc_idx,
-    d_prev) -> (D, new_wgs, rounds, inv_rounds): invalidates the entries of
-    d_prev [S, N] whose old shortest path may witness an increased edge
-    (_sell_invalidate, against the OLD weights), applies the weight
-    patches, and relaxes from the repaired state instead of from INF —
-    rounds scale with the affected radius of the event, not the graph
-    diameter. inv_rounds is the invalidation mark fixpoint's own round
-    count (0 for decrease-only events, whose empty inc_idx skips the loop
-    and warm-starts directly). All patch shapes are fixed (_PATCH_SLOTS per
-    bucket) so one executable serves every event; d_prev and the weight
-    buffers are donated since the caller always replaces its handles."""
+    d_prev) -> (D, new_wgs, rounds, inv_rounds, col_changed, num_changed):
+    invalidates the entries of d_prev [S, N] whose old shortest path may
+    witness an increased edge (_sell_invalidate, against the OLD weights),
+    applies the weight patches, and relaxes from the repaired state instead
+    of from INF — rounds scale with the affected radius of the event, not
+    the graph diameter. inv_rounds is the invalidation mark fixpoint's own
+    round count (0 for decrease-only events, whose empty inc_idx skips the
+    loop and warm-starts directly).
+
+    col_changed is a DEVICE-resident bool [N]: destination columns whose
+    distance row moved vs d_prev — the DeltaPath seed. num_changed is its
+    scalar popcount; the host reads only that int (4 bytes) and then sizes
+    a compacted `_delta_extract` dispatch, so the per-event copy-back is
+    O(changes), never the [S, N] mirror. All patch shapes are fixed
+    (_PATCH_SLOTS per bucket) so one executable serves every event; d_prev
+    and the weight buffers are donated since the caller always replaces
+    its handles."""
     zero_end, starts, shapes = key
 
     def solve(
@@ -441,7 +461,9 @@ def _sell_solver_warm(key: Tuple, mesh=None):
         d, rounds = _sell_relax(
             d0, allow, nbrs, new_wgs, zero_end, starts, shapes
         )
-        return d.T, new_wgs, rounds, inv_rounds
+        col_changed = jnp.any(d != dp, axis=1)  # dest-major: [N]
+        num_changed = jnp.sum(col_changed, dtype=jnp.int32)
+        return d.T, new_wgs, rounds, inv_rounds, col_changed, num_changed
 
     if mesh is None:
         return jax.jit(solve, donate_argnums=(2, 7))
@@ -450,8 +472,104 @@ def _sell_solver_warm(key: Tuple, mesh=None):
         solve,
         donate_argnums=(2, 7),
         in_shardings=(row, repl, repl, repl, repl, repl, repl, out),
-        out_shardings=(out, repl, repl, repl),
+        out_shardings=(out, repl, repl, repl, repl, repl),
     )
+
+
+def _bf_warm_core(
+    sources: jnp.ndarray,  # int32 [S]
+    src_e: jnp.ndarray,  # int32 [E]
+    dst_e: jnp.ndarray,  # int32 [E] (sorted ascending)
+    w_new: jnp.ndarray,  # int32 [E] weights after the event
+    w_old: jnp.ndarray,  # int32 [E] weights that produced d_prev
+    overloaded: jnp.ndarray,  # bool [N]
+    d_prev: jnp.ndarray,  # int32 [S, N] previous fixpoint (donated)
+):
+    """Warm-start solve on the edge-list (non sliced-ELL) layout: the same
+    Ramalingam–Reps-style recipe as _sell_solver_warm, but with the
+    increased-edge set derived on device from w_new > w_old instead of a
+    host-built index patch (the edge-list form has no fixed-width slot
+    structure to patch into; uploading the [E] weight vector per event is
+    the layout's native cost anyway).
+
+    Seed marks where an increased edge sits on the old shortest-path DAG
+    (triangle condition against w_old), propagate marks down the old DAG
+    with a boolean segment-max fixpoint, reset marked entries to INF, then
+    relax from the repaired state with the new weights. Returns
+    (d, rounds, inv_rounds, col_changed [N] bool, num_changed) — the same
+    delta outputs as the sliced path, so `_delta_extract` serves both."""
+    n = overloaded.shape[0]
+    s = sources.shape[0]
+    dp = d_prev
+    du = dp[:, src_e]  # [S, E]
+    dv = dp[:, dst_e]
+    on_old = (jnp.minimum(du + w_old[None, :], INF) == dv) & (dv < INF)
+    seeds = on_old & (w_new > w_old)[None, :]
+
+    def seg_any(rows):  # bool [S, E] -> bool [S, N] (OR per destination)
+        return (
+            jax.vmap(
+                lambda row: jax.ops.segment_max(
+                    row.astype(jnp.int32),
+                    dst_e,
+                    num_segments=n,
+                    indices_are_sorted=True,
+                )
+            )(rows)
+            > 0
+        )
+
+    marks0 = seg_any(seeds)
+
+    def body(state):
+        m, _, it = state
+        new_m = m | seg_any(m[:, src_e] & on_old)
+        return new_m, jnp.any(new_m != m), it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n)
+
+    # zero increased edges -> zero seed marks -> the loop is skipped whole
+    marks, _, inv_rounds = jax.lax.while_loop(
+        cond, body, (marks0, jnp.any(marks0), 0)
+    )
+    d0 = jnp.where(marks, INF, dp)
+    d0 = d0.at[jnp.arange(s), sources].set(0)  # re-pin marked sources
+    allow = _bf_allow(sources, overloaded)
+    d, rounds = _bf_relax(d0, allow, src_e, dst_e, w_new[None, :])
+    col_changed = jnp.any(d != dp, axis=0)  # row-major: reduce sources
+    num_changed = jnp.sum(col_changed, dtype=jnp.int32)
+    return d, rounds, inv_rounds, col_changed, num_changed
+
+
+_bf_solver_warm = jax.jit(_bf_warm_core, donate_argnums=(6,))
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _delta_extract(
+    col_changed: jnp.ndarray,  # bool [N] device-resident changed-dest mask
+    d: jnp.ndarray,  # int32 [S, N] device-resident distance matrix
+    nh_rows: jnp.ndarray,  # int32 [L] batch row of each up-link neighbor
+    nh_ws: jnp.ndarray,  # int32 [L] metric of each up-link from me
+    cap: int,  # static: compacted column capacity (power-of-two bucket)
+):
+    """Compact the changed destinations and recompute the triangle-condition
+    nexthop memberships for just those columns — the O(changes) copy-back
+    that replaces the full [S, N] mirror fetch on the warm event path.
+
+    Returns (cols [cap] int32 changed-destination indices, fill = N for
+    padding; dcols [S, cap] their distance columns; nh [L, cap] bool: link
+    l is an ECMP first hop toward cols[c], the exact _AreaSolve.nh_mask
+    formula w(me, n) + D[n, t] == D[me, t]). The caller picks cap =
+    _next_bucket(num_changed) so a handful of executables (one per
+    power-of-two bucket) serve every event size."""
+    n = col_changed.shape[0]
+    (cols,) = jnp.nonzero(col_changed, size=cap, fill_value=n)
+    safe = jnp.clip(cols, 0, n - 1)
+    dcols = d[:, safe]  # [S, cap]
+    nh = (nh_ws[:, None] + dcols[nh_rows, :]) == dcols[0][None, :]
+    return cols, dcols, nh
 
 
 @functools.lru_cache(maxsize=64)

@@ -15,9 +15,11 @@ from openr_tpu.solver.routes import (
     DecisionRouteUpdate,
     RibMplsEntry,
     RibUnicastEntry,
+    apply_route_delta,
     get_route_delta,
 )
 from openr_tpu.solver.cpu import SpfSolver
+from openr_tpu.solver.delta import DeltaRouteBuilder
 from openr_tpu.solver.supervisor import SolverSupervisor, SupervisorConfig
 from openr_tpu.solver.tpu import TpuSpfSolver
 
@@ -27,8 +29,10 @@ __all__ = [
     "TpuSpfSolver",
     "DecisionRouteDb",
     "DecisionRouteUpdate",
+    "DeltaRouteBuilder",
     "RibMplsEntry",
     "RibUnicastEntry",
+    "apply_route_delta",
     "get_route_delta",
     "SpfSolver",
 ]

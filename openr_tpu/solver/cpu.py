@@ -199,77 +199,14 @@ class SpfSolver(CountersMixin, HistogramsMixin):
 
         # ---- unicast best paths (IP and IP2MPLS) ----
         for prefix, prefix_entries in prefix_state.prefixes.items():
-            has_bgp = has_non_bgp = missing_mv = False
-            for node, areas in prefix_entries.items():
-                for entry in areas.values():
-                    is_bgp = entry.type == PrefixType.BGP
-                    has_bgp |= is_bgp
-                    has_non_bgp |= not is_bgp
-                    if is_bgp and entry.mv is None:
-                        missing_mv = True
-            if has_bgp:
-                if has_non_bgp or missing_mv:
-                    # mixed-type or malformed BGP advertisement: skip route
-                    self._bump("decision.skipped_unicast_route")
-                    continue
-
-            # prefixes advertised by me (non-BGP): no route needed
-            if my_node_name in prefix_entries and not has_bgp:
-                continue
-
-            is_v4 = prefix.is_v4
-            if is_v4 and not self.enable_v4:
-                self._bump("decision.skipped_unicast_route")
-                continue
-
-            fwd_algo = get_prefix_forwarding_algorithm(prefix_entries)
-            fwd_type = get_prefix_forwarding_type(prefix_entries)
-
-            if fwd_type == PrefixForwardingType.SR_MPLS:
-                # SP_ECMP or KSP2 on the MPLS data plane
-                nodes = self.get_best_announcing_nodes(
-                    my_node_name,
-                    prefix,
-                    prefix_entries,
-                    has_bgp,
-                    True,
-                    area_link_states,
-                )
-                if not nodes.success or not nodes.nodes:
-                    continue
-                self._select_ksp2(
-                    route_db.unicast_entries,
-                    prefix,
-                    my_node_name,
-                    nodes,
-                    prefix_entries,
-                    has_bgp,
-                    area_link_states,
-                    prefix_state,
-                    fwd_algo,
-                )
-            elif fwd_algo == PrefixForwardingAlgorithm.SP_ECMP:
-                if has_bgp:
-                    self._select_ecmp_bgp(
-                        route_db.unicast_entries,
-                        my_node_name,
-                        prefix,
-                        prefix_entries,
-                        is_v4,
-                        area_link_states,
-                        prefix_state,
-                    )
-                else:
-                    self._select_ecmp_openr(
-                        route_db.unicast_entries,
-                        my_node_name,
-                        prefix,
-                        prefix_entries,
-                        is_v4,
-                        area_link_states,
-                    )
-            else:
-                self._bump("decision.incompatible_forwarding_type")
+            self.build_unicast_route(
+                route_db.unicast_entries,
+                my_node_name,
+                prefix,
+                prefix_entries,
+                area_link_states,
+                prefix_state,
+            )
 
         # ---- MPLS node-label routes (Decision.cpp:415-501) ----
         label_to_node: Dict[int, Tuple[str, RibMplsEntry]] = {}
@@ -287,50 +224,12 @@ class SpfSolver(CountersMixin, HistogramsMixin):
                     self._bump("decision.duplicate_node_label")
                     if existing[0] < adj_db.this_node_name:
                         continue
-                if adj_db.this_node_name == my_node_name:
-                    # our own label: POP_AND_LOOKUP
-                    label_to_node[top_label] = (
-                        my_node_name,
-                        RibMplsEntry(
-                            top_label,
-                            {
-                                NextHop(
-                                    address="::",
-                                    area=area,
-                                    mpls_action=MplsAction(
-                                        MplsActionCode.POP_AND_LOOKUP
-                                    ),
-                                )
-                            },
-                        ),
-                    )
-                    continue
-                min_metric, nh_nodes = self.get_next_hops_with_metric(
-                    my_node_name,
-                    {adj_db.this_node_name},
-                    False,
-                    area_link_states,
+                entry = self.build_node_label_route(
+                    my_node_name, area, adj_db, area_link_states
                 )
-                if not nh_nodes:
-                    self._bump("decision.no_route_to_label")
+                if entry is None:
                     continue
-                label_to_node[top_label] = (
-                    adj_db.this_node_name,
-                    RibMplsEntry(
-                        top_label,
-                        self.get_next_hops(
-                            my_node_name,
-                            {adj_db.this_node_name},
-                            False,
-                            False,
-                            min_metric,
-                            nh_nodes,
-                            top_label,
-                            area_link_states,
-                            {area},
-                        ),
-                    ),
-                )
+                label_to_node[top_label] = (adj_db.this_node_name, entry)
         for label, (_, entry) in label_to_node.items():
             route_db.mpls_entries[label] = entry
 
@@ -357,6 +256,148 @@ class SpfSolver(CountersMixin, HistogramsMixin):
                     },
                 )
         return route_db
+
+    def build_unicast_route(
+        self,
+        unicast_entries: Dict[IpPrefix, RibUnicastEntry],
+        my_node_name: str,
+        prefix: IpPrefix,
+        prefix_entries: Dict[str, Dict[str, PrefixEntry]],
+        area_link_states: Dict[str, LinkState],
+        prefix_state: PrefixState,
+    ) -> None:
+        """One prefix's best-path selection + nexthop assembly (the body of
+        build_route_db's unicast loop), writing the entry — if any — into
+        `unicast_entries`. Exposed as a seam so the DeltaPath route build
+        (solver/delta.py) can recompute exactly the prefixes a device
+        delta names instead of looping the whole table."""
+        has_bgp = has_non_bgp = missing_mv = False
+        for node, areas in prefix_entries.items():
+            for entry in areas.values():
+                is_bgp = entry.type == PrefixType.BGP
+                has_bgp |= is_bgp
+                has_non_bgp |= not is_bgp
+                if is_bgp and entry.mv is None:
+                    missing_mv = True
+        if has_bgp:
+            if has_non_bgp or missing_mv:
+                # mixed-type or malformed BGP advertisement: skip route
+                self._bump("decision.skipped_unicast_route")
+                return
+
+        # prefixes advertised by me (non-BGP): no route needed
+        if my_node_name in prefix_entries and not has_bgp:
+            return
+
+        is_v4 = prefix.is_v4
+        if is_v4 and not self.enable_v4:
+            self._bump("decision.skipped_unicast_route")
+            return
+
+        fwd_algo = get_prefix_forwarding_algorithm(prefix_entries)
+        fwd_type = get_prefix_forwarding_type(prefix_entries)
+
+        if fwd_type == PrefixForwardingType.SR_MPLS:
+            # SP_ECMP or KSP2 on the MPLS data plane
+            nodes = self.get_best_announcing_nodes(
+                my_node_name,
+                prefix,
+                prefix_entries,
+                has_bgp,
+                True,
+                area_link_states,
+            )
+            if not nodes.success or not nodes.nodes:
+                return
+            self._select_ksp2(
+                unicast_entries,
+                prefix,
+                my_node_name,
+                nodes,
+                prefix_entries,
+                has_bgp,
+                area_link_states,
+                prefix_state,
+                fwd_algo,
+            )
+        elif fwd_algo == PrefixForwardingAlgorithm.SP_ECMP:
+            if has_bgp:
+                self._select_ecmp_bgp(
+                    unicast_entries,
+                    my_node_name,
+                    prefix,
+                    prefix_entries,
+                    is_v4,
+                    area_link_states,
+                    prefix_state,
+                )
+            else:
+                self._select_ecmp_openr(
+                    unicast_entries,
+                    my_node_name,
+                    prefix,
+                    prefix_entries,
+                    is_v4,
+                    area_link_states,
+                )
+        else:
+            self._bump("decision.incompatible_forwarding_type")
+
+    def build_node_label_route(
+        self,
+        my_node_name: str,
+        area: str,
+        adj_db,
+        area_link_states: Dict[str, LinkState],
+    ) -> Optional[RibMplsEntry]:
+        """One node's MPLS node-label route (POP_AND_LOOKUP for my own
+        label, SWAP/PHP nexthops toward everyone else's), or None when the
+        node is unreachable. Collision arbitration stays with the caller.
+        Shared by build_route_db and the DeltaPath partial rebuild."""
+        top_label = adj_db.node_label
+        if adj_db.this_node_name == my_node_name:
+            # our own label: POP_AND_LOOKUP
+            return RibMplsEntry(
+                top_label,
+                {
+                    NextHop(
+                        address="::",
+                        area=area,
+                        mpls_action=MplsAction(
+                            MplsActionCode.POP_AND_LOOKUP
+                        ),
+                    )
+                },
+            )
+        min_metric, nh_nodes = self.get_next_hops_with_metric(
+            my_node_name,
+            {adj_db.this_node_name},
+            False,
+            area_link_states,
+        )
+        if not nh_nodes:
+            self._bump("decision.no_route_to_label")
+            return None
+        return RibMplsEntry(
+            top_label,
+            self.get_next_hops(
+                my_node_name,
+                {adj_db.this_node_name},
+                False,
+                False,
+                min_metric,
+                nh_nodes,
+                top_label,
+                area_link_states,
+                {area},
+            ),
+        )
+
+    def poll_device_delta(self, area_link_states) -> Optional[set]:
+        """DeltaPath seam: backends without device-resident distance state
+        have no device delta to offer — the route build always takes the
+        full path (the TPU backend overrides this)."""
+        return None
 
     # ------------------------------------------------------------------
     # best announcing nodes

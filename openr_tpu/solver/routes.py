@@ -100,6 +100,27 @@ class DecisionRouteUpdate:
         )
 
 
+def apply_route_delta(
+    old_db: DecisionRouteDb, delta: DecisionRouteUpdate
+) -> DecisionRouteDb:
+    """The diff's inverse: fold an update into a route db, returning a new
+    db that shares unchanged entry objects with the old one. The DeltaPath
+    route build uses this to keep Decision's full RouteDatabase current
+    without rebuilding it — apply_route_delta(old, get_route_delta(new,
+    old)) == new for any pair of dbs."""
+    unicast = dict(old_db.unicast_entries)
+    mpls = dict(old_db.mpls_entries)
+    for prefix in delta.unicast_routes_to_delete:
+        unicast.pop(prefix, None)
+    for entry in delta.unicast_routes_to_update:
+        unicast[entry.prefix] = entry
+    for label in delta.mpls_routes_to_delete:
+        mpls.pop(label, None)
+    for entry in delta.mpls_routes_to_update:
+        mpls[entry.label] = entry
+    return DecisionRouteDb(unicast_entries=unicast, mpls_entries=mpls)
+
+
 def get_route_delta(
     new_db: DecisionRouteDb, old_db: DecisionRouteDb
 ) -> DecisionRouteUpdate:

@@ -47,6 +47,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from openr_tpu.solver.routes import get_route_delta
 from openr_tpu.utils.backoff import ExponentialBackoff
 from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
 
@@ -167,6 +168,7 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         self.probe_streak = 0
         self.last_fault_kind: Optional[str] = None
         self._solves_since_audit = 0
+        self._delta_builds_since_audit = 0
         self._probe_backoff = ExponentialBackoff(
             max(self.config.probe_interval_s, 1e-3),
             max(
@@ -281,6 +283,79 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         return self._fallback_solve(
             my_node_name, area_link_states, prefix_state
         )
+
+    # ------------------------------------------------------------------
+    # DeltaPath (device-side route-delta) fault domain
+    # ------------------------------------------------------------------
+
+    def poll_device_delta(self, area_link_states):
+        """Supervised DeltaPath poll: while the breaker is non-CLOSED the
+        primary's device state is not serving (and was invalidated on the
+        trip), so the answer is always None — the route build takes the
+        full path through the fallback. A solve fault inside the poll is
+        classified and fed to the breaker exactly like a supervised solve
+        failure, then reported as 'no delta' so the event is served by the
+        (retrying, degradable) full build."""
+        if self.state != CLOSED:
+            return None
+        poll = getattr(self.primary, "poll_device_delta", None)
+        if poll is None:
+            return None
+        try:
+            delta = poll(area_link_states)
+        except Exception as exc:
+            self._record_failure(classify_solver_error(exc), exc)
+            return None
+        self._sync_backend_stats(self.primary)
+        return delta
+
+    def verify_route_delta(
+        self, delta_db, my_node_name, area_link_states, prefix_state
+    ):
+        """Shadow audit of a delta-built route db: every `audit_interval`-th
+        delta build, recompute the full db from the primary (plus the
+        existing warm-state cold-mirror audit underneath it, via
+        _maybe_audit) and compare. A mismatch means the partial rebuild
+        dropped or fabricated a route: self-heal by invalidating the warm
+        state and serving the full rebuild — returns the corrected db, or
+        None when the delta-built db checks out (or no audit was due)."""
+        if self.config.audit_interval <= 0:
+            return None
+        self._delta_builds_since_audit += 1
+        if self._delta_builds_since_audit < self.config.audit_interval:
+            return None
+        self._delta_builds_since_audit = 0
+        self._bump("decision.spf.delta_audit_runs")
+        full_db = self.build_route_db(
+            my_node_name, area_link_states, prefix_state
+        )
+        if full_db is None:
+            return None
+        diff = get_route_delta(full_db, delta_db)
+        reverse = get_route_delta(delta_db, full_db)
+        if diff.empty() and reverse.empty():
+            return None
+        self._bump("decision.spf.delta_audit_mismatches")
+        log.error(
+            "route-delta audit mismatch: %d updates / %d deletes missing "
+            "from the delta-built db; forcing the full path",
+            len(diff.unicast_routes_to_update) + len(diff.mpls_routes_to_update),
+            len(diff.unicast_routes_to_delete) + len(diff.mpls_routes_to_delete),
+        )
+        self._emit_sample(
+            "ROUTE_DELTA_AUDIT_MISMATCH",
+            {},
+            {
+                "unicast_diverged": len(diff.unicast_routes_to_update)
+                + len(diff.unicast_routes_to_delete),
+                "mpls_diverged": len(diff.mpls_routes_to_update)
+                + len(diff.mpls_routes_to_delete),
+            },
+        )
+        # the partial rebuild derives from the resident warm state: after a
+        # route-level divergence it is not to be trusted either
+        self._invalidate_primary_warm_state()
+        return full_db
 
     # static-route pass-through: both backends ingest every push so the
     # fallback's static MPLS state is identical the moment it must serve
@@ -559,5 +634,11 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             "audit_runs": self.counters.get("decision.spf.audit_runs", 0),
             "audit_mismatches": self.counters.get(
                 "decision.spf.audit_mismatches", 0
+            ),
+            "delta_audit_runs": self.counters.get(
+                "decision.spf.delta_audit_runs", 0
+            ),
+            "delta_audit_mismatches": self.counters.get(
+                "decision.spf.delta_audit_mismatches", 0
             ),
         }

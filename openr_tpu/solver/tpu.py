@@ -54,6 +54,11 @@ from openr_tpu.testing.faults import fault_point
 # changing more slots per bucket fall back to standalone scatters
 _PATCH_SLOTS = 64
 
+# DeltaPath extraction cutoff: when more than this fraction of the
+# destination columns changed, the full [S, n_pad] mirror is the cheaper
+# copy-back and the event is served as a full rebuild instead
+_DELTA_MAX_FRAC = 0.5
+
 
 class _NodeView:
     """NodeSpfResult-compatible view over the device distance matrix."""
@@ -174,9 +179,26 @@ class _AreaSolve:
         self.last_solve_warm = False
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        # DeltaPath (device-side route-delta extraction) accounting: the
+        # changed-destination columns and copy-back bytes of extraction
+        # dispatches — d2h_bytes grows by delta_bytes on the delta path and
+        # by the full [S, n_pad] mirror on the cold/audit path, which is
+        # how the two are told apart in tests and dashboards
+        self.delta_extracts = 0
+        self.delta_columns = 0
+        self.delta_bytes = 0
+        self.delta_extract_ms_last: Optional[float] = None
+        # changed destination columns accumulated for the route-delta
+        # consumer (take_route_delta); None = poisoned: some solve since
+        # the last take had no device delta, the consumer must full-rebuild
+        self._delta_pending: Optional[set] = set()
+        self._last_solve_delta: Optional[np.ndarray] = None
         # _sync_spf_counters bookmarks (bytes already folded into counters)
         self._h2d_synced = 0
         self._d2h_synced = 0
+        self._delta_cols_synced = 0
+        self._delta_bytes_synced = 0
+        self._delta_extracts_synced = 0
         # persistent device buffers (SURVEY.md §7: the <100ms convergence
         # budget leaves no room to re-upload the LSDB per event): sell
         # nbr/wg/overloaded live on device across events; weight patches
@@ -253,6 +275,7 @@ class _AreaSolve:
         # scalar `rounds` output forces completion of the same computation,
         # so the measured wall time includes device execution there.
         inc_before = self.incremental_solves
+        self._last_solve_delta = None  # set by a qualifying resident solve
         t0 = time.perf_counter()
         self.h2d_bytes += rows.nbytes
         if self.graph.sell is not None:
@@ -264,18 +287,25 @@ class _AreaSolve:
             self.rounds_last = None  # edge-list form: rounds untracked
             self.full_solves += 1
         else:
-            self._d_dev = batched_spf(self.graph, rows)
-            self.rounds_last = None
-            self.full_solves += 1
+            self._d_dev, self.rounds_last = self._bf_solve_resident(rows)
         self.solve_ms_last = (time.perf_counter() - t0) * 1e3
         self.last_solve_warm = self.incremental_solves > inc_before
-        self._d_host = None
         self.device_solves += 1
+        if self._last_solve_delta is None:
+            # cold or non-qualifying event: the host mirrors are stale and
+            # the accumulated delta cannot describe the event — poison it
+            # until the consumer takes it (and full-rebuilds)
+            self._d_host = None
+            self._nh_links = None
+            self._nh_mask = None
+            self._delta_pending = None
+        elif self._delta_pending is not None:
+            # qualifying event: mirrors were patched in place during
+            # extraction, the changed columns accumulate for the consumer
+            self._delta_pending.update(int(c) for c in self._last_solve_delta)
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
         # reset with the snapshot, so topology changes invalidate it for free
         self._ksp: Dict[Tuple[str, int], List[Path]] = {}
-        self._nh_links: Optional[List[str]] = None
-        self._nh_mask: Optional[np.ndarray] = None
         # corruption seam (ctx = this solve): the warm-state audit tests
         # perturb the resident D here to prove divergence detection works
         fault_point("solver.tpu.warm_d", self)
@@ -304,8 +334,9 @@ class _AreaSolve:
         g = self.graph
         sell = g.sell
         st = self._dev
-        if st is None or st["src_ref"] is not g.src:
+        if st is None or st.get("kind") != "sell" or st["src_ref"] is not g.src:
             st = self._dev = {
+                "kind": "sell",
                 "src_ref": g.src,
                 "nbrs": tuple(self._replicated(a) for a in sell.nbr),
                 "wgs": tuple(self._replicated(a) for a in sell.wg),
@@ -429,12 +460,28 @@ class _AreaSolve:
                                 inc_idx[k, : len(sel), 1] = sell.edge_slot[sel]
                         fn = _sell_solver_warm(sell.shape_key(), self.mesh)
                         self.h2d_bytes += inc_idx.nbytes
-                        d, new_wgs, rounds, inv_rounds = fn(
-                            *args, jnp.asarray(inc_idx), self._d_dev
+                        # DeltaPath qualification: the host-visible route
+                        # inputs besides D are my own out-link metrics (the
+                        # nh_mask triangle w-column) and the transit mask —
+                        # an event touching either cannot be described by
+                        # changed D columns alone
+                        delta_ok = not ov_changed and not np.any(
+                            g.src[changed] == rows[0]
                         )
+                        (
+                            d,
+                            new_wgs,
+                            rounds,
+                            inv_rounds,
+                            col_changed,
+                            num_changed,
+                        ) = fn(*args, jnp.asarray(inc_idx), self._d_dev)
                         st["wgs"] = new_wgs
                         self.incremental_solves += 1
                         self.invalidation_rounds_last = int(inv_rounds)
+                        self._finish_delta(
+                            col_changed, num_changed, d, delta_ok
+                        )
                         return d, int(rounds)
                     if len(changed):
                         fn = _sell_solver_patched(sell.shape_key(), self.mesh)
@@ -467,6 +514,192 @@ class _AreaSolve:
         self.full_solves += 1
         return d, int(rounds)
 
+    def _bf_solve_resident(self, rows: np.ndarray):
+        """Edge-list (non sliced-ELL) solve against persistent device
+        buffers; returns (device distance matrix [s_pad, n_pad], rounds or
+        None). The warm event path mirrors the sliced-ELL recipe with the
+        layout's native patch unit — the whole [e_pad] weight vector —
+        and derives the increased-edge set on device (ops.spf._bf_warm_core),
+        so degree profiles that disqualify sliced-ELL no longer force a
+        cold solve per event (and no longer silently mask the delta path)."""
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.spf import _bf_fixpoint, _bf_solver_warm
+
+        g = self.graph
+        st = self._dev
+        structural = (
+            st is None or st.get("kind") != "bf" or st["src_ref"] is not g.src
+        )
+        if structural:
+            st = self._dev = {
+                "kind": "bf",
+                "src_ref": g.src,
+                "src": self._replicated(g.src),
+                "dst": self._replicated(g.dst),
+                "w": self._replicated(g.w),
+                "ov": self._replicated(g.overloaded),
+                "w_host": g.w.copy(),
+                "w_ver": g.version,
+                "ov_host": g.overloaded.copy(),
+                "rows": np.array(rows),
+            }
+            self.h2d_bytes += (
+                g.src.nbytes + g.dst.nbytes + g.w.nbytes + g.overloaded.nbytes
+            )
+        else:
+            ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
+            rows_same = np.array_equal(st["rows"], rows)
+            st["rows"] = np.array(rows)
+            if (
+                g.changed_edges is not None
+                and g.parent_version == st.get("w_ver")
+            ):
+                cand = g.changed_edges
+                changed = cand[st["w_host"][cand] != g.w[cand]]
+            else:
+                changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            st["w_ver"] = g.version
+            if ov_changed:
+                st["ov"] = self._replicated(g.overloaded)
+                st["ov_host"] = g.overloaded.copy()
+                self.h2d_bytes += g.overloaded.nbytes
+            if (
+                self.warm_start
+                and rows_same
+                and not ov_changed
+                and len(changed)
+                and self._d_dev is not None
+            ):
+                # weight-only event: upload the new weight vector and let
+                # the device classify increases against the resident copy
+                w_new = jnp.asarray(g.w)
+                self.h2d_bytes += g.w.nbytes
+                delta_ok = not np.any(g.src[changed] == rows[0])
+                d, rounds, inv_rounds, col_changed, num_changed = (
+                    _bf_solver_warm(
+                        jnp.asarray(rows, dtype=jnp.int32),
+                        st["src"],
+                        st["dst"],
+                        w_new,
+                        st["w"],
+                        st["ov"],
+                        self._d_dev,
+                    )
+                )
+                st["w"] = w_new
+                st["w_host"] = g.w.copy()
+                self.incremental_solves += 1
+                self.invalidation_rounds_last = int(inv_rounds)
+                self._finish_delta(col_changed, num_changed, d, delta_ok)
+                return d, int(rounds)
+            if len(changed):
+                st["w"] = self._replicated(g.w)
+                st["w_host"] = g.w.copy()
+                self.h2d_bytes += g.w.nbytes
+
+        d = _bf_fixpoint(
+            jnp.asarray(rows, dtype=jnp.int32),
+            st["src"],
+            st["dst"],
+            st["w"],
+            st["ov"],
+        )
+        self.full_solves += 1
+        return d, None
+
+    def _nh_link_arrays(self):
+        """(names, batch rows [L], metrics [L], overloaded flags [L]) of
+        my ordered up-links — the nh_mask triangle inputs, shared by the
+        host mask build and the device delta extraction."""
+        ls = self.link_state
+        names: List[str] = []
+        rows: List[int] = []
+        ws: List[int] = []
+        ov: List[bool] = []
+        for link in ls.ordered_links_from_node(self.me):
+            if not link.is_up():
+                continue
+            n = link.other_node_name(self.me)
+            r = self.row_map.get(n)
+            if r is None:
+                continue
+            names.append(n)
+            rows.append(r)
+            ws.append(link.metric_from_node(self.me))
+            ov.append(ls.is_node_overloaded(n))
+        return names, rows, ws, ov
+
+    def _finish_delta(self, col_changed, num_changed, d_dev, delta_ok) -> None:
+        """Complete a qualifying warm solve's DeltaPath extraction: read the
+        changed-column count (4 bytes), size a compacted `_delta_extract`
+        dispatch, and patch the persistent host mirrors (distance matrix +
+        nexthop mask) in place. Sets self._last_solve_delta to the changed
+        destination columns; leaving it None makes _solve treat the event
+        as full (mirrors reset, accumulated delta poisoned)."""
+        if not delta_ok:
+            return
+        num = int(num_changed)
+        if num == 0:
+            self._last_solve_delta = np.empty(0, dtype=np.int64)
+            return
+        g = self.graph
+        if num > max(_PATCH_SLOTS, int(g.n_pad * _DELTA_MAX_FRAC)):
+            return  # full mirror is the cheaper copy-back for bulk events
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.spf import _delta_extract
+
+        names, rows_l, ws_l, ov_l = self._nh_link_arrays()
+        l_pad = _next_bucket(max(len(rows_l), 1), minimum=8)
+        nh_rows = np.zeros(l_pad, dtype=np.int32)
+        nh_ws = np.full(l_pad, INF, dtype=np.int32)  # padding never matches
+        nh_rows[: len(rows_l)] = rows_l
+        nh_ws[: len(ws_l)] = ws_l
+        cap = _next_bucket(num, minimum=8)
+        t0 = time.perf_counter()
+        self.h2d_bytes += nh_rows.nbytes + nh_ws.nbytes
+        cols_d, dcols_d, nh_d = _delta_extract(
+            col_changed, d_dev, jnp.asarray(nh_rows), jnp.asarray(nh_ws),
+            cap=cap,
+        )
+        cols = np.asarray(cols_d)
+        dcols = np.array(dcols_d)
+        nh = np.array(nh_d)
+        self.delta_extract_ms_last = (time.perf_counter() - t0) * 1e3
+        xfer = cols.nbytes + dcols.nbytes + nh.nbytes + 4  # + count scalar
+        self.d2h_bytes += xfer
+        self.delta_bytes += xfer
+        self.delta_columns += num
+        self.delta_extracts += 1
+        valid = cols < g.n_pad
+        cols_real = cols[valid].astype(np.int64)
+        if self._d_host is not None:
+            self._d_host[:, cols_real] = dcols[:, valid]
+        if self._nh_mask is not None and self._nh_links == names:
+            mask_cols = nh[: len(names)][:, valid]
+            for i, (nm, is_ov) in enumerate(zip(names, ov_l)):
+                if is_ov:
+                    # an overloaded neighbor relays nothing: valid only
+                    # when it is itself the destination (nh_mask semantics)
+                    mask_cols[i] &= cols_real == g.node_index[nm]
+            self._nh_mask[:, cols_real] = mask_cols
+        elif self._nh_mask is not None:
+            self._nh_mask = None  # up-link set moved: rebuild lazily
+            self._nh_links = None
+        self._last_solve_delta = cols_real
+
+    def take_route_delta(self) -> Optional[set]:
+        """One-shot consumer handshake for the DeltaPath route build: the
+        changed destination columns accumulated since the last take (an
+        empty set means solves ran but no destination moved, or no solve
+        ran), or None when any intervening solve could not produce a
+        device delta — the caller must rebuild the full route db, which
+        re-arms accumulation."""
+        out = self._delta_pending
+        self._delta_pending = set()
+        return out
+
     def nh_mask(self) -> Tuple[List[str], np.ndarray]:
         """(neighbor names, [L, n_pad] bool): entry [i, t] is True iff the
         i-th up-link from me is an ECMP first hop toward node t.
@@ -476,22 +709,7 @@ class _AreaSolve:
         with overloaded neighbors valid only as final destinations) replaces
         the per-destination link loop."""
         if self._nh_mask is None:
-            ls = self.link_state
-            names: List[str] = []
-            rows: List[int] = []
-            ws: List[int] = []
-            ov: List[bool] = []
-            for link in ls.ordered_links_from_node(self.me):
-                if not link.is_up():
-                    continue
-                n = link.other_node_name(self.me)
-                r = self.row_map.get(n)
-                if r is None:
-                    continue
-                names.append(n)
-                rows.append(r)
-                ws.append(link.metric_from_node(self.me))
-                ov.append(ls.is_node_overloaded(n))
+            names, rows, ws, ov = self._nh_link_arrays()
             if not names:
                 self._nh_links = []
                 self._nh_mask = np.zeros(
@@ -793,11 +1011,58 @@ class TpuSpfSolver(SpfSolver):
         if d_d2h:
             solve._d2h_synced = solve.d2h_bytes
             self._bump("decision.spf.device_to_host_bytes", d_d2h)
+        # DeltaPath extraction stats (docs/Monitoring.md): changed columns
+        # and O(changes) copy-back bytes per warm event
+        d_cols = solve.delta_columns - solve._delta_cols_synced
+        if d_cols:
+            solve._delta_cols_synced = solve.delta_columns
+            self._bump("decision.spf.delta_columns", d_cols)
+        d_bytes = solve.delta_bytes - solve._delta_bytes_synced
+        if d_bytes:
+            solve._delta_bytes_synced = solve.delta_bytes
+            self._bump("decision.spf.delta_bytes", d_bytes)
+        if (
+            solve.delta_extracts > solve._delta_extracts_synced
+            and solve.delta_extract_ms_last is not None
+        ):
+            solve._delta_extracts_synced = solve.delta_extracts
+            self._observe(
+                "decision.spf.delta_extract_ms", solve.delta_extract_ms_last
+            )
         from openr_tpu.ops.spf import compile_cache_stats
 
         stats = compile_cache_stats()
         counters["decision.spf.compile_cache_hits"] = stats["hits"]
         counters["decision.spf.compile_cache_misses"] = stats["misses"]
+
+    # -- DeltaPath (device-side route-delta extraction) ------------------
+
+    def poll_device_delta(
+        self, area_link_states: Dict[str, LinkState]
+    ) -> Optional[Set[str]]:
+        """Refresh every area's device solve against the current LSDB and
+        return the union of changed destination NODE NAMES — iff every
+        area event since the last poll rode the device delta-extraction
+        path. None means some event had no device delta (cold solve,
+        overload change, flap incident to me, bulk event): the caller must
+        rebuild the full route db, which re-arms delta accumulation.
+
+        Areas where this node is absent contribute no routes (the pipeline
+        sees an empty SPF there) and are skipped."""
+        me = self.my_node_name
+        changed: Set[str] = set()
+        ok = True
+        for link_state in area_link_states.values():
+            solve = self._area_solve(link_state, me)
+            if solve is None:
+                continue
+            cols = solve.take_route_delta()
+            if cols is None:
+                ok = False  # keep draining the other areas' pending state
+                continue
+            names = solve.graph.names
+            changed.update(names[c] for c in cols if c < len(names))
+        return changed if ok else None
 
     # -- fault domain (SolverSupervisor seams) ---------------------------
 

@@ -507,3 +507,87 @@ def run_decision_backend_parity(
         return loop.run_until_complete(body())
     finally:
         loop.close()
+
+
+def run_bench_convergence(
+    nodes: int = 5, flaps: int = 2, backend: str = "tpu"
+) -> dict:
+    """Hello-to-programmed-route percentiles from an emulator flap run —
+    bench.py's second metric line (ROADMAP "relight the benchmark").
+
+    A `nodes`-node line topology converges, then the middle link fails and
+    restores `flaps` times; every event's spark→fib convergence span lands
+    in the per-node monitor rings and is folded network-wide by
+    `VirtualNetwork.convergence_report()` (the `breeze perf report` math).
+    Returns the aggregate e2e percentiles, so DeltaPath / solver wins show
+    up in the benchmark trajectory as `convergence.e2e_ms`, not just raw
+    SPF/s. The daemons run the requested Decision solver backend (tpu by
+    default: this is the path the delta extraction serves)."""
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    n = max(3, nodes)
+    mid = n // 2
+
+    async def body() -> dict:
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(
+                f"n{i}",
+                loopback_prefix=f"10.{i}.0.0/24",
+                config_overrides={
+                    "decision_config": {"solver_backend": backend}
+                },
+            )
+        await net.start_all()
+        for i in range(n - 1):
+            net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+        def converged() -> bool:
+            for i in range(n):
+                got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+                want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+                if not want.issubset(got):
+                    return False
+            return True
+
+        def partitioned() -> bool:
+            # after the mid link fails, the left side withdraws the
+            # rightmost prefix (and vice versa)
+            left = net.wrappers["n0"].programmed_prefixes()
+            right = net.wrappers[f"n{n - 1}"].programmed_prefixes()
+            return (
+                f"10.{n - 1}.0.0/24" not in left
+                and "10.0.0.0/24" not in right
+            )
+
+        try:
+            await wait_until(converged, timeout=60.0)
+            for _ in range(max(1, flaps)):
+                net.fail_link(
+                    f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+                )
+                await wait_until(partitioned, timeout=60.0)
+                net.restore_link(
+                    f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+                )
+                await wait_until(converged, timeout=60.0)
+            agg = net.convergence_report()
+        finally:
+            await net.stop_all()
+
+        e2e = agg["e2e_ms"]
+        return {
+            "nodes": n,
+            "flaps": max(1, flaps),
+            "backend": backend,
+            "spans_total": agg["spans_total"],
+            "e2e_p50_ms": e2e["p50"],
+            "e2e_p95_ms": e2e["p95"],
+            "e2e_max_ms": e2e["max"],
+        }
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()

@@ -159,6 +159,27 @@ def test_trace_safety_cli_exits_nonzero(tmp_path):
     assert analysis_main([str(path), "--no-baseline"]) == 1
 
 
+def test_trace_safety_reaches_delta_extraction_functions():
+    """Regression (ISSUE 6): the DeltaPath device-side extraction kernels
+    must sit inside the rule's jit-reachability set — a refactor that
+    renames a decorator or unhooks the `jax.jit(fn, ...)` factory call
+    would otherwise silently drop them from coverage."""
+    import ast
+
+    from openr_tpu.analysis.trace_safety import _traced_functions
+
+    tree = ast.parse((PKG / "ops" / "spf.py").read_text())
+    traced, direct = _traced_functions(tree)
+    traced_names = {fn.name for fn in traced}
+    direct_names = {fn.name for fn in direct}
+    # direct jit roots: decorated (_delta_extract) or passed to a
+    # jax.jit(...) factory call (_bf_warm_core)
+    assert {"_delta_extract", "_bf_warm_core"} <= direct_names
+    # transitively traced helpers shared by the cold and warm edge-list
+    # paths (called by name from traced functions in the same module)
+    assert {"_bf_relax", "_bf_allow"} <= traced_names
+
+
 # ---------------------------------------------------------------------------
 # thread-ownership
 # ---------------------------------------------------------------------------

@@ -2,6 +2,7 @@
 prints parseable JSON result lines (the contract bench.py also follows)."""
 
 import json
+import os
 
 import pytest
 
@@ -106,38 +107,87 @@ def test_incremental_bench(capsys, monkeypatch):
 
 def test_bench_py_smoke(capsys, monkeypatch):
     """`python bench.py` end-to-end under BENCH_SMOKE=1: tiny topology,
-    reps 1/2 — bench bitrot fails tier-1 instead of zeroing BENCH rounds."""
+    reps 1/2 — bench bitrot fails tier-1 instead of zeroing BENCH rounds.
+    Every stdout line must be parseable JSON: the SPF/s headline plus the
+    p95 hello-to-programmed-route convergence line from the emulator flap
+    run (the ROADMAP 'second bench metric line')."""
     import bench
 
     monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setenv("BENCH_CONV_NODES", "4")
+    monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert out, "bench.py printed no JSON line"
-    result = json.loads(out[-1])
-    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
-    assert result["value"] > 0
-    # conftest pins JAX_PLATFORMS=cpu, so the probe reports a native run
-    assert "backend" not in result
-    assert "degraded" not in result
+    assert len(out) >= 2, "bench.py must print SPF + convergence JSON lines"
+    results = [json.loads(line) for line in out]
+    for result in results:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
+        assert result["value"] > 0
+        # conftest pins JAX_PLATFORMS=cpu, so the probe reports native
+        assert "backend" not in result
+        assert "degraded" not in result
+    assert results[0]["metric"].endswith("spf_recomputes_per_sec")
+    assert results[1]["metric"] == "convergence_e2e_p95_ms"
+    assert results[1]["spans"] > 0
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):
     """A cpu-fallback run measures a reduced workload on the wrong
-    hardware: the JSON line must say so explicitly so BENCH consumers
+    hardware: every JSON line must say so explicitly so BENCH consumers
     treat it as an availability signal, never as a perf regression."""
     import bench
 
     monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setenv("BENCH_CONVERGENCE", "0")
     monkeypatch.setattr(bench, "_probe_backend", lambda: "cpu-fallback")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    result = json.loads(out[-1])
-    assert result["backend"] == "cpu-fallback"
-    assert result["degraded"] is True
-    # the availability-signal contract: a degraded line still carries the
-    # full metric shape, so dashboards can plot uptime without special
-    # cases — only perf comparisons must skip it
-    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
+    for line in out:
+        result = json.loads(line)
+        assert result["backend"] == "cpu-fallback"
+        assert result["degraded"] is True
+        # the availability-signal contract: a degraded line still carries
+        # the full metric shape, so dashboards can plot uptime without
+        # special cases — only perf comparisons must skip it
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
+
+
+def test_bench_py_dead_backend_degrades_never_raises():
+    """The BENCH_r02–r05 failure mode: a backend that passes the probe but
+    dies inside the workload (jax.devices() raising mid-bench). The bench
+    must route it through the breaker's degrade semantics — re-exec on
+    JAX_PLATFORMS=cpu, exit 0, and emit `"degraded": true` JSON — never
+    crash the round."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",  # probe short-circuits; fault injected
+            "BENCH_FAULT": "backend_unavailable",
+            "BENCH_SMOKE": "1",
+            "BENCH_CONVERGENCE": "0",  # keep the re-exec child lean
+        }
+    )
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    proc = subprocess.run(
+        [_sys.executable, str(bench_path)],
+        env=env,
+        capture_output=True,
+        timeout=500,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert lines, proc.stderr[-2000:]
+    for line in lines:
+        result = json.loads(line)
+        assert result["degraded"] is True
+        assert result["backend"] == "cpu-fallback"
+        assert result["fault_kind"]
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
 
 
 def test_config_store_bench(capsys, monkeypatch):

@@ -1,0 +1,559 @@
+"""DeltaPath (device-side route-delta extraction) differential suite.
+
+The O(changes) partial route rebuild (solver/delta.py) must be
+byte-identical to the classic full-mirror rebuild on every event class:
+randomized flap sequences (metric decrease/increase, adjacency flap,
+node-overload toggle), partitions, and `_PATCH_SLOTS` overflow — and the
+warm single-link event must copy back O(changes) bytes, never the full
+[s_pad, n_pad] mirror (the ISSUE 6 transfer-budget acceptance criterion).
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.solver import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    DeltaRouteBuilder,
+    SolverSupervisor,
+    SpfSolver,
+    SupervisorConfig,
+    TpuSpfSolver,
+    apply_route_delta,
+    get_route_delta,
+)
+from openr_tpu.solver.supervisor import OPEN
+from openr_tpu.topology import build_adj_dbs, fabric_edges, grid_edges
+from openr_tpu.types import IpPrefix, PrefixDatabase, PrefixEntry
+
+
+def build_ls(edges, area="0", **kwargs):
+    ls = LinkState(area)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def make_prefix_state(announcers, area="0", **entry_kw):
+    ps = PrefixState()
+    for node, pfxs in announcers.items():
+        ps.update_prefix_database(
+            PrefixDatabase(
+                node,
+                [PrefixEntry(IpPrefix(p), **entry_kw) for p in pfxs],
+                area=area,
+            )
+        )
+    return ps
+
+
+def assert_route_db_equal(db_a, db_b):
+    assert db_a is not None and db_b is not None
+    assert set(db_a.unicast_entries) == set(db_b.unicast_entries)
+    for prefix, entry in db_a.unicast_entries.items():
+        assert db_b.unicast_entries[prefix] == entry, prefix
+    assert set(db_a.mpls_entries) == set(db_b.mpls_entries)
+    for label, entry in db_a.mpls_entries.items():
+        assert db_b.mpls_entries[label] == entry, label
+
+
+def apply_weight_event(rng, dbs, ls, links):
+    """One randomized weight-only LSDB event (the classes the delta path
+    serves or must correctly refuse): adjacency flap via overload, metric
+    change, or node-overload toggle. Mutates dbs and ls."""
+    kind = rng.choice(("flap", "metric", "node_overload"))
+    if kind in ("flap", "metric"):
+        a, b, _ = links[rng.randrange(len(links))]
+        db = dbs[a]
+        new_adjs = []
+        for adj in db.adjacencies:
+            if adj.other_node_name == b:
+                if kind == "flap":
+                    adj = dataclasses.replace(
+                        adj, is_overloaded=not adj.is_overloaded
+                    )
+                else:
+                    adj = dataclasses.replace(adj, metric=rng.randint(1, 9))
+            new_adjs.append(adj)
+        dbs[a] = dataclasses.replace(db, adjacencies=new_adjs)
+        ls.update_adjacency_database(dbs[a])
+    else:
+        node = sorted(dbs)[rng.randrange(len(dbs))]
+        dbs[node] = dataclasses.replace(
+            dbs[node], is_overloaded=not dbs[node].is_overloaded
+        )
+        ls.update_adjacency_database(dbs[node])
+    return kind
+
+
+def set_metric(dbs, ls, a, b, metric):
+    """Set the directed metric of a's adjacency toward b."""
+    dbs[a] = dataclasses.replace(
+        dbs[a],
+        adjacencies=[
+            dataclasses.replace(adj, metric=metric)
+            if adj.other_node_name == b
+            else adj
+            for adj in dbs[a].adjacencies
+        ],
+    )
+    ls.update_adjacency_database(dbs[a])
+
+
+def set_adj_overload(dbs, ls, a, b, overloaded):
+    dbs[a] = dataclasses.replace(
+        dbs[a],
+        adjacencies=[
+            dataclasses.replace(adj, is_overloaded=overloaded)
+            if adj.other_node_name == b
+            else adj
+            for adj in dbs[a].adjacencies
+        ],
+    )
+    ls.update_adjacency_database(dbs[a])
+
+
+class DeltaHarness:
+    """TpuSpfSolver + DeltaRouteBuilder over a mutable LSDB, checked
+    against a cold full rebuild after every step."""
+
+    def __init__(self, edges, me, announcers, **entry_kw):
+        self.me = me
+        self.dbs = build_adj_dbs(edges)
+        self.ls = LinkState("0")
+        for db in self.dbs.values():
+            self.ls.update_adjacency_database(db)
+        self.ps = make_prefix_state(announcers, **entry_kw)
+        self.solver = TpuSpfSolver(me)
+        self.builder = DeltaRouteBuilder(self.solver)
+        self.als = {"0": self.ls}
+        self.db, _, used = self.builder.build(
+            me, self.als, self.ps, None, force_full=True
+        )
+        assert not used  # first build is always full
+        assert self.db is not None
+
+    def step(self, dirty_prefixes=frozenset(), force_full=False):
+        """One rebuild; asserts the result — delta-built or not — equals a
+        from-scratch full rebuild of the same LSDB, and that the emitted
+        update folds the previous db into the new one. Returns used_delta."""
+        prev = self.db
+        new_db, update, used = self.builder.build(
+            self.me,
+            self.als,
+            self.ps,
+            prev,
+            dirty_prefixes=dirty_prefixes,
+            force_full=force_full,
+        )
+        ref = TpuSpfSolver(self.me).build_route_db(self.me, self.als, self.ps)
+        assert_route_db_equal(ref, new_db)
+        oracle = SpfSolver(self.me).build_route_db(self.me, self.als, self.ps)
+        assert_route_db_equal(oracle, new_db)
+        folded = apply_route_delta(prev, update)
+        assert_route_db_equal(new_db, folded)
+        self.db = new_db
+        return used
+
+
+PFXS = ["10.1.0.0/16", "10.2.0.0/16", "10.3.0.0/16", "10.4.0.0/16"]
+
+
+class TestDeltaDifferential:
+    """Randomized flap sequences: the delta-built RouteDatabase must stay
+    identical to the full-mirror rebuild (TPU) and the CPU oracle."""
+
+    def test_grid_random_sequences(self):
+        for seed in (5, 23):
+            h = DeltaHarness(
+                grid_edges(4),
+                "g0_0",
+                {
+                    "g3_3": [PFXS[0]],
+                    "g0_3": [PFXS[1]],
+                    "g2_1": [PFXS[2]],
+                    "g1_2": [PFXS[3]],
+                },
+            )
+            rng = random.Random(seed)
+            links = list(grid_edges(4))
+            applied = 0
+            for _ in range(14):
+                before = h.ls.version
+                apply_weight_event(rng, h.dbs, h.ls, links)
+                if h.ls.version == before:
+                    continue
+                h.step()
+                applied += 1
+            assert applied > 0
+            # the sequences mix qualifying and disqualifying events: both
+            # paths must have served
+            assert h.builder.delta_builds > 0
+            assert h.builder.full_builds > 1
+
+    def test_clos_random_sequence(self):
+        edges = fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        h = DeltaHarness(
+            edges, "rsw0_0", {"rsw1_2": [PFXS[0]], "rsw0_2": [PFXS[1]]}
+        )
+        rng = random.Random(17)
+        links = list(edges)
+        for _ in range(10):
+            before = h.ls.version
+            apply_weight_event(rng, h.dbs, h.ls, links)
+            if h.ls.version == before:
+                continue
+            h.step()
+        assert h.builder.delta_builds > 0
+
+    def test_batched_events_accumulate_columns(self):
+        # several qualifying events between rebuilds: the accumulated
+        # changed-column set must describe the union
+        h = DeltaHarness(
+            grid_edges(4), "g0_0", {"g3_3": [PFXS[0]], "g0_3": [PFXS[1]]}
+        )
+        set_metric(h.dbs, h.ls, "g3_2", "g3_3", 7)
+        h.solver.poll_device_delta(h.als)  # solve event 1, delta pends
+        set_metric(h.dbs, h.ls, "g2_3", "g3_3", 7)
+        set_metric(h.dbs, h.ls, "g0_2", "g0_3", 5)
+        assert h.step() is True
+        assert h.builder.delta_builds == 1
+
+    def test_increase_then_decrease_same_link(self):
+        h = DeltaHarness(
+            [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("a", "d", 9)],
+            "a",
+            {"d": [PFXS[0]], "c": [PFXS[1]]},
+        )
+        used = []
+        for metric in (8, 1):  # invalidation pass, then warm decrease
+            set_metric(h.dbs, h.ls, "b", "c", metric)
+            used.append(h.step())
+        assert used == [True, True]
+
+    def test_partition_flap_and_heal_deletes_and_restores(self):
+        edges = [
+            ("a", "b", 1), ("b", "c", 1), ("c", "a", 1),
+            ("c", "x", 2),  # bridge
+            ("x", "y", 1), ("y", "z", 1), ("z", "x", 1),
+        ]
+        h = DeltaHarness(edges, "a", {"z": [PFXS[0]], "b": [PFXS[1]]})
+        far = IpPrefix(PFXS[0])
+        assert far in h.db.unicast_entries
+        # both directions of the bridge go down: far side unreachable
+        set_adj_overload(h.dbs, h.ls, "c", "x", True)
+        set_adj_overload(h.dbs, h.ls, "x", "c", True)
+        assert h.step() is True  # remote flap rides the delta path
+        assert far not in h.db.unicast_entries
+        assert IpPrefix(PFXS[1]) in h.db.unicast_entries
+        set_adj_overload(h.dbs, h.ls, "c", "x", False)
+        set_adj_overload(h.dbs, h.ls, "x", "c", False)
+        assert h.step() is True
+        assert far in h.db.unicast_entries
+
+    def test_node_overload_toggle_takes_full_path(self):
+        # a transit-mask change cannot be described by changed D columns
+        # alone: the solver must refuse the delta and the full path serves
+        h = DeltaHarness(
+            grid_edges(3), "g0_0", {"g2_2": [PFXS[0]], "g0_2": [PFXS[1]]}
+        )
+        for overloaded in (True, False):
+            h.dbs["g1_1"] = dataclasses.replace(
+                h.dbs["g1_1"], is_overloaded=overloaded
+            )
+            h.ls.update_adjacency_database(h.dbs["g1_1"])
+            assert h.step() is False
+        assert h.builder.delta_builds == 0
+
+    def test_event_incident_to_me_takes_full_path(self):
+        # my own out-link metric is a route input no distance column
+        # reflects (the nexthop triangle's weight column)
+        h = DeltaHarness(
+            grid_edges(3), "g0_0", {"g2_2": [PFXS[0]]}
+        )
+        set_metric(h.dbs, h.ls, "g0_0", "g0_1", 4)
+        assert h.step() is False
+
+    def test_patch_slots_overflow_takes_full_path(self, monkeypatch):
+        import openr_tpu.solver.tpu as tpu_mod
+
+        monkeypatch.setattr(tpu_mod, "_PATCH_SLOTS", 0)
+        h = DeltaHarness(
+            [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)],
+            "a",
+            {"d": [PFXS[0]]},
+        )
+        set_metric(h.dbs, h.ls, "b", "c", 6)  # overflows the 0-slot budget
+        assert h.step() is False
+        assert h.builder.delta_builds == 0
+
+    def test_prefix_advertisement_change_rides_dirty_set(self):
+        # a prefix event with no topology change: Decision feeds the dirty
+        # prefixes explicitly; no solve delta pends, but the partial path
+        # still serves it (changed_nodes is empty, not None)
+        h = DeltaHarness(
+            grid_edges(3), "g0_0", {"g2_2": [PFXS[0]]}
+        )
+        dirty = h.ps.update_prefix_database(
+            PrefixDatabase(
+                "g0_2", [PrefixEntry(IpPrefix(PFXS[1]))], area="0"
+            )
+        )
+        assert dirty
+        assert h.step(dirty_prefixes=dirty) is True
+        assert IpPrefix(PFXS[1]) in h.db.unicast_entries
+        # withdrawal deletes through the same path
+        dirty = h.ps.update_prefix_database(
+            PrefixDatabase("g0_2", [], area="0")
+        )
+        assert h.step(dirty_prefixes=dirty) is True
+        assert IpPrefix(PFXS[1]) not in h.db.unicast_entries
+
+    def test_force_full_drains_pending_delta(self):
+        # a forced-full rebuild must consume the accumulated delta so a
+        # stale column set never rides into a later event
+        h = DeltaHarness(grid_edges(3), "g0_0", {"g2_2": [PFXS[0]]})
+        set_metric(h.dbs, h.ls, "g1_2", "g2_2", 8)
+        assert h.step(force_full=True) is False
+        set_metric(h.dbs, h.ls, "g1_2", "g2_2", 1)
+        assert h.step() is True  # re-armed, next event is delta-served
+
+
+class TestTransferBudget:
+    """ISSUE 6 acceptance: a warm single-link-flap event transfers
+    O(changes) host<->device bytes — bounded by the changed columns'
+    compaction bucket, never by n_pad."""
+
+    def test_single_link_warm_event_d2h_is_o_changes(self):
+        from openr_tpu.ops.graph import _next_bucket
+
+        side = 12  # 144 nodes
+        h = DeltaHarness(
+            grid_edges(side),
+            "g0_0",
+            {f"g{side - 1}_{side - 1}": [PFXS[0]]},
+        )
+        solve = h.solver._solves[("0", "g0_0")][1]
+        s_pad, n_pad = solve.d.shape
+        full_mirror_bytes = s_pad * n_pad * 4
+        d2h_before = solve.d2h_bytes
+        extracts_before = solve.delta_extracts
+        cols_before = solve.delta_columns
+        # bump both far-corner in-edges (one leaves the other ECMP leg
+        # equal-cost, changing nothing): exactly one column moves
+        corner = f"g{side - 1}_{side - 1}"
+        set_metric(h.dbs, h.ls, f"g{side - 2}_{side - 1}", corner, 9)
+        set_metric(h.dbs, h.ls, f"g{side - 1}_{side - 2}", corner, 9)
+        assert h.step() is True
+        assert solve.delta_extracts == extracts_before + 1
+        xfer = solve.d2h_bytes - d2h_before
+        # the whole event's copy-back (count scalar + compacted columns +
+        # nexthop rows) fits the bucket bound and is far below the mirror
+        num = solve.delta_columns - cols_before
+        cap = _next_bucket(num, minimum=8)
+        l_pad = _next_bucket(
+            max(len(solve._nh_link_arrays()[0]), 1), minimum=8
+        )
+        assert num < n_pad // 4
+        assert xfer <= 4 + cap * (4 + 4 * s_pad + l_pad)
+        assert xfer < full_mirror_bytes // 4
+        # and the route build consumed the patched mirror: no full fetch
+        assert solve.d2h_bytes - d2h_before == xfer
+
+    def test_patched_mirror_matches_cold_fetch(self):
+        h = DeltaHarness(
+            grid_edges(6), "g0_0", {"g5_5": [PFXS[0]], "g0_5": [PFXS[1]]}
+        )
+        set_metric(h.dbs, h.ls, "g4_5", "g5_5", 7)
+        assert h.step() is True
+        warm = h.solver._solves[("0", "g0_0")][1]
+        cold = TpuSpfSolver("g0_0")
+        cold.build_route_db("g0_0", h.als, h.ps)
+        cold_solve = cold._solves[("0", "g0_0")][1]
+        np.testing.assert_array_equal(warm.d, cold_solve.d)
+
+
+class TestApplyRouteDelta:
+    def test_apply_is_diff_inverse(self):
+        me, announcers = "g0_0", {
+            "g2_2": [PFXS[0]], "g0_2": [PFXS[1]], "g1_1": [PFXS[2]]
+        }
+        ls_old = build_ls(grid_edges(3))
+        old = SpfSolver(me).build_route_db(
+            me, {"0": ls_old}, make_prefix_state(announcers)
+        )
+        edges_new = [
+            (a, b, 9 if (a, b) == ("g1_2", "g2_2") else w)
+            for a, b, w in grid_edges(3)
+        ]
+        new = SpfSolver(me).build_route_db(
+            me,
+            {"0": build_ls(edges_new)},
+            make_prefix_state({"g2_2": [PFXS[0]], "g1_1": [PFXS[2]]}),
+        )
+        folded = apply_route_delta(old, get_route_delta(new, old))
+        assert_route_db_equal(new, folded)
+        assert get_route_delta(folded, new).empty()
+
+    def test_unchanged_entries_are_shared(self):
+        old = DecisionRouteDb()
+        new = apply_route_delta(old, DecisionRouteUpdate())
+        assert new.unicast_entries == {} and new.mpls_entries == {}
+
+
+class TestSupervisorDeltaFaultDomain:
+    """Breaker trips and shadow audits must force the full path."""
+
+    def _inputs(self):
+        edges = grid_edges(3)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"g2_2": [PFXS[0]], "g0_2": [PFXS[1]]})
+        return "g0_0", {"0": ls}, ps
+
+    def test_poll_gated_while_breaker_open(self):
+        me, als, ps = self._inputs()
+        sup = SolverSupervisor(
+            TpuSpfSolver(me), SpfSolver(me), SupervisorConfig()
+        )
+        sup.build_route_db(me, als, ps)
+        sup.state = OPEN
+        assert sup.poll_device_delta(als) is None
+
+    def test_poll_fault_classified_and_degrades(self):
+        me, als, ps = self._inputs()
+        sup = SolverSupervisor(
+            TpuSpfSolver(me), SpfSolver(me), SupervisorConfig()
+        )
+        sup.build_route_db(me, als, ps)
+
+        def boom(_als):
+            raise RuntimeError("DEVICE_LOST: chip went away")
+
+        sup.primary.poll_device_delta = boom
+        assert sup.poll_device_delta(als) is None
+        assert sup.counters["decision.spf.solver_failures.device_loss"] == 1
+
+    def test_verify_route_delta_self_heals_mismatch(self):
+        me, als, ps = self._inputs()
+        samples = []
+        sup = SolverSupervisor(
+            TpuSpfSolver(me),
+            SpfSolver(me),
+            SupervisorConfig(audit_interval=1),
+            log_sample_fn=samples.append,
+        )
+        full = sup.build_route_db(me, als, ps)
+        corrupted = DecisionRouteDb(
+            unicast_entries=dict(
+                list(full.unicast_entries.items())[:-1]  # drop one route
+            ),
+            mpls_entries=dict(full.mpls_entries),
+        )
+        corrected = sup.verify_route_delta(corrupted, me, als, ps)
+        assert corrected is not None
+        assert_route_db_equal(full, corrected)
+        assert sup.counters["decision.spf.delta_audit_mismatches"] == 1
+        assert any(
+            s.get("event") == "ROUTE_DELTA_AUDIT_MISMATCH" for s in samples
+        )
+
+    def test_verify_route_delta_clean_db_passes(self):
+        me, als, ps = self._inputs()
+        sup = SolverSupervisor(
+            TpuSpfSolver(me),
+            SpfSolver(me),
+            SupervisorConfig(audit_interval=1),
+        )
+        full = sup.build_route_db(me, als, ps)
+        assert sup.verify_route_delta(full, me, als, ps) is None
+        assert sup.counters["decision.spf.delta_audit_runs"] == 1
+        assert "decision.spf.delta_audit_mismatches" not in sup.counters
+
+
+class TestDecisionDeltaPath:
+    """End to end through Decision: a qualifying remote flap must be served
+    by the delta route build and emit the same update the full path would."""
+
+    def test_remote_metric_flap_uses_delta_build(self):
+        import asyncio
+
+        from openr_tpu.decision import Decision, DecisionConfig
+        from openr_tpu.messaging import ReplicateQueue, RQueue, RWQueue
+        from openr_tpu.types import Publication, Value, adj_key, prefix_key
+        from openr_tpu.utils import serializer
+
+        async def body():
+            kv_q = RWQueue()
+            route_q = ReplicateQueue()
+            decision = Decision(
+                DecisionConfig(
+                    my_node_name="a",
+                    solver_backend="tpu",
+                    debounce_min=0.005,
+                    debounce_max=0.02,
+                ),
+                RQueue(kv_q),
+                route_q,
+            )
+            reader = route_q.get_reader()
+            decision.start()
+            edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("d", "e", 1)]
+            dbs = build_adj_dbs(edges)
+            pub = Publication(area="0")
+            for db in dbs.values():
+                pub.key_vals[adj_key(db.this_node_name)] = Value(
+                    1, db.this_node_name, serializer.dumps(db)
+                )
+            pub.key_vals[prefix_key("e")] = Value(
+                1, "e", serializer.dumps(
+                    PrefixDatabase("e", [PrefixEntry(IpPrefix(PFXS[0]))])
+                )
+            )
+            kv_q.push(pub)
+            await asyncio.wait_for(reader.get(), 10)
+            assert decision.counters.get(
+                "decision.route_build_delta_runs", 0
+            ) == 0  # first build is full
+            # remote metric bump: c->d — c is not adjacent to me, so the
+            # batch qualifies at the Decision layer too
+            dbs["c"] = dataclasses.replace(
+                dbs["c"],
+                adjacencies=[
+                    dataclasses.replace(adj, metric=5)
+                    if adj.other_node_name == "d"
+                    else adj
+                    for adj in dbs["c"].adjacencies
+                ],
+            )
+            pub2 = Publication(area="0")
+            pub2.key_vals[adj_key("c")] = Value(
+                2, "c", serializer.dumps(dbs["c"])
+            )
+            kv_q.push(pub2)
+            delta = await asyncio.wait_for(reader.get(), 10)
+            assert decision.counters["decision.route_build_delta_runs"] == 1
+            routes = {e.prefix: e for e in delta.unicast_routes_to_update}
+            assert IpPrefix(PFXS[0]) in routes
+            entry = routes[IpPrefix(PFXS[0])]
+            assert {nh.metric for nh in entry.nexthops} == {8}
+            # the maintained route_db matches a from-scratch oracle build
+            ls = LinkState("0")
+            for db in dbs.values():
+                ls.update_adjacency_database(db)
+            oracle = SpfSolver("a").build_route_db(
+                "a", {"0": ls}, decision.prefix_state
+            )
+            assert_route_db_equal(oracle, decision.route_db)
+            decision.stop()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(body(), 30))
+        finally:
+            loop.close()
