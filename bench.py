@@ -19,7 +19,8 @@ where results stay device-resident).
 Set BENCH_TOPO=grid for the 1k-node grid config (BASELINE.md config 1, with
 ECMP first-hop DAG extraction fused — config 4 semantics).
 
-Prints ONE JSON line:
+Prints one JSON line per metric (SPF/s headline, convergence p95, TE
+optimize latency):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "baseline": ...}
 plus detail lines on stderr.
 """
@@ -298,6 +299,9 @@ def _apply_smoke_env() -> None:
             ("BENCH_REPS_SMALL", "1"),
             ("BENCH_REPS_BIG", "2"),
             ("BENCH_CPU_SAMPLES", "4"),
+            ("BENCH_TE_STEPS", "6"),
+            ("BENCH_TE_SCENARIOS", "2"),
+            ("BENCH_TE_REPEATS", "1"),
         )
     )
 
@@ -315,6 +319,9 @@ def _apply_reduced_env() -> None:
             ("BENCH_CPU_SAMPLES", "8"),
             ("BENCH_CONV_NODES", "4"),
             ("BENCH_CONV_FLAPS", "1"),
+            ("BENCH_TE_STEPS", "12"),
+            ("BENCH_TE_SCENARIOS", "2"),
+            ("BENCH_TE_REPEATS", "1"),
         )
     )
 
@@ -389,6 +396,56 @@ def _bench_convergence() -> dict:
     }
 
 
+def _bench_te() -> dict:
+    """Third metric line: wall-clock of one what-if differentiable-TE
+    optimization (openr_tpu/te) on the congested 2-pod Clos fixture with
+    its skewed synthetic demand matrix — the TE workload enters the bench
+    trajectory from day one as te_optimize_ms. Degraded-aware like the
+    other lines: a cpu-fallback round runs the identical optimization with
+    a reduced step budget and is marked `"degraded": true` by main()."""
+    from openr_tpu.lsdb import LinkState
+    from openr_tpu.te import TeService, congested_clos_fixture
+    from openr_tpu.topology import build_adj_dbs
+
+    steps = int(os.environ.get("BENCH_TE_STEPS", "48"))
+    scenarios = int(os.environ.get("BENCH_TE_SCENARIOS", "4"))
+    repeats = int(os.environ.get("BENCH_TE_REPEATS", "3"))
+
+    edges, spec = congested_clos_fixture()
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges).values():
+        ls.update_adjacency_database(db)
+    svc = TeService("l0_0", {"0": ls})
+    params = {"demands": spec, "steps": steps, "scenarios": scenarios}
+    report = svc.optimize(params)  # compile + first run, excluded
+    times = []
+    for _ in range(max(repeats, 1)):
+        report = svc.optimize(params)
+        times.append(report["solve_ms"])
+    best = min(times)
+    _note(
+        f"te-optimize: {report['nodes']}-node Clos, {report['scenarios']} "
+        f"scenario(s), {report['steps']} steps in {best:.1f}ms (best of "
+        f"{len(times)}; first+compile excluded) — max util "
+        f"{report['initial_max_util']:.2f} -> "
+        f"{report['optimized_max_util']:.2f}"
+    )
+    return {
+        "metric": "te_optimize_ms",
+        "value": round(best, 2),
+        "unit": (
+            f"ms per what-if TE optimization ({report['nodes']}-node Clos, "
+            f"{report['scenarios']} scenario(s), {report['steps']} Adam "
+            f"steps, compile excluded)"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "initial_max_util": report["initial_max_util"],
+        "optimized_max_util": report["optimized_max_util"],
+        "improved": report["improved"],
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -431,6 +488,8 @@ def main(argv=None) -> None:
         results = [bench_grid() if topo == "grid" else bench_wan()]
         if os.environ.get("BENCH_CONVERGENCE", "1") == "1":
             results.append(_bench_convergence())
+        if os.environ.get("BENCH_TE", "1") == "1":
+            results.append(_bench_te())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-ANALYSIS_VERSION = "1.1.0"  # 1.1: registry-drift LogSample event catalog
+ANALYSIS_VERSION = "1.2.0"  # 1.2: trace-safety grad/vmap-reachability
 
 _IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
 _SKIP_FILE_RE = re.compile(r"#\s*analysis:\s*skip-file")

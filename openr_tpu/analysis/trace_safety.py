@@ -4,9 +4,11 @@ The solver hot path (ops/spf.py, solver/tpu.py, parallel/mesh.py) lives
 inside `jax.jit`; the paper's wins die the moment a traced function forces
 an implicit host transfer (the tensorized Floyd–Warshall lesson, PAPERS.md).
 This rule finds the functions that trace — decorated with `jax.jit`, passed
-to a `jax.jit(...)`/`shard_map(...)` call, nested inside a traced function,
-or called by name from one (per module, transitively) — and flags, inside
-them:
+to a `jax.jit(...)`/`shard_map(...)` call or a transform that traces its
+operand (`grad(...)`/`value_and_grad(...)`/`vmap(...)` — the differentiable
+TE core in openr_tpu/te/ reaches its objective exclusively through
+`jax.value_and_grad`), nested inside a traced function, or called by name
+from one (per module, transitively) — and flags, inside them:
 
   - `python-branch`: an `if`/`while`/conditional-expression test that
     contains a jnp/jax call (tracer-valued: `if jnp.any(...)` forces a
@@ -80,23 +82,28 @@ def _numpy_aliases(tree: ast.AST) -> Set[str]:
     return aliases
 
 
+# calls whose function-valued arguments trace: jit/shard_map compile,
+# grad/value_and_grad/vmap trace their operand on every (re)trace
+_TRACE_ENTRY_CALLS = ("jit", "shard_map", "grad", "value_and_grad", "vmap")
+
+
 def _is_jit_entry(call: ast.Call) -> bool:
-    """jax.jit(...) / jit(...) / shard_map(...) call."""
+    """jax.jit(...) / shard_map(...) / grad(...) / vmap(...) call."""
     name = call_name(call)
-    return name in ("jit", "shard_map")
+    return name in _TRACE_ENTRY_CALLS
 
 
 def _jit_decorated(fn) -> bool:
     for dec in fn.decorator_list:
         target = dec.func if isinstance(dec, ast.Call) else dec
         base = dotted_name(target) or ""
-        if base.split(".")[-1] in ("jit", "shard_map"):
+        if base.split(".")[-1] in _TRACE_ENTRY_CALLS:
             return True
         if isinstance(dec, ast.Call):
             # functools.partial(jax.jit, ...) and friends
             for arg in dec.args:
                 nm = dotted_name(arg) or ""
-                if nm.split(".")[-1] in ("jit", "shard_map"):
+                if nm.split(".")[-1] in _TRACE_ENTRY_CALLS:
                     return True
     return False
 

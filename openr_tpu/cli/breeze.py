@@ -7,7 +7,8 @@ monitor / openr). argparse instead of click (no extra deps in this image);
 same command vocabulary:
 
   breeze kvstore keys|keyvals|peers|areas
-  breeze decision adj|prefixes|routes|rib-policy|solver-health
+  breeze decision adj|prefixes|routes|rib-policy|solver-health|
+                  te-optimize [--demands file.json] [--steps N] [--json]
   breeze fib routes|unicast-routes|mpls-routes|counters
   breeze lm links|set-node-overload|unset-node-overload|
             set-link-overload|unset-link-overload|
@@ -168,6 +169,50 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
         state = "DEGRADED" if health.get("degraded") else "HEALTHY"
         print(f"solver: {state} (breaker: {health.get('breaker_state')})")
         _print_json(health)
+    elif args.cmd == "te-optimize":
+        params = {}
+        if args.demands:
+            with open(args.demands) as fh:
+                params["demands"] = json.load(fh)
+        if args.steps is not None:
+            params["steps"] = args.steps
+        if args.scenarios is not None:
+            params["scenarios"] = args.scenarios
+        report = client.call("runTeOptimize", **params)
+        if args.json:
+            _print_json(report)
+            return
+        state = "DEGRADED cpu-fallback" if report.get("degraded") else "ok"
+        print(
+            f"te-optimize [{state}]: max link util "
+            f"{report['initial_max_util']:.3f} -> "
+            f"{report['optimized_max_util']:.3f} "
+            f"({report['scenarios']} scenario(s), {report['steps']} steps, "
+            f"{report['solve_ms']:.1f}ms)"
+        )
+        if not report["weight_changes"]:
+            print("no improving weight change found")
+        else:
+            _print_table(
+                ["Node", "Neighbor", "Iface", "Metric", "Proposed"],
+                [
+                    [
+                        c["node"],
+                        c["neighbor"],
+                        c["iface"],
+                        c["metric_before"],
+                        c["metric_after"],
+                    ]
+                    for c in report["weight_changes"]
+                ],
+            )
+        hottest = report["top_links"]["optimized"]
+        if hottest:
+            print("hottest links (proposed weights, worst scenario):")
+            _print_table(
+                ["Src", "Dst", "Util"],
+                [[l["src"], l["dst"], l["util"]] for l in hottest],
+            )
     elif args.cmd == "path":
         # all shortest paths src -> dst over the live adjacency dump
         # (py/openr/cli/commands/decision.py PathCmd equivalent)
@@ -550,6 +595,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default=None)
     dec.add_parser("rib-policy")
     dec.add_parser("solver-health")
+    p = dec.add_parser("te-optimize")
+    p.add_argument(
+        "--demands",
+        default=None,
+        help="JSON demand spec file (docs/TrafficEngineering.md format)",
+    )
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--scenarios", type=int, default=None)
+    p.add_argument(
+        "--json", action="store_true", help="dump the full report"
+    )
     p = dec.add_parser("path")
     p.add_argument("src")
     p.add_argument("dst")

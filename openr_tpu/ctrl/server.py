@@ -390,6 +390,15 @@ class CtrlServer:
             ), db in self.decision.get_prefix_databases().items()
         }
 
+    def m_runTeOptimize(self, params) -> Dict[str, Any]:
+        """What-if gradient-descent TE optimization over the live LSDB
+        (docs/TrafficEngineering.md): proposes link-metric changes plus
+        the predicted hard-SPF max-link-utilization delta; programs
+        nothing. params: demands (spec dict), steps, scenarios, area,
+        seed, plus optimizer knobs (lr, tau0, tau_min, ...)."""
+        assert self.decision is not None, "decision module not attached"
+        return self.decision.run_te_optimize(params or {})
+
     def m_setRibPolicy(self, params) -> None:
         assert self.decision is not None
         from openr_tpu.solver.rib_policy import RibPolicy

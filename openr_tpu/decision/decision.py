@@ -68,6 +68,44 @@ def _loads_cached(data: bytes):
     return serializer.loads(data)
 
 
+def _adjacencies_to_me_changed(
+    prior_db: Optional[AdjacencyDatabase],
+    adj_db: AdjacencyDatabase,
+    me: str,
+) -> bool:
+    """DeltaPath qualification for a neighbor's adjacency update.
+
+    My route inputs beyond distances (nexthop addresses, my link up/down,
+    my triangle weights) can only move when the neighbor's adjacencies TO
+    ME changed: the LinkState ordered diff applies only the advertising
+    node's own direction, so a far-side-only update leaves every link to
+    me byte-identical. Compares exactly the fields that diff consumes; a
+    node with no prior advertisement is structural and forces the full
+    path through the comparison (None != [...])."""
+
+    def to_me(db: Optional[AdjacencyDatabase]):
+        if db is None:
+            return None
+        return sorted(
+            (
+                adj.if_name,
+                adj.other_if_name,
+                adj.metric,
+                adj.adj_label,
+                adj.is_overloaded,
+                adj.nexthop_v4,
+                adj.nexthop_v6,
+            )
+            for adj in db.adjacencies
+            if adj.other_node_name == me
+        )
+
+    new = to_me(adj_db)
+    if not new and not (prior_db is not None and to_me(prior_db)):
+        return False  # no adjacency to me on either side of the update
+    return to_me(prior_db) != new
+
+
 def _load_adj_db(data: bytes, area: str) -> AdjacencyDatabase:
     adj_db = _loads_cached(data)
     assert isinstance(adj_db, AdjacencyDatabase)
@@ -244,6 +282,9 @@ class Decision(CountersMixin, HistogramsMixin):
         self.route_updates_queue = route_updates_queue
         self.static_routes_updates = static_routes_updates
         self._loop = loop
+        self._log_sample_fn = log_sample_fn
+        # lazy TE engine (openr_tpu/te): built on the first runTeOptimize
+        self._te_service = None
 
         solver_kwargs = dict(
             enable_v4=config.enable_v4,
@@ -526,6 +567,12 @@ class Decision(CountersMixin, HistogramsMixin):
         changed = False
         if key.startswith(ADJ_DB_MARKER):
             adj_db = _load_adj_db(value.value, area)
+            # snapshot the previous advertisement before the LinkState
+            # diff replaces it: the DeltaPath qualification below compares
+            # the adjacencies-to-me across the update
+            prior_db = link_state.get_adjacency_databases().get(
+                adj_db.this_node_name
+            )
             hold_up = hold_down = 0
             if self.config.enable_ordered_fib:
                 # hold TTLs from hop distance (Decision.cpp:1669-1679)
@@ -549,18 +596,20 @@ class Decision(CountersMixin, HistogramsMixin):
             ):
                 changed = True
                 # DeltaPath qualification: a label move re-arbitrates the
-                # whole node-label table, and an adjacency update touching
-                # my own links changes route inputs (nexthop addresses,
-                # link up/down, my triangle weights) that no distance
-                # column reflects — those batches take the full rebuild
+                # whole node-label table, my own advertisement changes my
+                # links wholesale, and a neighbor whose adjacency TO ME
+                # changed moves route inputs (nexthop addresses, link
+                # up/down, my triangle weights) no distance column
+                # reflects. A neighbor update where the adjacency to me is
+                # byte-identical — only FAR-side links changed — leaves
+                # the link to me untouched and stays on the delta path
+                # (the narrowed ROADMAP refusal; the ordered diff only
+                # applies the advertising node's own direction).
                 me = self.config.my_node_name
                 if (
                     change.node_label_changed
                     or adj_db.this_node_name == me
-                    or any(
-                        adj.other_node_name == me
-                        for adj in adj_db.adjacencies
-                    )
+                    or _adjacencies_to_me_changed(prior_db, adj_db, me)
                 ):
                     self._pending.force_full = True
                 self._pending.apply(adj_db.perf_events, publication)
@@ -803,6 +852,30 @@ class Decision(CountersMixin, HistogramsMixin):
         return solver.build_route_db(
             node, self.area_link_states, self.prefix_state
         )
+
+    # analysis: shared — sync ctrl handler, loop-serialized with the owner
+    def run_te_optimize(self, params: Optional[Dict] = None) -> Dict:
+        """What-if differentiable-TE optimization over the live LSDB
+        (ctrl `runTeOptimize` / `breeze decision te-optimize`,
+        docs/TrafficEngineering.md). Read-only against routing state: the
+        report proposes weight changes, nothing is programmed. Runs
+        supervised when the solver is a SolverSupervisor — a device fault
+        degrades the optimization to the CPU backend and feeds the same
+        breaker as SPF solves."""
+        if self._te_service is None:
+            from openr_tpu.te import TeService
+
+            self._te_service = TeService(
+                self.config.my_node_name,
+                self.area_link_states,
+                solver=self.solver,
+                log_sample_fn=self._log_sample_fn,
+            )
+            # TE counters/histograms record straight into this module's
+            # monitor-registered dicts (same pattern as the supervisor)
+            self._te_service.counters = self.counters
+            self._te_service.histograms = self.histograms
+        return self._te_service.optimize(params)
 
     def get_solver_health(self) -> Dict:
         """Solver fault-domain state (ctrl getSolverHealth / `breeze

@@ -285,6 +285,73 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         )
 
     # ------------------------------------------------------------------
+    # generic supervised device workloads (TE optimization etc.)
+    # ------------------------------------------------------------------
+
+    def supervised_call(
+        self, op: str, primary_fn, fallback_fn=None, deadline_s=None
+    ):
+        """Run a non-SPF device workload inside this fault domain.
+
+        Same contract as a supervised solve: raised errors are classified
+        and feed the breaker (the workloads share the device — a TE
+        dispatch fault is device evidence like any other), retries are
+        bounded by `max_attempts`, and a completed-but-late call records a
+        deadline fault while its result is still served. While the
+        breaker is non-CLOSED, or when the retry budget is exhausted, the
+        fallback serves. Returns (result, degraded); with no fallback the
+        last primary error propagates."""
+        deadline = (
+            deadline_s if deadline_s is not None
+            else self.config.solve_deadline_s
+        )
+        if self.state != CLOSED and self._probe_task is None:
+            self.maybe_probe()  # loop-less embeddings still recover
+        if self.state != CLOSED:
+            if fallback_fn is None:
+                raise RuntimeError(
+                    f"supervised call {op}: breaker {self.state}, "
+                    f"no fallback"
+                )
+            return fallback_fn(), True
+
+        attempts = 0
+        last_exc: Optional[BaseException] = None
+        while True:
+            attempts += 1
+            self._touch_watchdog()
+            t0 = self._clock()
+            try:
+                result = primary_fn()
+            except Exception as exc:
+                last_exc = exc
+                self._record_failure(classify_solver_error(exc), exc)
+                if self.state != CLOSED:
+                    break
+                if attempts >= max(self.config.max_attempts, 1):
+                    break
+                self._bump("decision.spf.solver_retries")
+                continue
+            finally:
+                self._touch_watchdog()
+            elapsed = self._clock() - t0
+            if elapsed > deadline:
+                self._record_failure(
+                    FAULT_DEADLINE,
+                    SolveDeadlineExceeded(
+                        f"{op} took {elapsed:.3f}s (deadline {deadline}s)"
+                    ),
+                    elapsed_s=elapsed,
+                )
+            else:
+                self._record_success()
+            return result, False
+
+        if fallback_fn is None:
+            raise last_exc
+        return fallback_fn(), True
+
+    # ------------------------------------------------------------------
     # DeltaPath (device-side route-delta) fault domain
     # ------------------------------------------------------------------
 

@@ -1,0 +1,44 @@
+"""Differentiable traffic engineering over the live LSDB.
+
+A new Decision workload (ROADMAP "differentiable TE"): softmin-relaxed
+shortest paths turn link weights into optimizable parameters, a manual
+Adam loop with temperature annealing descends the softmax-relaxed
+max-link-utilization over a batch of demand scenarios, and the TE service
+reports proposed integer weight changes scored under exact hard-SPF ECMP
+routing — supervised by the solver fault domain, surfaced via ctrl
+`runTeOptimize` / `breeze decision te-optimize`.
+"""
+
+from openr_tpu.te.objective import (
+    hard_distances,
+    hard_max_util,
+    hard_utilization,
+    soft_mlu,
+    soft_utilization,
+    softmin_distances,
+    te_edge_arrays,
+)
+from openr_tpu.te.optimizer import TeOptConfig, TeOptResult, optimize_weights
+from openr_tpu.te.scenarios import (
+    build_demand_scenarios,
+    congested_clos_fixture,
+    uniform_demand_spec,
+)
+from openr_tpu.te.service import TeService
+
+__all__ = [
+    "TeOptConfig",
+    "TeOptResult",
+    "TeService",
+    "build_demand_scenarios",
+    "congested_clos_fixture",
+    "hard_distances",
+    "hard_max_util",
+    "hard_utilization",
+    "optimize_weights",
+    "soft_mlu",
+    "soft_utilization",
+    "softmin_distances",
+    "te_edge_arrays",
+    "uniform_demand_spec",
+]

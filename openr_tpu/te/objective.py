@@ -1,0 +1,245 @@
+"""Differentiable routing core: softmin-relaxed SPF over the edge list.
+
+The solver stack computes hard shortest paths as a min-plus fixpoint
+(ops/spf.py). This module relaxes the same recursion with a temperature
+parameter so the whole routing function becomes differentiable in the edge
+weights — the gradient-descent traffic-engineering formulation of "Fast
+Traffic Engineering by Gradient Descent with Learned Differentiable
+Routing" (PAPERS.md, arXiv:2209.10380), grafted onto this repo's
+compiled-graph arrays instead of a learned GNN:
+
+  - **softmin distances** (`softmin_distances`): replace the inner `min`
+    of the Bellman-Ford recursion D[v, t] <- min(D[v, t], min over edges
+    (v->u): w + D[u, t]) with softmin_tau(x) = -tau * log(sum exp(-x /
+    tau)) across v's out-edges (the incumbent folds in with a hard min —
+    see `_softmin_fixpoint_core`). As tau -> 0 this converges to the hard
+    SPF distances (the annealing
+    differential suite in tests/test_te_objective.py pins this against the
+    solver/cpu.py oracle); at tau > 0 every candidate path contributes,
+    which is exactly what gives the objective a nonzero gradient through
+    alternative paths a hard argmin would ignore.
+  - **soft traffic splitting** (`soft_utilization`): at each node, traffic
+    toward destination t splits over out-edges by a softmax of the negated
+    triangle gap (w(u,v) + D[v, t] - D[u, t]) / tau — the relaxation of the
+    ECMP first-hop DAG membership test (`ops/spf.py:_ecmp_dag`). Flows
+    propagate for a fixed number of rounds (paths are <= n-1 hops), giving
+    per-link utilizations against per-edge capacities.
+  - **soft max-link-utilization**: tau_obj * logsumexp(util / tau_obj), the
+    softmax relaxation of the TE objective max_e util[e].
+
+The hard counterparts (`hard_distances`, `hard_utilization`,
+`hard_max_util`) evaluate candidate integer weight vectors under exact SPF
++ fractional ECMP splitting — the acceptance metric the optimizer's
+rounded iterates are scored with. They run host-side in numpy and are
+never traced.
+
+Relaxation rounds are a static argument (scan of fixed length): reverse-
+mode autodiff cannot differentiate through `lax.while_loop`, so unlike the
+hard solver the soft fixpoint runs a bounded unroll instead of iterating
+to convergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.ops.graph import INF, CompiledGraph
+
+# float-domain "unreachable": softmin arithmetic needs a finite sentinel
+# (exp(-INF/tau) underflows fine, but INF - INF poisons gradients)
+F_INF = 1.0e9
+
+
+def te_edge_arrays(graph: CompiledGraph):
+    """(src, dst, w0, up) real-edge arrays for the TE relaxation.
+
+    Down links (weight INF in the compiled arrays) stay in the edge list
+    with up=False so the optimizer's weight vector keeps the compiled
+    graph's edge positions — proposed changes map back to Link objects via
+    CompiledGraph.link_edges without index translation."""
+    e = graph.e
+    src = graph.src[:e].astype(np.int32)
+    dst = graph.dst[:e].astype(np.int32)
+    up = graph.w[:e] < INF
+    w0 = np.where(up, graph.w[:e], 1).astype(np.float32)
+    return src, dst, w0, up
+
+
+def _segment_softmin(x, seg, n, tau):
+    """Softmin over segments of x's leading axis (empty segments -> F_INF).
+
+    Stabilized by the segment min: softmin = m - tau * log(sum exp(-(x -
+    m[seg]) / tau)); entries at F_INF contribute exp(0)=1 only when the
+    whole segment is unreachable, in which case the result clips back to
+    F_INF."""
+    x = jnp.minimum(x, F_INF)
+    m = jnp.minimum(jax.ops.segment_min(x, seg, num_segments=n), F_INF)
+    z = jnp.exp(-(x - m[seg]) / tau)
+    s = jax.ops.segment_sum(z, seg, num_segments=n)
+    out = m - tau * jnp.log(jnp.maximum(s, 1e-30))
+    return jnp.where(s > 0, jnp.minimum(out, F_INF), F_INF)
+
+
+def _softmin_fixpoint_core(w, src_e, dst_e, up, tau, n, rounds):
+    """Softmin distance-to-destination matrix D [N, N]: D[v, t] is the
+    relaxed distance from v to t after `rounds` relaxations.
+
+    Edge e = (src_e[e] -> dst_e[e]) relaxes its source row: candidates for
+    D[u, t] are w[e] + D[dst_e[e], t] over u's out-edges, softmin-combined
+    ACROSS EDGES only — the incumbent is folded in with a hard `minimum`.
+    Softmin against the incumbent would re-count the same paths every
+    round (the incumbent already is last round's softmin of them),
+    accumulating an O(rounds * tau * log 2) undershoot; the hard fold
+    keeps the per-entry error at O(hops * tau * log degree) while
+    gradients still flow through whichever side wins (and through every
+    edge of the segment softmin, which is where multi-path gradient
+    signal comes from). Down edges are pinned to F_INF (they never relax,
+    matching the hard solver's INF-weight convention)."""
+    we = jnp.where(up, w, F_INF)
+    d0 = jnp.full((n, n), F_INF, dtype=jnp.float32)
+    d0 = d0.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+
+    def body(d, _):
+        cand = jnp.minimum(we[:, None] + d[dst_e], F_INF)  # [E, N]
+        relaxed = _segment_softmin(cand, src_e, n, tau)
+        new_d = jnp.minimum(d, relaxed)
+        new_d = new_d.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+        return new_d, None
+
+    d, _ = jax.lax.scan(body, d0, None, length=rounds)
+    return d
+
+
+softmin_distances = jax.jit(
+    _softmin_fixpoint_core, static_argnames=("n", "rounds")
+)
+
+
+def _soft_utilization_core(
+    w, demands, caps, src_e, dst_e, up, tau, n, rounds
+):
+    """Per-link utilization [E] of one demand matrix under soft routing.
+
+    demands [N, N]: row = origin node, column = destination node (diagonal
+    ignored). Splitting gates are the softmax relaxation of the ECMP
+    triangle condition; flows propagate for `rounds` hops and are absorbed
+    at their destination (a destination node forwards nothing toward
+    itself). caps [E] are per-directed-edge capacities."""
+    d = _softmin_fixpoint_core(w, src_e, dst_e, up, tau, n, rounds)
+    we = jnp.where(up, w, F_INF)
+    # triangle gap of edge e toward each destination; >= 0 near the
+    # shortest DAG, large on detours — the softmax temperature decides how
+    # much traffic detours carry
+    gap = we[:, None] + d[dst_e] - d[src_e]  # [E, N]
+    node_t = jnp.arange(n, dtype=jnp.int32)
+    score = jnp.exp(-jnp.maximum(gap, 0.0) / tau)
+    score = score * up[:, None]
+    score = score * (src_e[:, None] != node_t[None, :])  # absorb at dest
+    score = jnp.where(d[dst_e] >= F_INF / 2, 0.0, score)  # dead ends
+    denom = jax.ops.segment_sum(score, src_e, num_segments=n)  # [N, N]
+    # double-where: the masked branch must be NaN-free in the BACKWARD
+    # pass too (reverse-mode differentiates both branches; a zero-denom
+    # division poisons the weight gradient with NaN even though the
+    # forward value is discarded)
+    safe_denom = jnp.where(denom[src_e] > 1e-20, denom[src_e], 1.0)
+    p = jnp.where(denom[src_e] > 1e-20, score / safe_denom, 0.0)
+
+    x0 = demands * (1.0 - jnp.eye(n, dtype=demands.dtype))
+    flow0 = jnp.zeros((src_e.shape[0], n), dtype=jnp.float32)
+
+    def body(carry, _):
+        x, flow = carry
+        ef = p * x[src_e]  # [E, N] flow pushed over each edge this hop
+        new_x = jax.ops.segment_sum(ef, dst_e, num_segments=n)
+        return (new_x, flow + ef), None
+
+    (_, flow), _ = jax.lax.scan(body, (x0, flow0), None, length=rounds)
+    util = flow.sum(axis=1) / jnp.maximum(caps, 1e-9)
+    return util
+
+
+soft_utilization = jax.jit(
+    _soft_utilization_core, static_argnames=("n", "rounds")
+)
+
+
+def _soft_mlu_core(
+    w, demands, caps, src_e, dst_e, up, tau, tau_obj, n, rounds
+):
+    """Softmax-relaxed max link utilization of one demand scenario."""
+    util = _soft_utilization_core(
+        w, demands, caps, src_e, dst_e, up, tau, n, rounds
+    )
+    return tau_obj * jax.scipy.special.logsumexp(util / tau_obj)
+
+
+soft_mlu = jax.jit(_soft_mlu_core, static_argnames=("n", "rounds"))
+
+
+# ---------------------------------------------------------------------------
+# hard counterparts (numpy, host-side): the acceptance metric the rounded
+# candidate weights are scored with — exact SPF + fractional ECMP splits
+# ---------------------------------------------------------------------------
+
+
+def hard_distances(w, src_e, dst_e, up, n) -> np.ndarray:
+    """Integer distance-to-destination matrix D [N, N] by Bellman-Ford.
+
+    Matches the hard SPF semantics the solvers share: down edges never
+    relax, unreachable stays at INF. (No overload/transit pruning: the TE
+    service excludes overloaded nodes' transit by pinning their out-edge
+    weights, same as the compiled-graph convention.)"""
+    big = np.int64(INF)
+    we = np.where(up, w.astype(np.int64), big)
+    d = np.full((n, n), big, dtype=np.int64)
+    np.fill_diagonal(d, 0)
+    for _ in range(n):
+        cand = np.minimum(we[:, None] + d[dst_e], big)  # [E, N]
+        upd = np.full((n, n), big, dtype=np.int64)
+        np.minimum.at(upd, src_e, cand)
+        new_d = np.minimum(d, upd)
+        if np.array_equal(new_d, d):
+            break
+        d = new_d
+    return d
+
+
+def hard_utilization(w, demands, caps, src_e, dst_e, up, n) -> np.ndarray:
+    """Per-link utilization [E] under exact SPF + fractional ECMP.
+
+    At every node, traffic toward t splits equally over the out-edges on
+    the shortest-path DAG (the triangle condition of ops/spf.py:_ecmp_dag),
+    the idealized ECMP model TE optimizes for."""
+    d = hard_distances(w, src_e, dst_e, up, n)
+    big = np.int64(INF)
+    we = np.where(up, w.astype(np.int64), big)
+    node_t = np.arange(n)
+    on_dag = (
+        (we[:, None] + d[dst_e] == d[src_e])
+        & (d[src_e] < big)
+        & up[:, None]
+        & (src_e[:, None] != node_t[None, :])
+    )
+    deg = np.zeros((n, n), dtype=np.int64)
+    np.add.at(deg, src_e, on_dag.astype(np.int64))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = np.where(deg[src_e] > 0, on_dag / np.maximum(deg[src_e], 1), 0.0)
+
+    x = demands * (1.0 - np.eye(n))
+    flow = np.zeros((len(src_e), n), dtype=np.float64)
+    for _ in range(n):
+        ef = p * x[src_e]
+        if not ef.any():
+            break
+        flow += ef
+        x = np.zeros((n, n), dtype=np.float64)
+        np.add.at(x, dst_e, ef)
+    return flow.sum(axis=1) / np.maximum(caps, 1e-9)
+
+
+def hard_max_util(w, demands, caps, src_e, dst_e, up, n) -> float:
+    """Max link utilization of one demand matrix under hard SPF routing."""
+    util = hard_utilization(w, demands, caps, src_e, dst_e, up, n)
+    return float(util.max()) if len(util) else 0.0

@@ -1,0 +1,259 @@
+"""Gradient-descent TE loop: manual Adam over link weights, annealed.
+
+One jitted `lax.scan` runs the whole optimization — per step: anneal the
+softmin temperature toward hard SPF, differentiate the mean soft
+max-link-utilization over the demand-scenario batch (`jax.value_and_grad`
+of the objective in te/objective.py), apply a hand-rolled Adam update (no
+optax in the image; the four-line recurrence is not worth a dependency),
+and project back into the bounded weight box. The scan emits the full
+weight trajectory so the host can score every *rounded integer* iterate
+under exact hard-SPF routing and keep the best one — gradient descent
+explores in the relaxation, but the weights a TE service proposes must win
+under the routing the network actually runs.
+
+Scenario batching rides the existing source-batch conventions: the demand
+tensor is [B, N, N] with a scenario validity mask (padding scenarios are
+zero-demand and masked out of the objective), and with a solver mesh the
+batch axis is sharded over the mesh's 'batch' axis exactly like SPF source
+batches (openr_tpu/parallel/mesh.py) — scenario sweeps run data-parallel
+with the topology arrays replicated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.te.objective import (
+    _soft_utilization_core,
+    hard_max_util,
+)
+
+
+@dataclass(frozen=True)
+class TeOptConfig:
+    """Knobs of the gradient-descent TE loop (docs/TrafficEngineering.md)."""
+
+    steps: int = 80  # Adam steps
+    lr: float = 0.4
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    # softmin/softmax temperature annealing: geometric tau0 -> tau_min
+    # across the step budget; small tau -> the relaxation approaches the
+    # hard SPF objective it is scored under
+    tau0: float = 2.0
+    tau_min: float = 0.05
+    # smooth-max temperature of the max-link-utilization objective
+    tau_obj: float = 0.25
+    # bounded-weight projection box (integer metrics after rounding)
+    w_min: float = 1.0
+    w_max: float = 64.0
+    # soft relaxation rounds; None -> n (graph node count)
+    rounds: Optional[int] = None
+
+
+@dataclass
+class TeOptResult:
+    """Outcome of one optimization run, hard-scored."""
+
+    w0: np.ndarray  # initial float weights [E]
+    w_best: np.ndarray  # best rounded integer weights [E]
+    best_step: int  # scan step the winner came from (-1 = initial)
+    initial_max_util: float  # worst-scenario hard MLU at w0
+    best_max_util: float  # worst-scenario hard MLU at w_best
+    losses: np.ndarray  # soft objective per step [steps]
+    steps: int
+
+
+def _loss_core(
+    w, demands, scen_mask, caps, src_e, dst_e, up, tau, tau_obj, n, rounds
+):
+    """Scenario-averaged soft max-link-utilization (the objective).
+
+    demands [B, N, N]; scen_mask [B] zeroes padded scenarios out of the
+    mean (padding exists so the batch axis divides a mesh's batch size)."""
+    utils = jax.vmap(
+        lambda dm: _soft_utilization_core(
+            w, dm, caps, src_e, dst_e, up, tau, n, rounds
+        )
+    )(demands)  # [B, E]
+    mlu = tau_obj * jax.scipy.special.logsumexp(utils / tau_obj, axis=1)
+    return jnp.sum(mlu * scen_mask) / jnp.maximum(jnp.sum(scen_mask), 1.0)
+
+
+def _adam_scan_core(
+    w0,
+    demands,
+    scen_mask,
+    caps,
+    src_e,
+    dst_e,
+    up,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    tau0,
+    tau_min,
+    tau_obj,
+    w_min,
+    w_max,
+    n,
+    rounds,
+    steps,
+):
+    """(final w, weight trajectory [steps, E], losses [steps])."""
+    grad_fn = jax.value_and_grad(_loss_core)
+    m0 = jnp.zeros_like(w0)
+    v0 = jnp.zeros_like(w0)
+
+    def step(carry, i):
+        w, m, v = carry
+        frac = i.astype(jnp.float32) / jnp.maximum(steps - 1, 1)
+        tau = tau0 * (tau_min / tau0) ** frac
+        loss, g = grad_fn(
+            w, demands, scen_mask, caps, src_e, dst_e, up, tau, tau_obj,
+            n, rounds,
+        )
+        g = jnp.where(up, g, 0.0)  # down links are not optimizable
+        m = beta1 * m + (1.0 - beta1) * g
+        v = beta2 * v + (1.0 - beta2) * g * g
+        mh = m / (1.0 - beta1 ** (i.astype(jnp.float32) + 1.0))
+        vh = v / (1.0 - beta2 ** (i.astype(jnp.float32) + 1.0))
+        w = w - lr * mh / (jnp.sqrt(vh) + eps)
+        w = jnp.clip(w, w_min, w_max)  # bounded projection
+        return (w, m, v), (w, loss)
+
+    (w_final, _, _), (w_hist, losses) = jax.lax.scan(
+        step, (w0, m0, v0), jnp.arange(steps, dtype=jnp.int32)
+    )
+    return w_final, w_hist, losses
+
+
+_adam_solver = jax.jit(
+    _adam_scan_core, static_argnames=("n", "rounds", "steps")
+)
+
+
+def _shard_scenarios(demands, scen_mask, mesh):
+    """Pad the scenario axis to the mesh batch size and commit the demand
+    tensor row-sharded over 'batch' (topology arrays stay replicated by
+    default) — the SPF source-batch sharding scheme applied to scenarios."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    b = mesh.shape["batch"]
+    pad = (-demands.shape[0]) % b
+    if pad:
+        demands = np.concatenate(
+            [demands, np.zeros((pad,) + demands.shape[1:], demands.dtype)]
+        )
+        scen_mask = np.concatenate(
+            [scen_mask, np.zeros(pad, scen_mask.dtype)]
+        )
+    demands = jax.device_put(
+        jnp.asarray(demands), NamedSharding(mesh, P("batch", None, None))
+    )
+    scen_mask = jax.device_put(
+        jnp.asarray(scen_mask), NamedSharding(mesh, P("batch"))
+    )
+    return demands, scen_mask
+
+
+def optimize_weights(
+    src_e: np.ndarray,
+    dst_e: np.ndarray,
+    up: np.ndarray,
+    w0: np.ndarray,  # float initial weights [E]
+    demands: np.ndarray,  # [B, N, N] candidate demand scenarios
+    caps: np.ndarray,  # [E] per-directed-edge capacities
+    n: int,
+    config: Optional[TeOptConfig] = None,
+    mesh=None,
+) -> TeOptResult:
+    """Run the annealed GD loop and hard-score the rounded iterates.
+
+    The winner is the rounded integer weight vector minimizing the WORST
+    scenario's hard max link utilization; the initial weights are scored
+    too, so a run that finds nothing better reports itself unimproved
+    instead of proposing noise."""
+    cfg = config or TeOptConfig()
+    rounds = cfg.rounds if cfg.rounds is not None else int(n)
+    rounds = max(2, min(int(rounds), 128))
+
+    b = demands.shape[0]
+    scen_mask = np.ones(b, dtype=np.float32)
+    dem = demands.astype(np.float32)
+    if mesh is not None:
+        dem, scen_mask = _shard_scenarios(dem, scen_mask, mesh)
+
+    _, w_hist, losses = _adam_solver(
+        jnp.asarray(w0, dtype=jnp.float32),
+        jnp.asarray(dem),
+        jnp.asarray(scen_mask),
+        jnp.asarray(caps, dtype=jnp.float32),
+        jnp.asarray(src_e),
+        jnp.asarray(dst_e),
+        jnp.asarray(up),
+        cfg.lr,
+        cfg.beta1,
+        cfg.beta2,
+        cfg.eps,
+        cfg.tau0,
+        cfg.tau_min,
+        cfg.tau_obj,
+        cfg.w_min,
+        cfg.w_max,
+        n=int(n),
+        rounds=rounds,
+        steps=int(cfg.steps),
+    )
+    w_hist = np.asarray(w_hist)
+    losses = np.asarray(losses)
+
+    def worst_hard(w_int: np.ndarray) -> float:
+        return max(
+            hard_max_util(w_int, demands[k], caps, src_e, dst_e, up, n)
+            for k in range(b)
+        )
+
+    w0_int = np.clip(np.rint(w0), cfg.w_min, cfg.w_max).astype(np.int64)
+    best_w, best_step = w0_int, -1
+    best_util = initial_util = worst_hard(w0_int)
+    seen = {w0_int.tobytes()}
+    for i in range(w_hist.shape[0]):
+        w_int = np.clip(np.rint(w_hist[i]), cfg.w_min, cfg.w_max).astype(
+            np.int64
+        )
+        key = w_int.tobytes()
+        if key in seen:
+            continue  # rounded trajectory revisits few distinct vectors
+        seen.add(key)
+        util = worst_hard(w_int)
+        if util < best_util:
+            best_util, best_w, best_step = util, w_int, i
+
+    if best_step >= 0:
+        # minimal-change prune: GD wanders many weights on its way to the
+        # optimum; revert every changed edge that does not pay for itself
+        # so operators see the smallest equivalent proposal
+        best_w = best_w.copy()
+        for pos in np.flatnonzero(best_w != w0_int):
+            trial = best_w.copy()
+            trial[pos] = w0_int[pos]
+            if worst_hard(trial) <= best_util:
+                best_w = trial
+
+    return TeOptResult(
+        w0=np.asarray(w0),
+        w_best=best_w,
+        best_step=best_step,
+        initial_max_util=initial_util,
+        best_max_util=best_util,
+        losses=losses,
+        steps=int(cfg.steps),
+    )

@@ -211,3 +211,4 @@ def injected(injector: Optional[FaultInjector] = None):
 #   kvstore.full_sync     3-way full-sync dump RPC, ctx=peer name
 #   spark.packet_send     outbound datagram seam, ctx=iface (spark/spark.py)
 #   spark.packet_recv     inbound datagram seam, ctx=ReceivedPacket
+#   te.optimize           TE optimization device dispatch (te/service.py)

@@ -180,6 +180,61 @@ def test_trace_safety_reaches_delta_extraction_functions():
     assert {"_bf_relax", "_bf_allow"} <= traced_names
 
 
+def test_trace_safety_reaches_te_grad_functions():
+    """Regression (ISSUE 7): the differentiable-TE core must sit inside
+    the rule's traced set. The softmin fixpoint and utilization kernels
+    are `jax.jit(fn, ...)` factory seeds; the optimizer's objective is
+    reachable ONLY through `jax.value_and_grad(_loss_core)` — the
+    grad-entry extension this test pins (before it, a host sync added to
+    the objective would have sailed past --strict)."""
+    import ast
+
+    from openr_tpu.analysis.trace_safety import _traced_functions
+
+    tree = ast.parse((PKG / "te" / "objective.py").read_text())
+    traced, direct = _traced_functions(tree)
+    direct_names = {fn.name for fn in direct}
+    assert {
+        "_softmin_fixpoint_core",
+        "_soft_utilization_core",
+        "_soft_mlu_core",
+    } <= direct_names
+    # the hard numpy counterparts run host-side and must NOT be traced
+    # (np.* calls inside them would otherwise be host-sync findings)
+    traced_names = {fn.name for fn in traced}
+    assert not {
+        "hard_distances", "hard_utilization", "hard_max_util"
+    } & traced_names
+
+    tree = ast.parse((PKG / "te" / "optimizer.py").read_text())
+    traced, direct = _traced_functions(tree)
+    assert "_loss_core" in {fn.name for fn in direct}  # grad seed
+    assert "_adam_scan_core" in {fn.name for fn in direct}  # jit factory
+    assert "step" in {fn.name for fn in traced}  # nested scan body
+
+
+def test_trace_safety_flags_host_sync_under_grad():
+    """A value_and_grad-reachable function with a numpy host sync must be
+    a finding — the seam the te/ traced-set extension exists to close."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def loss(w):\n"
+        "    return jnp.sum(np.square(w))\n"
+        "grad_fn = jax.value_and_grad(loss)\n"
+    )
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "grad_sync.py"
+        path.write_text(src)
+        found, _ = _findings([path], rule="trace-safety")
+    assert len(found) == 1
+    assert found[0].check == "host-sync"
+
+
 # ---------------------------------------------------------------------------
 # thread-ownership
 # ---------------------------------------------------------------------------

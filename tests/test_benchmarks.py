@@ -108,9 +108,10 @@ def test_incremental_bench(capsys, monkeypatch):
 def test_bench_py_smoke(capsys, monkeypatch):
     """`python bench.py` end-to-end under BENCH_SMOKE=1: tiny topology,
     reps 1/2 — bench bitrot fails tier-1 instead of zeroing BENCH rounds.
-    Every stdout line must be parseable JSON: the SPF/s headline plus the
+    Every stdout line must be parseable JSON: the SPF/s headline, the
     p95 hello-to-programmed-route convergence line from the emulator flap
-    run (the ROADMAP 'second bench metric line')."""
+    run (the ROADMAP 'second bench metric line'), and the what-if TE
+    optimization line (ISSUE 7 'third metric line')."""
     import bench
 
     monkeypatch.setenv("BENCH_SMOKE", "1")
@@ -118,7 +119,7 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 2, "bench.py must print SPF + convergence JSON lines"
+    assert len(out) >= 3, "bench.py must print SPF+convergence+TE JSON lines"
     results = [json.loads(line) for line in out]
     for result in results:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
@@ -129,6 +130,8 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert results[0]["metric"].endswith("spf_recomputes_per_sec")
     assert results[1]["metric"] == "convergence_e2e_p95_ms"
     assert results[1]["spans"] > 0
+    assert results[2]["metric"] == "te_optimize_ms"
+    assert results[2]["initial_max_util"] >= results[2]["optimized_max_util"]
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):

@@ -51,8 +51,11 @@ def ctrl_endpoint():
         monitor.register_module("decision", _Hists())
 
         class _FakeDecision:
-            """Solver-health surface only: `decision adj` must still error
-            (no get_adjacency_databases), which test_decision_adj pins."""
+            """Solver-health + TE surfaces only: `decision adj` must still
+            error (no get_adjacency_databases), which test_decision_adj
+            pins."""
+
+            te_params = {}  # last runTeOptimize params, for assertions
 
             @staticmethod
             def get_solver_health():
@@ -62,6 +65,47 @@ def ctrl_endpoint():
                     "fallback_active": 1,
                     "last_fault_kind": "device_loss",
                 }
+
+            @classmethod
+            def run_te_optimize(cls, params):
+                cls.te_params = dict(params)
+                return {
+                    "node": "cli-node",
+                    "area": "0",
+                    "nodes": 7,
+                    "links": 18,
+                    "scenarios": params.get("scenarios", 1),
+                    "steps": params.get("steps", 80),
+                    "best_step": 12,
+                    "backend": "primary",
+                    "degraded": False,
+                    "improved": True,
+                    "initial_max_util": 6.0,
+                    "optimized_max_util": 2.0,
+                    "max_util_delta": -4.0,
+                    "weight_changes": [
+                        {
+                            "node": "l0_0",
+                            "neighbor": "l1_0",
+                            "iface": "if-l0_0-l1_0",
+                            "metric_before": 1,
+                            "metric_after": 3,
+                        }
+                    ],
+                    "top_links": {
+                        "initial": [
+                            {"src": "l0_0", "dst": "l1_0", "util": 6.0}
+                        ],
+                        "optimized": [
+                            {"src": "l0_0", "dst": "l1_0", "util": 2.0}
+                        ],
+                    },
+                    "loss_first": 5.1,
+                    "loss_last": 2.2,
+                    "solve_ms": 41.5,
+                }
+
+        state["fake_decision"] = _FakeDecision
 
         server = CtrlServer(
             "cli-node",
@@ -147,6 +191,40 @@ def test_decision_solver_health(ctrl_endpoint, capsys):
     out = capsys.readouterr().out
     assert "solver: DEGRADED (breaker: open)" in out
     assert "device_loss" in out
+
+
+def test_decision_te_optimize(ctrl_endpoint, capsys, tmp_path):
+    host, port = ctrl_endpoint
+    spec = tmp_path / "demands.json"
+    spec.write_text(
+        '{"demands": [["l0_0", "l1_0", 6.0]], "scenarios": 2}'
+    )
+    assert breeze(
+        host, port, "decision", "te-optimize",
+        "--demands", str(spec), "--steps", "17",
+    ) == 0
+    out = capsys.readouterr().out
+    assert "max link util 6.000 -> 2.000" in out
+    # the proposed-change table maps to `breeze lm set-link-metric` inputs
+    for token in ("l0_0", "l1_0", "if-l0_0-l1_0", "Proposed"):
+        assert token in out
+    assert "hottest links" in out
+
+
+def test_decision_te_optimize_json_and_param_passthrough(
+    ctrl_endpoint, capsys
+):
+    import json as json_mod
+
+    host, port = ctrl_endpoint
+    assert breeze(
+        host, port, "decision", "te-optimize", "--steps", "9",
+        "--scenarios", "3", "--json",
+    ) == 0
+    report = json_mod.loads(capsys.readouterr().out)
+    assert report["steps"] == 9
+    assert report["scenarios"] == 3
+    assert report["weight_changes"][0]["metric_after"] == 3
 
 
 def test_monitor_histograms_reset(ctrl_endpoint, capsys):

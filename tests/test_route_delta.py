@@ -476,6 +476,67 @@ class TestSupervisorDeltaFaultDomain:
         assert "decision.spf.delta_audit_mismatches" not in sup.counters
 
 
+class TestAdjacencyToMeQualification:
+    """Unit suite for the narrowed direct-neighbor refusal (ISSUE 7): a
+    neighbor's update forces the full path only when its adjacencies TO ME
+    actually changed — far-side-only updates stay delta-eligible."""
+
+    @staticmethod
+    def db(node, adjs):
+        from openr_tpu.types import AdjacencyDatabase
+
+        return AdjacencyDatabase(this_node_name=node, adjacencies=adjs)
+
+    @staticmethod
+    def adj(other, **kw):
+        from openr_tpu.types import Adjacency
+
+        return Adjacency(
+            other_node_name=other, if_name=f"if-b-{other}", **kw
+        )
+
+    def check(self, prior_adjs, new_adjs):
+        from openr_tpu.decision.decision import _adjacencies_to_me_changed
+
+        prior = self.db("b", prior_adjs) if prior_adjs is not None else None
+        return _adjacencies_to_me_changed(prior, self.db("b", new_adjs), "a")
+
+    def test_far_side_only_change_does_not_force_full(self):
+        before = [self.adj("a", metric=1), self.adj("c", metric=1)]
+        after = [self.adj("a", metric=1), self.adj("c", metric=7)]
+        assert self.check(before, after) is False
+
+    def test_metric_to_me_forces_full(self):
+        before = [self.adj("a", metric=1), self.adj("c", metric=1)]
+        after = [self.adj("a", metric=4), self.adj("c", metric=1)]
+        assert self.check(before, after) is True
+
+    def test_overload_and_nexthop_to_me_force_full(self):
+        before = [self.adj("a", metric=1)]
+        assert self.check(
+            before, [self.adj("a", metric=1, is_overloaded=True)]
+        ) is True
+        assert self.check(
+            before, [self.adj("a", metric=1, nexthop_v6="fe80::b")]
+        ) is True
+
+    def test_adjacency_to_me_added_or_removed_forces_full(self):
+        assert self.check([self.adj("c")], [self.adj("c"), self.adj("a")])
+        assert self.check([self.adj("c"), self.adj("a")], [self.adj("c")])
+
+    def test_first_advertisement_with_adj_to_me_is_structural(self):
+        assert self.check(None, [self.adj("a")]) is True
+
+    def test_first_advertisement_without_adj_to_me_is_not(self):
+        assert self.check(None, [self.adj("c")]) is False
+
+    def test_rtt_timestamp_churn_is_ignored(self):
+        # fields the route build never consumes must not poison the delta
+        before = [self.adj("a", rtt=100, timestamp=1), self.adj("c")]
+        after = [self.adj("a", rtt=900, timestamp=2), self.adj("c")]
+        assert self.check(before, after) is False
+
+
 class TestDecisionDeltaPath:
     """End to end through Decision: a qualifying remote flap must be served
     by the delta route build and emit the same update the full path would."""
@@ -550,6 +611,101 @@ class TestDecisionDeltaPath:
                 "a", {"0": ls}, decision.prefix_state
             )
             assert_route_db_equal(oracle, decision.route_db)
+            decision.stop()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(body(), 30))
+        finally:
+            loop.close()
+
+    def test_neighbor_far_side_change_stays_on_delta_path(self):
+        """The narrowed refusal (ISSUE 7 satellite): my direct neighbor b
+        re-advertises, but only its FAR-side link b->c changed — the
+        adjacency to me is byte-identical. Decision used to force a full
+        rebuild for any update containing an adjacency to me; it must now
+        stay on the delta path and still match the from-scratch oracle.
+        A follow-up update that touches b's adjacency TO me must still
+        take the full path."""
+        import asyncio
+
+        from openr_tpu.decision import Decision, DecisionConfig
+        from openr_tpu.messaging import ReplicateQueue, RQueue, RWQueue
+        from openr_tpu.types import Publication, Value, adj_key, prefix_key
+        from openr_tpu.utils import serializer
+
+        def bump(dbs, node, metrics, version):
+            dbs[node] = dataclasses.replace(
+                dbs[node],
+                adjacencies=[
+                    dataclasses.replace(
+                        adj, metric=metrics.get(adj.other_node_name,
+                                                adj.metric)
+                    )
+                    for adj in dbs[node].adjacencies
+                ],
+            )
+            pub = Publication(area="0")
+            pub.key_vals[adj_key(node)] = Value(
+                version, node, serializer.dumps(dbs[node])
+            )
+            return pub
+
+        async def body():
+            kv_q = RWQueue()
+            route_q = ReplicateQueue()
+            decision = Decision(
+                DecisionConfig(
+                    my_node_name="a",
+                    solver_backend="tpu",
+                    debounce_min=0.005,
+                    debounce_max=0.02,
+                ),
+                RQueue(kv_q),
+                route_q,
+            )
+            reader = route_q.get_reader()
+            decision.start()
+            edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+            dbs = build_adj_dbs(edges)
+            pub = Publication(area="0")
+            for db in dbs.values():
+                pub.key_vals[adj_key(db.this_node_name)] = Value(
+                    1, db.this_node_name, serializer.dumps(db)
+                )
+            pub.key_vals[prefix_key("d")] = Value(
+                1, "d", serializer.dumps(
+                    PrefixDatabase("d", [PrefixEntry(IpPrefix(PFXS[0]))])
+                )
+            )
+            kv_q.push(pub)
+            await asyncio.wait_for(reader.get(), 10)
+
+            def oracle():
+                ls = LinkState("0")
+                for db in dbs.values():
+                    ls.update_adjacency_database(db)
+                return SpfSolver("a").build_route_db(
+                    "a", {"0": ls}, decision.prefix_state
+                )
+
+            # b is MY neighbor; only its far-side link b->c changes
+            kv_q.push(bump(dbs, "b", {"c": 5}, 2))
+            delta = await asyncio.wait_for(reader.get(), 10)
+            assert decision.counters["decision.route_build_delta_runs"] == 1
+            routes = {e.prefix: e for e in delta.unicast_routes_to_update}
+            assert {nh.metric for nh in routes[IpPrefix(PFXS[0])].nexthops} \
+                == {7}
+            assert_route_db_equal(oracle(), decision.route_db)
+
+            # the same batch shape, but b also touches its adjacency TO
+            # me: the narrowed qualification must still refuse the delta
+            # (route-affecting far-side change rides along so an update
+            # is emitted either way)
+            kv_q.push(bump(dbs, "b", {"a": 3, "c": 2}, 3))
+            await asyncio.wait_for(reader.get(), 10)
+            assert decision.counters["decision.route_build_delta_runs"] == 1
+            assert_route_db_equal(oracle(), decision.route_db)
             decision.stop()
 
         loop = asyncio.new_event_loop()

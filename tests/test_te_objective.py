@@ -1,0 +1,181 @@
+"""Temperature-annealing consistency suite for the differentiable TE core.
+
+The softmin relaxation (openr_tpu/te/objective.py) is only trustworthy as
+a TE objective if it provably approaches the routing the network actually
+runs: as tau -> 0 the softmin distance matrix must converge to the hard
+SPF oracle's distances (solver/cpu.py semantics — LinkState.run_spf's
+Dijkstra — differentially, on randomized grid and Clos topologies), and
+the soft traffic splits must approach exact fractional ECMP. The hard
+numpy counterparts are pinned against the same oracle first, so the
+optimizer's acceptance metric and the relaxation are anchored to one
+ground truth.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.lsdb import LinkState
+from openr_tpu.ops.graph import INF, compile_graph
+from openr_tpu.te.objective import (
+    F_INF,
+    hard_distances,
+    hard_utilization,
+    softmin_distances,
+    soft_utilization,
+    te_edge_arrays,
+)
+from openr_tpu.topology import build_adj_dbs, fabric_edges, grid_edges
+
+
+def build_ls(edges, area="0", **kwargs):
+    ls = LinkState(area)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def randomized(edges, seed, lo=1, hi=9):
+    rng = random.Random(seed)
+    return [(a, b, rng.randint(lo, hi)) for a, b, _ in edges]
+
+
+def small_clos():
+    return fabric_edges(2, planes=2, ssw_per_plane=2, fsw_per_pod=2,
+                        rsw_per_pod=3)
+
+
+def oracle_distance_matrix(ls: LinkState, graph) -> np.ndarray:
+    """D[v, t] from the CPU oracle's Dijkstra (unreachable = INF)."""
+    d = np.full((graph.n, graph.n), np.int64(INF))
+    np.fill_diagonal(d, 0)
+    for v, name in enumerate(graph.names):
+        for dest, res in ls.get_spf_result(name).items():
+            d[v, graph.node_index[dest]] = res.metric
+    return d
+
+
+TOPOLOGIES = [
+    pytest.param(lambda s: randomized(grid_edges(4), s), id="grid4"),
+    pytest.param(lambda s: randomized(small_clos(), s), id="clos2pod"),
+]
+
+
+class TestHardOracle:
+    @pytest.mark.parametrize("topo", TOPOLOGIES)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_hard_distances_match_cpu_oracle(self, topo, seed):
+        ls = build_ls(topo(seed))
+        graph = compile_graph(ls)
+        src_e, dst_e, w0, up = te_edge_arrays(graph)
+        got = hard_distances(w0, src_e, dst_e, up, graph.n)
+        np.testing.assert_array_equal(got, oracle_distance_matrix(ls, graph))
+
+    def test_down_link_never_relaxes(self):
+        # flap a link down (overloaded adjacency -> INF weight in the
+        # compiled arrays): the hard BF must route around it like Dijkstra
+        import dataclasses
+
+        edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 5)]
+        dbs = build_adj_dbs(edges)
+        dbs["a"] = dataclasses.replace(
+            dbs["a"],
+            adjacencies=[
+                dataclasses.replace(adj, is_overloaded=True)
+                if adj.other_node_name == "b"
+                else adj
+                for adj in dbs["a"].adjacencies
+            ],
+        )
+        ls = LinkState("0")
+        for db in dbs.values():
+            ls.update_adjacency_database(db)
+        graph = compile_graph(ls)
+        src_e, dst_e, w0, up = te_edge_arrays(graph)
+        d = hard_distances(w0, src_e, dst_e, up, graph.n)
+        np.testing.assert_array_equal(
+            d, oracle_distance_matrix(ls, graph)
+        )
+        assert d[graph.node_index["a"], graph.node_index["c"]] == 5
+
+
+class TestAnnealing:
+    @pytest.mark.parametrize("topo", TOPOLOGIES)
+    @pytest.mark.parametrize("seed", [3, 7])
+    def test_softmin_converges_to_hard_spf(self, topo, seed):
+        """As tau -> 0 the softmin distances approach the oracle's, and the
+        approximation error shrinks monotonically along the anneal — the
+        property the optimizer's temperature schedule relies on."""
+        ls = build_ls(topo(seed))
+        graph = compile_graph(ls)
+        src_e, dst_e, w0, up = te_edge_arrays(graph)
+        hard = oracle_distance_matrix(ls, graph).astype(np.float64)
+        reachable = hard < INF
+
+        taus = (2.0, 0.5, 0.1, 0.02)
+        errors = []
+        for tau in taus:
+            soft = np.asarray(
+                softmin_distances(
+                    w0.astype(np.float32), src_e, dst_e, up,
+                    tau, n=graph.n, rounds=graph.n,
+                )
+            ).astype(np.float64)
+            # softmin is a lower bound on the hard min everywhere
+            assert (soft[reachable] <= hard[reachable] + 1e-3).all()
+            errors.append(float(np.abs(soft - hard)[reachable].max()))
+        assert errors == sorted(errors, reverse=True)
+        # error scale is tau * log(#near-shortest path combinations); pin
+        # the constant so a regression that breaks convergence (e.g. a
+        # wrong stabilization) cannot hide behind "still decreasing"
+        assert errors[-1] <= 10 * taus[-1], errors
+        # metrics are integers: at the end of the anneal, rounding the
+        # relaxed distances must recover the oracle's matrix EXACTLY
+        np.testing.assert_array_equal(np.rint(soft)[reachable],
+                                      hard[reachable])
+
+    @pytest.mark.parametrize("seed", [11])
+    def test_unreachable_pairs_stay_at_sentinel(self, seed):
+        # two disconnected components: cross-component softmin distances
+        # must hold at the finite sentinel at every temperature
+        edges = randomized(
+            [("a", "b", 1), ("b", "c", 1), ("x", "y", 1)], seed
+        )
+        ls = build_ls(edges)
+        graph = compile_graph(ls)
+        src_e, dst_e, w0, up = te_edge_arrays(graph)
+        ia, ix = graph.node_index["a"], graph.node_index["x"]
+        for tau in (2.0, 0.1):
+            soft = np.asarray(
+                softmin_distances(
+                    w0.astype(np.float32), src_e, dst_e, up,
+                    tau, n=graph.n, rounds=graph.n,
+                )
+            )
+            assert soft[ia, ix] >= F_INF / 2
+            assert soft[ix, ia] >= F_INF / 2
+
+    @pytest.mark.parametrize("topo", TOPOLOGIES)
+    def test_soft_utilization_converges_to_hard_ecmp(self, topo):
+        """At low temperature the soft splits reproduce exact fractional
+        ECMP link utilizations (the acceptance metric's routing model)."""
+        ls = build_ls(topo(5))
+        graph = compile_graph(ls)
+        src_e, dst_e, w0, up = te_edge_arrays(graph)
+        rng = np.random.default_rng(5)
+        demands = (
+            rng.uniform(0.0, 2.0, size=(graph.n, graph.n))
+            * (1.0 - np.eye(graph.n))
+        ).astype(np.float32)
+        caps = np.ones(graph.e, dtype=np.float32)
+        hard = hard_utilization(
+            w0, demands, caps, src_e, dst_e, up, graph.n
+        )
+        soft = np.asarray(
+            soft_utilization(
+                w0.astype(np.float32), demands, caps, src_e, dst_e, up,
+                0.01, n=graph.n, rounds=graph.n,
+            )
+        )
+        np.testing.assert_allclose(soft, hard, atol=0.02, rtol=0.02)
