@@ -1,21 +1,38 @@
 """Project-specific static analysis suite (docs/Analysis.md).
 
-Four rule families encode this repo's invariants:
+Seven rule families encode this repo's invariants, sharing two pieces of
+interprocedural infrastructure (v2.0 — "DeepFlow"): a whole-package call
+graph (analysis/callgraph.py) and a light intraprocedural alias/escape
+dataflow engine (analysis/dataflow.py).
 
   - trace-safety:    no host syncs / Python branches on traced values in
-                     jax.jit-reachable solver code
+                     jax.jit-reachable code — reachability crosses module
+                     boundaries via the call graph
   - thread-ownership: ctrl/monitor-reachable methods must not mutate
-                     @owned_by module state without a declared handover
+                     @owned_by module state without a declared handover —
+                     alias-aware (`d = self.x; d[k] = v`) and
+                     escape-aware (queue/thread handoffs)
+  - device-transfer: no unsanctioned host syncs on values flowing out of
+                     solver/jit dispatches (accounted *d2h* seams pass)
+  - recompile-risk:  static jit arguments must be bucketed/bounded, never
+                     a raw per-call len()
+  - shard-spec:      in/out sharding-spec arity matches the wrapped
+                     function; mesh axis names match the solver_mesh
+                     vocabulary
   - blocking-call:   no synchronous blocking inside event-loop bodies
-  - registry-drift:  counters/histograms, fault points and
-                     DecisionConfigSection knobs match their docs tables
+  - registry-drift:  counters/histograms, fault points, LogSample events,
+                     DecisionConfigSection knobs AND the docs/Analysis.md
+                     rule table match their code registries
 
 Run it:  python -m openr_tpu.analysis [paths] [--strict] [--json]
+         python -m openr_tpu.analysis --changed   (diff-scoped fast path)
+         python -m openr_tpu.analysis --update-baseline
 Tier-1:  tests/test_analysis.py self-runs the suite over openr_tpu/.
 """
 
 from openr_tpu.analysis.core import (  # noqa: F401
     ANALYSIS_VERSION,
+    LAST_RUN_STATS,
     AnalysisContext,
     Finding,
     RULES,
@@ -31,7 +48,10 @@ from openr_tpu.analysis.core import (  # noqa: F401
 # importing the rule modules registers them in RULES
 from openr_tpu.analysis import (  # noqa: F401  (registration side effect)
     blocking_calls,
+    device_transfer,
+    recompile_risk,
     registry_drift,
+    shard_spec,
     thread_ownership,
     trace_safety,
 )
@@ -44,8 +64,19 @@ def rule_names():
 def get_analysis_info() -> dict:
     """Metadata surfaced through utils/build_info.get_build_info and
     `breeze openr version`: deployed binaries report which invariants
-    they were linted against."""
-    return {
+    they were linted against, and — when an analysis ran in this process
+    (the tier-1 self-run, a --changed pre-commit pass) — what it cost:
+    per-rule finding counts and wall time, observable like every other
+    cost in this codebase."""
+    info = {
         "analysis_version": ANALYSIS_VERSION,
         "analysis_rules": rule_names(),
     }
+    if LAST_RUN_STATS:
+        info["analysis_wall_ms"] = LAST_RUN_STATS["wall_ms"]
+        info["analysis_files"] = LAST_RUN_STATS["files"]
+        info["analysis_rule_stats"] = {
+            name: dict(stats)
+            for name, stats in LAST_RUN_STATS["per_rule"].items()
+        }
+    return info

@@ -1,0 +1,519 @@
+"""Whole-package call graph: the shared skeleton of the deep rules.
+
+Every interprocedural rule in this suite (cross-module trace-safety
+reachability, device-transfer flow classification, recompile-risk static
+argument resolution, `--changed` dependent selection) needs the same three
+facts about the scanned file set:
+
+  1. which function definitions exist, per module (including nested and
+     method defs, with their qualified names);
+  2. how a *name used in a call* resolves to those definitions — same-module
+     simple names (the existing per-file behavior), `from X import f`
+     bindings, and `import X as y; y.f(...)` attribute chains;
+  3. which module-level names are *jit artifacts*: defs decorated with a
+     trace-entry transform, `X = jax.jit(f, ...)` bindings, factory
+     functions whose return value is a jit callable
+     (`def _sell_solver(key): return jax.jit(solve)`), and functions whose
+     return value is a device array because it flows out of one of the
+     above (`def batched_spf(...): return sell_fixpoint(...)`).
+
+Resolution is name-based and import-directed: a cross-module edge exists
+only when an import statement links the caller's name to the callee's
+module, so unrelated same-named helpers in different modules never alias
+each other (the precision lesson from the per-file rule generation).
+Everything here is an over-approximation in the direction each rule wants:
+trace-safety wants "possibly traced" (union over candidates), the transfer
+rules want "definitely a device producer" (resolution misses degrade to
+silence, not noise).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    SourceFile,
+    call_name,
+    dotted_name,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# calls whose function-valued operand traces: jit/shard_map compile their
+# operand; grad/value_and_grad/vmap trace theirs on every (re)trace
+TRACE_ENTRY_CALLS = ("jit", "shard_map", "grad", "value_and_grad", "vmap")
+# the subset that returns a *compiled callable* (a jit artifact a factory
+# can hand back to its caller)
+_JIT_WRAPPER_CALLS = ("jit", "shard_map")
+
+
+def module_name(sf: SourceFile) -> str:
+    """Dotted module name of a SourceFile: openr_tpu/ops/spf.py ->
+    openr_tpu.ops.spf; package __init__.py collapses onto the package."""
+    rel = sf.rel
+    if rel.endswith(".py"):
+        rel = rel[: -len(".py")]
+    parts = rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass
+class FunctionInfo:
+    """One function definition, located in the package."""
+
+    qname: str  # '<module>::Outer.inner' dotted nesting path
+    name: str  # simple name
+    module: str
+    sf: SourceFile
+    node: ast.AST  # the FunctionDef / AsyncFunctionDef
+    in_class: bool = False  # lexically inside a ClassDef (a method)
+    parent: Optional["FunctionInfo"] = None  # lexically enclosing function
+
+    def __hash__(self):  # identity hashing: defs are unique AST nodes
+        return id(self.node)
+
+    def __eq__(self, other):
+        return isinstance(other, FunctionInfo) and other.node is self.node
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    sf: SourceFile
+    # local binding -> (source module, source name) from `from X import a`
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # local alias -> module dotted name from `import X [as y]`
+    module_aliases: Dict[str, str] = field(default_factory=dict)
+    # simple name -> defs carrying it anywhere in the module (collisions
+    # kept: per-file trace reachability intentionally unions over them)
+    by_name: Dict[str, List[FunctionInfo]] = field(default_factory=dict)
+    # module-level (top-of-module) defs by name — the importable surface
+    top_level: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # module-level names bound to jit callables: decorated defs and
+    # `X = jax.jit(f, ...)` assignments
+    jit_bindings: Set[str] = field(default_factory=set)
+    # module-level defs that RETURN a jit callable (solver factories)
+    factories: Set[str] = field(default_factory=set)
+    # module-level defs that return a device value (flow fixpoint)
+    device_fns: Set[str] = field(default_factory=set)
+
+
+def _is_trace_entry_call(call: ast.Call, names=TRACE_ENTRY_CALLS) -> bool:
+    return call_name(call) in names
+
+
+def _jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        base = dotted_name(target) or ""
+        if base.split(".")[-1] in TRACE_ENTRY_CALLS:
+            return True
+        if isinstance(dec, ast.Call):
+            # functools.partial(jax.jit, ...) and friends
+            for arg in dec.args:
+                nm = dotted_name(arg) or ""
+                if nm.split(".")[-1] in TRACE_ENTRY_CALLS:
+                    return True
+    return False
+
+
+def returned_local_defs(fn: ast.AST) -> List[ast.AST]:
+    """Nested defs this function returns by bare name — the factory shape
+    `def factory(key): def solve(...): ...; return solve` (ops/spf.py's
+    `_sell_solver_raw`). Used to seed tracing through
+    `jax.jit(factory(...), ...)` call sites."""
+    nested = {
+        n.name: n
+        for n in ast.walk(fn)
+        if isinstance(n, _FuncDef) and n is not fn
+    }
+    out: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            target = nested.get(node.value.id)
+            if target is not None:
+                out.append(target)
+    return out
+
+
+class CallGraph:
+    """Package-wide function index + import-directed call resolution."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+        self.modules: Dict[str, ModuleInfo] = {}
+        self._fn_by_node: Dict[int, FunctionInfo] = {}
+        for sf in ctx.files:
+            self._index_module(sf)
+        self._classify_jit_artifacts()
+
+    # -- indexing --------------------------------------------------------
+
+    def _index_module(self, sf: SourceFile) -> None:
+        mod = ModuleInfo(name=module_name(sf), sf=sf)
+        self.modules[mod.name] = mod
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module and node.level == 0:
+                    for a in node.names:
+                        mod.from_imports[a.asname or a.name] = (
+                            node.module,
+                            a.name,
+                        )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    # `import a.b.c` binds `a`; `import a.b.c as x` binds
+                    # x -> a.b.c. Attribute-chain resolution re-joins the
+                    # full path either way.
+                    mod.module_aliases[alias] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+
+        def index_defs(parent: ast.AST, prefix: str, in_class: bool,
+                       enclosing: Optional[FunctionInfo]) -> None:
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, _FuncDef):
+                    qname = f"{mod.name}::{prefix}{child.name}"
+                    info = FunctionInfo(
+                        qname=qname,
+                        name=child.name,
+                        module=mod.name,
+                        sf=sf,
+                        node=child,
+                        in_class=in_class,
+                        parent=enclosing,
+                    )
+                    mod.by_name.setdefault(child.name, []).append(info)
+                    self._fn_by_node[id(child)] = info
+                    if parent is sf.tree:
+                        mod.top_level[child.name] = info
+                    index_defs(
+                        child, f"{prefix}{child.name}.", False, info
+                    )
+                elif isinstance(child, ast.ClassDef):
+                    index_defs(
+                        child, f"{prefix}{child.name}.", True, enclosing
+                    )
+                else:
+                    index_defs(child, prefix, in_class, enclosing)
+
+        index_defs(sf.tree, "", False, None)
+
+    def info(self, fn_node: ast.AST) -> Optional[FunctionInfo]:
+        return self._fn_by_node.get(id(fn_node))
+
+    # -- jit-artifact classification -------------------------------------
+
+    def _classify_jit_artifacts(self) -> None:
+        for mod in self.modules.values():
+            for name, fi in mod.top_level.items():
+                if _jit_decorated(fi.node):
+                    mod.jit_bindings.add(name)
+            for node in mod.sf.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _is_trace_entry_call(
+                        node.value, _JIT_WRAPPER_CALLS
+                    )
+                ):
+                    mod.jit_bindings.add(node.targets[0].id)
+            for name, fi in mod.top_level.items():
+                if self._returns_jit_callable(fi.node):
+                    mod.factories.add(name)
+        # device-returning functions: fixpoint over return-expression flow
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules.values():
+                for name, fi in mod.top_level.items():
+                    if name in mod.device_fns or fi.in_class:
+                        continue
+                    if self._returns_device(mod, fi.node):
+                        mod.device_fns.add(name)
+                        changed = True
+
+    def _returns_jit_callable(self, fn) -> bool:
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Call)
+                and _is_trace_entry_call(node.value, _JIT_WRAPPER_CALLS)
+            ):
+                return True
+        return False
+
+    def _returns_device(self, mod: ModuleInfo, fn) -> bool:
+        """Does some return expression flow out of a jit dispatch? Tracks
+        jit-callable locals (`fn = _sell_solver(key); return fn(...)`) and
+        device locals (`d = batched_spf(...); return d`)."""
+        jit_locals: Set[str] = set()
+        dev_locals: Set[str] = set()
+
+        def call_is_device(call: ast.Call) -> bool:
+            func = call.func
+            if isinstance(func, ast.Name):
+                if func.id in jit_locals:
+                    return True
+                kind = self.resolve_producer(mod, func.id)
+                return kind in ("jit", "device")
+            if isinstance(func, ast.Attribute):
+                chain = dotted_name(func)
+                if chain:
+                    kind = self.resolve_producer_chain(mod, chain)
+                    return kind in ("jit", "device")
+            if isinstance(func, ast.Call):
+                # factory call called immediately: _bf_vw_solver(mesh)(...)
+                inner = call_name(func)
+                if inner and self.resolve_producer(mod, inner) == "factory":
+                    return True
+            return False
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fname = (
+                    node.value.func.id
+                    if isinstance(node.value.func, ast.Name)
+                    else None
+                )
+                is_factory = (
+                    fname is not None
+                    and self.resolve_producer(mod, fname) == "factory"
+                ) or _is_trace_entry_call(node.value, _JIT_WRAPPER_CALLS)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        if is_factory:
+                            jit_locals.add(t.id)
+                        elif call_is_device(node.value):
+                            dev_locals.add(t.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Call) and call_is_device(v):
+                    return True
+                if isinstance(v, ast.Name) and v.id in dev_locals:
+                    return True
+        return False
+
+    # -- resolution ------------------------------------------------------
+
+    def _imported(
+        self, mod: ModuleInfo, local: str
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """(source module, source name) of a from-import binding, when the
+        source module is in the scanned set (re-export chains followed one
+        hop through package __init__ files)."""
+        seen = 0
+        cur: Optional[Tuple[str, str]] = mod.from_imports.get(local)
+        while cur is not None and seen < 4:
+            src_mod = self.modules.get(cur[0])
+            if src_mod is None:
+                return None
+            if cur[1] in src_mod.top_level or cur[1] in src_mod.jit_bindings:
+                return src_mod, cur[1]
+            cur = src_mod.from_imports.get(cur[1])
+            seen += 1
+        return None
+
+    def resolve_call_defs(
+        self, mod: ModuleInfo, call: ast.Call
+    ) -> List[FunctionInfo]:
+        """Candidate definitions a call site may invoke: same-module simple
+        names (union over collisions, matching the per-file rule), from-
+        import bindings, and module-alias attribute chains."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            local = mod.by_name.get(name)
+            if local:
+                return list(local)
+            imp = self._imported(mod, name)
+            if imp is not None:
+                src_mod, src_name = imp
+                target = src_mod.top_level.get(src_name)
+                return [target] if target is not None else []
+            return []
+        if isinstance(func, ast.Attribute):
+            chain = dotted_name(func)
+            if not chain or "." not in chain:
+                return []
+            head, _, attr_path = chain.partition(".")
+            if head in ("self", "cls"):
+                return []
+            base = mod.module_aliases.get(head)
+            if base is None:
+                return []
+            # re-join `import a.b.c` chains: a.b.c.f -> module a.b.c, f
+            full = chain
+            mod_path, _, fn_name = full.rpartition(".")
+            src_mod = self.modules.get(mod_path)
+            if src_mod is None and mod_path == head:
+                src_mod = self.modules.get(base)
+            if src_mod is None:
+                return []
+            target = src_mod.top_level.get(fn_name)
+            return [target] if target is not None else []
+        return []
+
+    def resolve_producer(self, mod: ModuleInfo, name: str) -> Optional[str]:
+        """'jit' | 'factory' | 'device' | None for a bare name in mod —
+        following from-imports to the defining module."""
+        if name in mod.jit_bindings:
+            return "jit"
+        if name in mod.factories:
+            return "factory"
+        if name in mod.device_fns:
+            return "device"
+        if name in mod.top_level:
+            return None  # defined here, classified as none of the above
+        imp = self._imported(mod, name)
+        if imp is not None:
+            src_mod, src_name = imp
+            if src_name in src_mod.jit_bindings:
+                return "jit"
+            if src_name in src_mod.factories:
+                return "factory"
+            if src_name in src_mod.device_fns:
+                return "device"
+        return None
+
+    def resolve_producer_chain(
+        self, mod: ModuleInfo, chain: str
+    ) -> Optional[str]:
+        """resolve_producer for dotted `alias.f` module-attribute calls."""
+        mod_path, _, fn_name = chain.rpartition(".")
+        if not mod_path:
+            return self.resolve_producer(mod, chain)
+        head = mod_path.split(".")[0]
+        if head not in mod.module_aliases:
+            return None
+        src_mod = self.modules.get(mod_path) or self.modules.get(
+            mod.module_aliases[head]
+        )
+        if src_mod is None:
+            return None
+        if fn_name in src_mod.jit_bindings:
+            return "jit"
+        if fn_name in src_mod.factories:
+            return "factory"
+        if fn_name in src_mod.device_fns:
+            return "device"
+        return None
+
+    def resolve_static_argnames(
+        self, mod: ModuleInfo, name: str
+    ) -> Optional[Tuple[FunctionInfo, Tuple, Tuple]]:
+        """(wrapped def, static_argnames, static_argnums) for a module-level
+        jit binding `X = jax.jit(core, static_argnames=(...))` or a
+        @functools.partial(jax.jit, static_argnames=...)-decorated def —
+        following from-imports. None when the name is not such a binding."""
+        target_mod = mod
+        target_name = name
+        if name not in mod.jit_bindings:
+            imp = self._imported(mod, name)
+            if imp is None:
+                return None
+            target_mod, target_name = imp
+        if target_name not in target_mod.jit_bindings:
+            return None
+        # `X = jax.jit(core, static_arg...=...)` module-level assignment
+        for node in target_mod.sf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == target_name
+                and isinstance(node.value, ast.Call)
+                and _is_trace_entry_call(node.value, _JIT_WRAPPER_CALLS)
+            ):
+                names, nums = _static_kwargs(node.value)
+                core = None
+                if node.value.args and isinstance(
+                    node.value.args[0], ast.Name
+                ):
+                    core = target_mod.top_level.get(node.value.args[0].id)
+                if core is not None:
+                    return core, names, nums
+        # decorated def: @functools.partial(jax.jit, static_argnames=...)
+        fi = target_mod.top_level.get(target_name)
+        if fi is not None:
+            for dec in fi.node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    args_all = [dotted_name(a) or "" for a in dec.args]
+                    if any(
+                        a.split(".")[-1] in _JIT_WRAPPER_CALLS
+                        for a in args_all
+                    ) or (dotted_name(dec.func) or "").split(".")[
+                        -1
+                    ] in _JIT_WRAPPER_CALLS:
+                        names, nums = _static_kwargs(dec)
+                        return fi, names, nums
+        return None
+
+    # -- dependency closure (for `--changed`) ----------------------------
+
+    def module_dependents(self, changed: Iterable[str]) -> Set[str]:
+        """Transitive closure of modules importing any of `changed` —
+        the scan set a diff-scoped run must cover (a cross-module rule's
+        finding can live in a dependent of the edited module)."""
+        importers: Dict[str, Set[str]] = {m: set() for m in self.modules}
+        for mod in self.modules.values():
+            deps: Set[str] = set()
+            for src_module, _ in mod.from_imports.values():
+                deps.add(src_module)
+            deps.update(mod.module_aliases.values())
+            for dep in deps:
+                # an import of a package lands on its __init__ module
+                for candidate in (dep,):
+                    if candidate in importers:
+                        importers[candidate].add(mod.name)
+        out: Set[str] = set()
+        queue = [m for m in changed if m in importers]
+        while queue:
+            cur = queue.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            queue.extend(importers.get(cur, ()))
+        return out
+
+
+def build_callgraph(ctx: AnalysisContext) -> CallGraph:
+    """Context-cached accessor: every rule in a run shares one graph."""
+    cached = getattr(ctx, "_callgraph", None)
+    if cached is None:
+        cached = CallGraph(ctx)
+        ctx._callgraph = cached
+    return cached
+
+
+def _static_kwargs(call: ast.Call) -> Tuple[Tuple, Tuple]:
+    """(static_argnames, static_argnums) literal values of a jit call."""
+    names: Tuple = ()
+    nums: Tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _const_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            nums = _const_tuple(kw.value)
+    return names, nums
+
+
+def _const_tuple(node: ast.AST) -> Tuple:
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts if isinstance(e, ast.Constant)
+        )
+    return ()

@@ -28,7 +28,16 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-ANALYSIS_VERSION = "1.2.0"  # 1.2: trace-safety grad/vmap-reachability
+# 2.0: interprocedural dataflow — whole-package call graph (cross-module
+# trace-safety reachability), alias/escape-aware thread-ownership, and the
+# device-transfer / recompile-risk / shard-spec rule families
+ANALYSIS_VERSION = "2.0.0"
+
+# per-rule finding counts + wall time of the most recent run_analysis in
+# this process — surfaced through utils/build_info.get_build_info so
+# analysis cost rides ctrl getBuildInfo / `breeze openr version` like
+# every other cost in this codebase
+LAST_RUN_STATS: Dict = {}
 
 _IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
 _SKIP_FILE_RE = re.compile(r"#\s*analysis:\s*skip-file")
@@ -257,15 +266,25 @@ def load_baseline(path: Optional[Path]) -> set:
 
 
 def run_rules(
-    ctx: AnalysisContext, strict: bool = False
+    ctx: AnalysisContext,
+    strict: bool = False,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Finding], int]:
     """(kept findings, suppressed count). Suppressions apply per line;
-    strict promotes advisory findings to errors."""
+    strict promotes advisory findings to errors. Pass a dict as `timings`
+    to collect per-rule wall milliseconds (rule generators are drained
+    inside the timed section)."""
+    import time
+
     by_rel = {sf.rel: sf for sf in ctx.files}
     kept: List[Finding] = []
     suppressed = 0
     for rule in RULES.values():
-        for finding in rule.run(ctx):
+        t0 = time.perf_counter()
+        produced = list(rule.run(ctx))
+        if timings is not None:
+            timings[rule.name] = (time.perf_counter() - t0) * 1e3
+        for finding in produced:
             sf = by_rel.get(finding.path)
             if sf is not None and is_suppressed(sf, finding):
                 suppressed += 1
@@ -286,16 +305,54 @@ def run_analysis(
     """End-to-end run: returns a result dict (findings, counts, exit code).
 
     Exit semantics: non-zero iff any non-baselined error-severity finding
-    remains. Advisory findings are reported but do not fail the run unless
-    strict mode promoted them.
+    remains, or (on full-package scans) the baseline carries a STALE entry
+    — a waived key no rule produces anymore. A stale waiver means the debt
+    it marked was paid (or the message drifted): the baseline must be
+    regenerated (`--update-baseline`) so it never shadows a future
+    regression with the same key. Advisory findings are reported but do
+    not fail the run unless strict mode promoted them.
     """
+    import time
+
+    t_start = time.perf_counter()
     ctx = build_context(paths, root=root)
-    findings, suppressed = run_rules(ctx, strict=strict)
+    timings: Dict[str, float] = {}
+    findings, suppressed = run_rules(ctx, strict=strict, timings=timings)
     baseline = load_baseline(baseline_path)
     baselined = [f for f in findings if f.key() in baseline]
     active = [f for f in findings if f.key() not in baseline]
+    # stale-waiver check: only meaningful when the scan could have
+    # reproduced every waived finding, i.e. the whole package is in scope
+    if ctx.full_package:
+        produced_keys = {f.key() for f in findings}
+        for key in sorted(baseline - produced_keys):
+            rule = key.split("\t", 1)[0]
+            active.append(
+                Finding(
+                    rule="baseline",
+                    check="stale-entry",
+                    path=(
+                        baseline_path.name
+                        if baseline_path is not None
+                        else "analysis-baseline.txt"
+                    ),
+                    line=1,
+                    message=(
+                        f"stale baseline entry (no '{rule}' finding "
+                        f"produces this key anymore): {key!r} — "
+                        f"regenerate with --update-baseline"
+                    ),
+                )
+            )
     errors = [f for f in active if f.severity == "error"]
-    return {
+    per_rule: Dict[str, Dict] = {}
+    for name in sorted(RULES):
+        per_rule[name] = {
+            "findings": sum(1 for f in active if f.rule == name),
+            "ms": round(timings.get(name, 0.0), 3),
+        }
+    wall_ms = (time.perf_counter() - t_start) * 1e3
+    result = {
         "version": ANALYSIS_VERSION,
         "rules": [r["name"] for r in rule_catalog()],
         "files": len(ctx.files),
@@ -304,8 +361,20 @@ def run_analysis(
         "advisories": len(active) - len(errors),
         "suppressed": suppressed,
         "baselined": len(baselined),
+        "per_rule": per_rule,
+        "wall_ms": round(wall_ms, 3),
+        "full_package": ctx.full_package,
         "exit_code": 1 if errors else 0,
     }
+    LAST_RUN_STATS.clear()
+    LAST_RUN_STATS.update(
+        {
+            "wall_ms": result["wall_ms"],
+            "files": result["files"],
+            "per_rule": per_rule,
+        }
+    )
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -325,6 +394,11 @@ def render_text(result: Dict) -> str:
         f"{result['errors']} error(s), {result['advisories']} advisory, "
         f"{result['suppressed']} suppressed, "
         f"{result['baselined']} baselined"
+        + (
+            f", {result['wall_ms']:.0f} ms"
+            if "wall_ms" in result
+            else ""
+        )
     )
     return "\n".join(out)
 

@@ -1,0 +1,474 @@
+"""Light intraprocedural forward dataflow: alias sets + escape tracking.
+
+The ROADMAP's analysis-depth gap in one example: `d = self.x; d[k] = v`
+mutates decision-loop-owned state, and the attribute-rooted mutation walk
+cannot see it. This module is the general fix — a statement-ordered
+forward pass over one function body that tracks, per local name, a set of
+*tagged aliases*:
+
+    ('attr', 'x')     may alias self.x (or an object reachable from it)
+    ('device', desc)  flows out of a jit dispatch (a device-resident array)
+    ('jit', name)     holds a compiled callable (a solver-factory result)
+
+Transfer rules (deliberately simple — precision over recall, like every
+rule in this suite):
+
+  - `d = self.x` / `d = self.x[k]` / `d = self.x.y` bind ('attr', 'x');
+    plain Name/Attribute/Subscript loads propagate tags, *calls break
+    aliasing* (`d = self.x.copy()` is a fresh value) except when the
+    callee is classified by the `classify_call` hook (device producers).
+  - tuple-unpacking from a classified call tags every target (a solve's
+    unpacked outputs are all device-resident until proven otherwise).
+  - rebinding a name replaces its tags (kill on assignment); branches are
+    processed in order with no joins — facts accumulate per line, which
+    is exactly what a linter needs to point at the binding statement.
+
+The pass reports three event streams, each carrying the alias *chain*
+(the binding statements that created the alias) so findings read like the
+bug: "self.x aliased as 'd' (line 12), mutated via d[k] = ... (line 14)".
+
+  - mutations():  subscript/attr stores, aug-assigns, `del`, and mutating
+    container-method calls on attr-tagged names (plus direct self.x forms)
+  - escapes():    attr-tagged values passed to thread/executor/callback
+    handoff sinks, queue puts, or returned
+  - syncs():      host-sync expressions over device-tagged values
+    (np.asarray/np.array, .item()/.tolist(), float(), `for _ in d`)
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import call_name, dotted_name
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_MUTATOR_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+}
+
+# call shapes that hand a value to another execution context: threads,
+# executors, loop callbacks scheduled from other threads, queue puts
+_HANDOFF_CALLS = {
+    "Thread": "a thread target",
+    "submit": "an executor",
+    "run_in_executor": "an executor",
+    "call_soon_threadsafe": "a cross-thread loop callback",
+    "put": "a queue",
+    "put_nowait": "a queue",
+}
+
+# numpy module aliases that force a host copy of their array argument
+_NP_SYNC_CALLS = {"asarray", "array"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+Tag = Tuple[str, str]  # (kind, detail)
+
+
+@dataclass(frozen=True)
+class Alias:
+    tag: Tag
+    chain: Tuple[str, ...]  # binding descriptions, outermost first
+
+    def extended(self, step: str) -> "Alias":
+        return Alias(self.tag, self.chain + (step,))
+
+
+@dataclass
+class Mutation:
+    line: int
+    alias: Alias  # ('attr', name) tagged — the owned state mutated
+    desc: str  # e.g. "d[...] = ..." / "d.update(...)"
+    direct: bool  # True for self.x forms, False for alias-mediated
+
+
+@dataclass
+class Escape:
+    line: int
+    alias: Alias
+    sink: str  # human description of where it escaped to
+
+
+@dataclass
+class HostSync:
+    line: int
+    alias: Alias  # ('device', desc) tagged
+    desc: str  # e.g. "np.asarray(d)" / "d.item()" / "iteration over d"
+
+
+def self_attr_root(node: ast.AST) -> Optional[str]:
+    """First attribute name of a self-rooted load/store chain:
+    self.x[...] -> 'x', self.a.b -> 'a'; None otherwise."""
+    chain: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and chain:
+        return chain[-1]
+    return None
+
+
+class AliasTracker:
+    """One pass over one function body (nested defs are NOT entered: they
+    are separate functions analyzed in their own right)."""
+
+    def __init__(
+        self,
+        fn,
+        classify_call: Optional[Callable[[ast.Call], Optional[Tag]]] = None,
+        np_aliases: Optional[Set[str]] = None,
+        track_self_attrs: bool = True,
+    ):
+        self.fn = fn
+        self.classify_call = classify_call or (lambda call: None)
+        self.np_aliases = np_aliases or set()
+        self.track_self_attrs = track_self_attrs
+        self.state: Dict[str, Set[Alias]] = {}
+        self.mutations: List[Mutation] = []
+        self.escapes: List[Escape] = []
+        self.syncs: List[HostSync] = []
+        self._ran = False
+
+    # -- public ----------------------------------------------------------
+
+    def run(self) -> "AliasTracker":
+        if not self._ran:
+            self._ran = True
+            # parameters of the function are opaque (no tags): interproc
+            # parameter flow is each rule's business, not the tracker's
+            self._exec_block(self.fn.body)
+        return self
+
+    # -- expression tagging ----------------------------------------------
+
+    def tags_of(self, node: ast.AST) -> Set[Alias]:
+        if isinstance(node, ast.Name):
+            return set(self.state.get(node.id, ()))
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            if self.track_self_attrs:
+                attr = self_attr_root(node)
+                if attr is not None:
+                    return {Alias(("attr", attr), ())}
+            # a load off a tagged root stays tagged: d[0] of a device d is
+            # a device scalar; self.x's element is still owned state
+            root = node
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            return self.tags_of(root) if isinstance(root, ast.Name) else set()
+        if isinstance(node, ast.Call):
+            tag = self.classify_call(node)
+            if tag is not None:
+                return {Alias(tag, ())}
+            # a call on a jit-callable local produces a device value:
+            # fn = _sell_solver(key); d = fn(rows, ...)
+            if isinstance(node.func, ast.Name):
+                for alias in self.state.get(node.func.id, ()):
+                    if alias.tag[0] == "jit":
+                        return {
+                            Alias(
+                                ("device", f"{node.func.id}(...)"),
+                                alias.chain,
+                            )
+                        }
+            return set()  # calls break aliasing
+        if isinstance(node, ast.IfExp):
+            return self.tags_of(node.body) | self.tags_of(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: Set[Alias] = set()
+            for e in node.elts:
+                out |= self.tags_of(e)
+            return out
+        if isinstance(node, ast.Starred):
+            return self.tags_of(node.value)
+        return set()
+
+    # -- statement execution ---------------------------------------------
+
+    def _exec_block(self, body: Iterable[ast.AST]) -> None:
+        for stmt in body:
+            self._exec_stmt(stmt)
+
+    def _exec_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, _FuncDef) or isinstance(stmt, ast.ClassDef):
+            return  # nested scopes are separate analyses
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            for target in stmt.targets:
+                self._assign(target, stmt.value, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                self._assign(stmt.target, stmt.value, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            self._store_mutation(stmt.target, stmt.lineno, aug=True)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    self._store_mutation(t, stmt.lineno, deleted=True)
+                else:
+                    attr = self_attr_root(t)
+                    if attr is not None and self.track_self_attrs:
+                        self.mutations.append(
+                            Mutation(
+                                stmt.lineno,
+                                Alias(("attr", attr), ()),
+                                f"del self.{attr}",
+                                direct=True,
+                            )
+                        )
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                for alias in self.tags_of(stmt.value):
+                    if alias.tag[0] == "attr":
+                        self.escapes.append(
+                            Escape(stmt.lineno, alias, "the return value")
+                        )
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter)
+            for alias in self.tags_of(stmt.iter):
+                if alias.tag[0] == "device":
+                    self.syncs.append(
+                        HostSync(
+                            stmt.lineno,
+                            alias,
+                            "Python iteration over a device array",
+                        )
+                    )
+            # loop variable inherits element tags (device scalar / owned
+            # element)
+            if isinstance(stmt.target, ast.Name):
+                self.state[stmt.target.id] = {
+                    a.extended(
+                        f"iterated as '{stmt.target.id}' "
+                        f"(line {stmt.lineno})"
+                    )
+                    for a in self.tags_of(stmt.iter)
+                }
+            self._exec_block(stmt.body)
+            self._exec_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            self._exec_block(stmt.body)
+            self._exec_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            self._exec_block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body)
+            for handler in stmt.handlers:
+                self._exec_block(handler.body)
+            self._exec_block(stmt.orelse)
+            self._exec_block(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value)
+            return
+        # fall through (pass/raise/assert/global/...): scan for calls
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+
+    def _assign(self, target: ast.AST, value: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Name):
+            tags = self.tags_of(value)
+            src = _expr_desc(value)
+            self.state[target.id] = {
+                a.extended(f"{target.id} = {src} (line {line})")
+                for a in tags
+            }
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self._assign(t, v, line)
+                return
+            # unpacking one producer call: every target inherits its tags
+            tags = self.tags_of(value)
+            src = _expr_desc(value)
+            for t in target.elts:
+                if isinstance(t, ast.Name):
+                    self.state[t.id] = {
+                        a.extended(f"{t.id} unpacked from {src} (line {line})")
+                        for a in tags
+                    }
+                elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    self._store_mutation(t, line)
+            return
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            self._store_mutation(target, line)
+
+    def _store_mutation(
+        self, target: ast.AST, line: int, aug: bool = False,
+        deleted: bool = False,
+    ) -> None:
+        """A store through an attribute/subscript: direct self.x forms and
+        stores through attr-tagged aliases are owned-state mutations."""
+        op = "del " if deleted else ""
+        if self.track_self_attrs:
+            attr = self_attr_root(target)
+            if attr is not None:
+                self.mutations.append(
+                    Mutation(
+                        line,
+                        Alias(("attr", attr), ()),
+                        f"{op}self.{attr}{'[...]' if _subscripted(target) else ''}"
+                        + (" (aug-assign)" if aug else " = ..." if not deleted else ""),
+                        direct=True,
+                    )
+                )
+                return
+        root = target
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if isinstance(root, ast.Name):
+            desc = _expr_desc(target)
+            for alias in self.state.get(root.id, ()):
+                if alias.tag[0] == "attr":
+                    self.mutations.append(
+                        Mutation(
+                            line,
+                            alias,
+                            f"{op}{desc}" + ("" if deleted else " = ..."),
+                            direct=False,
+                        )
+                    )
+        elif isinstance(root, ast.Name) and aug:
+            pass  # plain `x += 1` on an untagged local: not a mutation
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._scan_call(sub)
+
+    def _scan_call(self, call: ast.Call) -> None:
+        name = call_name(call)
+        # mutating container-method calls on tagged receivers
+        if (
+            isinstance(call.func, ast.Attribute)
+            and name in _MUTATOR_METHODS
+            and isinstance(call.func.value, ast.Name)
+        ):
+            recv = call.func.value.id
+            for alias in self.state.get(recv, ()):
+                if alias.tag[0] == "attr":
+                    self.mutations.append(
+                        Mutation(
+                            call.lineno,
+                            alias,
+                            f"{recv}.{name}(...)",
+                            direct=False,
+                        )
+                    )
+        # host syncs on device-tagged values
+        if name in _SYNC_METHODS and isinstance(call.func, ast.Attribute):
+            for alias in self.tags_of(call.func.value):
+                if alias.tag[0] == "device":
+                    self.syncs.append(
+                        HostSync(
+                            call.lineno,
+                            alias,
+                            f"{_expr_desc(call.func.value)}.{name}()",
+                        )
+                    )
+        if isinstance(call.func, ast.Name) and name == "float" and call.args:
+            for alias in self.tags_of(call.args[0]):
+                if alias.tag[0] == "device":
+                    self.syncs.append(
+                        HostSync(
+                            call.lineno,
+                            alias,
+                            f"float({_expr_desc(call.args[0])})",
+                        )
+                    )
+        if (
+            isinstance(call.func, ast.Attribute)
+            and name in _NP_SYNC_CALLS
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in self.np_aliases
+            and call.args
+        ):
+            for alias in self.tags_of(call.args[0]):
+                if alias.tag[0] == "device":
+                    self.syncs.append(
+                        HostSync(
+                            call.lineno,
+                            alias,
+                            f"{call.func.value.id}.{name}"
+                            f"({_expr_desc(call.args[0])})",
+                        )
+                    )
+        # escapes: attr-tagged values handed to another execution context
+        if name in _HANDOFF_CALLS:
+            sink = _HANDOFF_CALLS[name]
+            operands = list(call.args) + [kw.value for kw in call.keywords]
+            for operand in operands:
+                for alias in self.tags_of(operand):
+                    if alias.tag[0] == "attr":
+                        self.escapes.append(
+                            Escape(call.lineno, alias, sink)
+                        )
+
+
+def _subscripted(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Subscript):
+            return True
+        node = node.value
+    return False
+
+
+def _expr_desc(node: ast.AST, depth: int = 0) -> str:
+    """Short source-ish rendering for finding messages."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_expr_desc(node.value, depth + 1)}.{node.attr}"
+    if isinstance(node, ast.Subscript):
+        return f"{_expr_desc(node.value, depth + 1)}[...]"
+    if isinstance(node, ast.Call):
+        base = _expr_desc(node.func, depth + 1)
+        return f"{base}(...)"
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return "(...)"
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    return "<expr>"
+
+
+def alias_chain_text(alias: Alias) -> str:
+    """'self.x via d = self.x (line 12)' rendering for finding messages."""
+    base = (
+        f"self.{alias.tag[1]}"
+        if alias.tag[0] == "attr"
+        else alias.tag[1] or alias.tag[0]
+    )
+    if not alias.chain:
+        return base
+    return f"{base} via " + " -> ".join(alias.chain)

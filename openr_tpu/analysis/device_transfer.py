@@ -1,0 +1,151 @@
+"""device-transfer: no unsanctioned host syncs on device-resident arrays.
+
+Trace-safety polices code *inside* jit; this rule polices the host side of
+the seam. The solver stack's whole performance story is that distance
+state stays device-resident between events (docs/Decision.md DeltaPath):
+an `np.asarray(...)` / `.item()` / `float(...)` / Python iteration over a
+value that flowed out of a solver dispatch is a synchronous device->host
+copy on the hot path — the exact bug class the [S, n_pad] mirror fetch
+was redesigned to avoid.
+
+Mechanics (callgraph + dataflow):
+  - *producers* are resolved through the package call graph
+    (analysis/callgraph.py): module-level jit bindings (`@jax.jit` defs,
+    `X = jax.jit(f, ...)`), solver factories (functions returning a jit
+    callable — `fn = _sell_solver(key); d = fn(...)`), and functions whose
+    return value flows out of one of those (`batched_spf`).
+  - the alias tracker (analysis/dataflow.py) follows the producer's value
+    through local bindings, tuple unpacking (`d, rounds = fn(...)`), and
+    sub-object loads, then reports host syncs with the flow chain in the
+    message.
+  - traced functions are excluded — host syncs inside them are
+    trace-safety findings, not transfer findings.
+
+Sanctioned seams — "whitelisted by construction": a function that
+accounts its copy-back into a `*d2h*` transfer counter in the same body
+(`self.d2h_bytes += xfer`, the DeltaPath compacted-extraction contract) is
+a deliberate, *measured* seam and is skipped whole. The rule therefore
+enforces a real invariant: every host sync on solver output is either
+accounted where it happens or explicitly waived with a comment.
+
+Note `int(...)` is deliberately NOT a sync trigger: the 4-byte scalar
+reads the warm path is designed around (`int(num_changed)`,
+`int(rounds)`) are the sanctioned way to size a compacted fetch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set, Tuple
+
+from openr_tpu.analysis.callgraph import build_callgraph
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+from openr_tpu.analysis.dataflow import AliasTracker, alias_chain_text
+from openr_tpu.analysis.trace_safety import (
+    _numpy_aliases,
+    traced_function_infos,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _accounts_transfer(fn) -> bool:
+    """True when the function accounts device->host bytes in its own body:
+    an (aug-)assignment to an attribute or local whose name mentions d2h
+    (`self.d2h_bytes += xfer` — the 'sanctioned seam' contract the
+    DeltaPath extraction established; free functions hand a `d2h_bytes`
+    local to their caller's counters instead)."""
+    for node in ast.walk(fn):
+        target = None
+        if isinstance(node, ast.AugAssign):
+            target = node.target
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        if isinstance(target, ast.Attribute) and "d2h" in target.attr.lower():
+            return True
+        if isinstance(target, ast.Name) and "d2h" in target.id.lower():
+            return True
+    return False
+
+
+@register
+class DeviceTransferRule(Rule):
+    name = "device-transfer"
+    severity = "error"
+    description = (
+        "host syncs (np.asarray/.item()/float()/iteration) on values that "
+        "flow from solver/jit outputs must happen in sanctioned seams "
+        "(functions accounting *d2h* transfer bytes) or carry a waiver"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        cg = build_callgraph(ctx)
+        traced, _ = traced_function_infos(ctx)
+        traced_nodes = {id(fi.node) for fi in traced}
+        for mod in cg.modules.values():
+            np_aliases = _numpy_aliases(mod.sf.tree)
+
+            def classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+                func = call.func
+                if isinstance(func, ast.Name):
+                    kind = cg.resolve_producer(mod, func.id)
+                    if kind in ("jit", "device"):
+                        return ("device", f"{func.id}(...)")
+                    if kind == "factory":
+                        return ("jit", func.id)
+                elif isinstance(func, ast.Attribute):
+                    chain = dotted_name(func)
+                    if chain and not chain.startswith("self."):
+                        kind = cg.resolve_producer_chain(mod, chain)
+                        if kind in ("jit", "device"):
+                            return ("device", f"{chain}(...)")
+                        if kind == "factory":
+                            return ("jit", chain)
+                elif isinstance(func, ast.Call):
+                    inner = call_name(func)
+                    if (
+                        inner
+                        and cg.resolve_producer(mod, inner) == "factory"
+                    ):
+                        return ("device", f"{inner}(...)(...)")
+                return None
+
+            for infos in mod.by_name.values():
+                for fi in infos:
+                    if id(fi.node) in traced_nodes:
+                        continue  # trace-safety's jurisdiction
+                    if fi.parent is not None and id(
+                        fi.parent.node
+                    ) in traced_nodes:
+                        continue
+                    if _accounts_transfer(fi.node):
+                        continue  # sanctioned seam, by construction
+                    tracker = AliasTracker(
+                        fi.node,
+                        classify_call=classify,
+                        np_aliases=np_aliases,
+                    ).run()
+                    for sync in tracker.syncs:
+                        check = (
+                            "device-iteration"
+                            if "iteration" in sync.desc
+                            else "host-sync"
+                        )
+                        flow = alias_chain_text(sync.alias)
+                        yield self.finding(
+                            check,
+                            mod.sf,
+                            sync.line,
+                            f"'{fi.name}': {sync.desc} forces a "
+                            f"device->host copy of a solver output "
+                            f"({flow}) outside a sanctioned seam — "
+                            f"account the bytes into a *d2h* counter, "
+                            f"move it behind an accounted fetch, or "
+                            f"waive with a comment",
+                        )

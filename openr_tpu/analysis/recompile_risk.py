@@ -1,0 +1,185 @@
+"""recompile-risk: static jit arguments must be bounded (bucketed).
+
+Every distinct value of a `static_argnames`/`static_argnums` parameter is
+a fresh trace + XLA compile. The codebase's discipline is the
+`_PATCH_SLOTS` / `_next_bucket` idiom: any per-event size that reaches a
+static argument is first rounded up to a power-of-two bucket, so a
+handful of executables serve every event (docs/Decision.md,
+`ops/spf.py:_delta_extract`'s `cap`). Feeding a raw `len(...)` — or
+arithmetic derived from one — recompiles per event size: the classic
+silent TPU-stack performance bug this rule exists to catch.
+
+Mechanics: call sites of jit bindings with statically-known
+static_argnames/static_argnums (resolved through the package call graph,
+imports included) have each static operand classified as *bounded* or
+*unbounded*:
+
+  bounded    constants; bucketing calls (`_next_bucket`, `*_pad`, names
+             containing 'bucket'); clamps (min/max/clip); attribute loads
+             (config knobs, shape-key fields — already bucketed by the
+             compile_graph padding discipline); `int()` of a bounded
+             value; locals whose every assignment is bounded; bare
+             parameters (the caller's responsibility, checked at ITS call
+             sites)
+  unbounded  `len(...)`, `sum(...)`, subscripts of data, arithmetic with
+             an unbounded operand — anything that varies per call with
+             the workload
+
+Advisory severity: boundedness is a heuristic classification; `--strict`
+(the tier-1 gate) promotes it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from openr_tpu.analysis.callgraph import build_callgraph
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    call_name,
+    register,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_CLAMP_CALLS = {"min", "max", "clip"}
+_UNBOUNDED_CALLS = {"len", "sum", "count_nonzero"}
+
+
+def _is_bucketing_call(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return "bucket" in name or name.endswith("_pad")
+
+
+class _Boundedness:
+    """Classify expressions inside one enclosing function."""
+
+    def __init__(self, enclosing) -> None:
+        self.assignments: Dict[str, List[ast.AST]] = {}
+        if enclosing is not None:
+            for node in ast.walk(enclosing):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.assignments.setdefault(t.id, []).append(
+                                node.value
+                            )
+                elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    if node.value is not None:
+                        self.assignments.setdefault(
+                            node.target.id, []
+                        ).append(node.value)
+
+    def bounded(self, node: ast.AST, depth: int = 0) -> bool:
+        if depth > 8:
+            return True  # resolution fuel exhausted: trust it
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            # cfg.steps / key[0] / x.shape[1]: config knobs and shape-key
+            # fields are bounded by the padding discipline
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if _is_bucketing_call(name) or name in _CLAMP_CALLS:
+                return True
+            if name in _UNBOUNDED_CALLS:
+                return False
+            if name in ("int", "abs", "round") and node.args:
+                return self.bounded(node.args[0], depth + 1)
+            return True  # unknown call: trust it (precision over recall)
+        if isinstance(node, ast.Name):
+            exprs = self.assignments.get(node.id)
+            if not exprs:
+                return True  # a parameter or outer binding: trusted
+            return all(self.bounded(e, depth + 1) for e in exprs)
+        if isinstance(node, ast.BinOp):
+            return self.bounded(node.left, depth + 1) and self.bounded(
+                node.right, depth + 1
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.bounded(node.operand, depth + 1)
+        if isinstance(node, ast.IfExp):
+            return self.bounded(node.body, depth + 1) and self.bounded(
+                node.orelse, depth + 1
+            )
+        return True
+
+
+@register
+class RecompileRiskRule(Rule):
+    name = "recompile-risk"
+    severity = "advisory"
+    description = (
+        "static jit arguments (static_argnames/static_argnums) must be "
+        "bounded — bucketed via the _next_bucket/_PATCH_SLOTS idiom, "
+        "clamped, or configuration — never a raw per-call len()/size"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        cg = build_callgraph(ctx)
+        for mod in cg.modules.values():
+            # enclosing-function map for local-assignment resolution
+            enclosing_of: Dict[int, ast.AST] = {}
+            for fn in ast.walk(mod.sf.tree):
+                if isinstance(fn, _FuncDef):
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, ast.Call):
+                            enclosing_of.setdefault(id(sub), fn)
+            for node in ast.walk(mod.sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                if callee is None:
+                    continue
+                resolved = cg.resolve_static_argnames(mod, callee)
+                if resolved is None:
+                    continue
+                core, static_names, static_nums = resolved
+                if not static_names and not static_nums:
+                    continue
+                params = [
+                    a.arg
+                    for a in (
+                        core.node.args.posonlyargs + core.node.args.args
+                    )
+                ]
+                checker = _Boundedness(enclosing_of.get(id(node)))
+                # keyword statics
+                for kw in node.keywords:
+                    if kw.arg in static_names and not checker.bounded(
+                        kw.value
+                    ):
+                        yield self.finding(
+                            "unbucketed-static",
+                            mod.sf,
+                            node.lineno,
+                            f"call to jitted '{callee}': static argument "
+                            f"'{kw.arg}' varies per call (unbounded "
+                            f"expression) — bucket it with _next_bucket "
+                            f"or clamp it, or every event size compiles "
+                            f"a fresh executable",
+                        )
+                # positional statics (by name position or static_argnums)
+                for i, arg in enumerate(node.args):
+                    pname = params[i] if i < len(params) else None
+                    if (
+                        i in static_nums or pname in static_names
+                    ) and not checker.bounded(arg):
+                        yield self.finding(
+                            "unbucketed-static",
+                            mod.sf,
+                            node.lineno,
+                            f"call to jitted '{callee}': static argument "
+                            f"#{i} ('{pname or '?'}') varies per call "
+                            f"(unbounded expression) — bucket it with "
+                            f"_next_bucket or clamp it, or every event "
+                            f"size compiles a fresh executable",
+                        )

@@ -42,6 +42,7 @@ from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from openr_tpu.analysis.core import (
+    RULES,
     AnalysisContext,
     Rule,
     SourceFile,
@@ -437,6 +438,7 @@ class RegistryDriftRule(Rule):
         yield from self._check_event_catalog(ctx)
         yield from self._check_fault_catalog(ctx)
         yield from self._check_config_knobs(ctx)
+        yield from self._check_rule_table(ctx)
 
     # -- naming convention (always on) ----------------------------------
 
@@ -560,6 +562,56 @@ class RegistryDriftRule(Rule):
                 _doc_line(text, name),
                 f"docs/Robustness.md catalogs fault point '{name}' but "
                 f"no fault_point(...) declares it",
+            )
+
+    # -- docs/Analysis.md rule catalog ----------------------------------
+
+    def _check_rule_table(self, ctx: AnalysisContext):
+        """The analysis suite's own registry: the docs/Analysis.md rule
+        table and the RULES registry (= `--list-rules` output, which is
+        generated from it) must match both ways — a rule family without a
+        documented invariant is unreviewable, a documented family that no
+        longer registers is a ghost."""
+        doc = ctx.docs_dir / "Analysis.md"
+        if not doc.exists():
+            return
+        sf_doc = _doc_source(ctx, doc)
+        text = doc.read_text()
+        documented: Set[str] = set()
+        in_table = False
+        for line in text.splitlines():
+            s = line.strip()
+            if not s.startswith("|"):
+                in_table = False
+                continue
+            cells = [c.strip() for c in s.strip("|").split("|")]
+            if not cells:
+                continue
+            low = cells[0].lower()
+            if low == "rule":
+                in_table = True
+                continue
+            if not in_table or set(cells[0]) <= {"-", " "}:
+                continue
+            m = re.match(r"^`([a-z][a-z0-9-]*)`$", cells[0])
+            if m:
+                documented.add(m.group(1))
+        registered = set(RULES)
+        for name in sorted(registered - documented):
+            yield self.finding(
+                "undocumented-rule",
+                sf_doc,
+                _doc_line(text, name),
+                f"analysis rule '{name}' is registered but missing from "
+                f"the docs/Analysis.md rule table",
+            )
+        for name in sorted(documented - registered):
+            yield self.finding(
+                "ghost-rule",
+                sf_doc,
+                _doc_line(text, name),
+                f"docs/Analysis.md documents analysis rule '{name}' but "
+                f"no such rule registers (see --list-rules)",
             )
 
     # -- DecisionConfigSection knobs ------------------------------------

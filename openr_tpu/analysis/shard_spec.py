@@ -1,0 +1,300 @@
+"""shard-spec: sharding specs must match the functions they wrap.
+
+Two drift modes bite multi-chip code and surface only at trace time (or
+worse, as silent resharding):
+
+  - arity drift: `jax.jit(solve, in_shardings=(row, repl, repl))` where
+    `solve` takes four arguments — adding a solver operand without
+    extending the spec tuple raises deep inside pjit with a message that
+    names neither the function nor the missing leaf. Same for
+    `out_shardings` vs. the returned tuple, and `shard_map`'s
+    in_specs/out_specs.
+  - axis-vocabulary drift: every PartitionSpec axis name and
+    `mesh.shape["..."]` lookup must come from the solver-mesh axis
+    vocabulary (`make_mesh`'s axis_names — 'batch'/'graph' in this repo).
+    A typo'd axis name (`P('batchs')`) resolves to nothing until a run on
+    a real multi-chip mesh dies.
+
+Resolution: the wrapped function is found through the package call graph
+(local defs — nearest preceding def for same-name shadowing — imported
+names, and `factory(...)` operands via the factory's returned nested
+def). Only literal tuple/list specs are checked; computed specs
+(`shardings + (extra,)`) are skipped — precision over recall. The axis
+vocabulary is read from the scanned set itself (the `axis_names` default
+of a `make_mesh` def, literal `Mesh(..., ('batch', 'graph'))`
+constructions, and literal `axis_names=` kwargs); when no vocabulary is
+in scope (single-file scans of consumer modules) the axis check disarms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from openr_tpu.analysis.callgraph import (
+    build_callgraph,
+    returned_local_defs,
+)
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_IN_SPEC_KWARGS = ("in_shardings", "in_specs")
+_OUT_SPEC_KWARGS = ("out_shardings", "out_specs")
+_WRAPPER_CALLS = ("jit", "shard_map")
+
+
+def _partition_spec_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to jax.sharding.PartitionSpec ('P' by idiom)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("sharding") or node.module == "jax"
+        ):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def mesh_axis_vocabulary(ctx: AnalysisContext) -> Set[str]:
+    """Axis names the scanned set itself declares: make_mesh's axis_names
+    default, literal Mesh(..., (names)) constructions, and literal
+    axis_names= kwargs anywhere."""
+    vocab: Set[str] = set()
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, _FuncDef) and node.name == "make_mesh":
+                args = node.args
+                names = args.posonlyargs + args.args + args.kwonlyargs
+                defaults = list(args.defaults)
+                kw_defaults = list(args.kw_defaults)
+                pos = args.posonlyargs + args.args
+                for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                    if a.arg == "axis_names":
+                        vocab.update(_const_strs(d))
+                for a, d in zip(args.kwonlyargs, kw_defaults):
+                    if a.arg == "axis_names" and d is not None:
+                        vocab.update(_const_strs(d))
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name == "Mesh" and len(node.args) >= 2:
+                    vocab.update(_const_strs(node.args[1]))
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        vocab.update(_const_strs(kw.value))
+    return vocab
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _spec_len(node: ast.AST) -> Optional[int]:
+    """Length of a literal tuple/list spec; None when computed."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return None
+
+
+def _positional_arity(fn) -> Optional[range]:
+    """Acceptable in-spec arities: a range covering optional defaults;
+    None when *args makes the arity open."""
+    args = fn.args
+    if args.vararg is not None:
+        return None
+    names = [a.arg for a in args.posonlyargs + args.args if a.arg != "self"]
+    n = len(names)
+    ndefault = len(args.defaults)
+    return range(n - ndefault, n + 1)
+
+
+def _return_arity(fn) -> Optional[int]:
+    """Consistent tuple-return length of a def; None when mixed/opaque."""
+    lengths: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, _FuncDef) and node is not fn:
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Tuple):
+                lengths.add(len(node.value.elts))
+            else:
+                return None
+    # only descend this function's own returns (walk enters nested defs;
+    # redo shallowly)
+    lengths = set()
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FuncDef):
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Tuple):
+                lengths.add(len(node.value.elts))
+            else:
+                return None
+        stack.extend(
+            c
+            for c in ast.iter_child_nodes(node)
+            if not isinstance(c, _FuncDef)
+        )
+    return lengths.pop() if len(lengths) == 1 else None
+
+
+@register
+class ShardSpecRule(Rule):
+    name = "shard-spec"
+    severity = "error"
+    description = (
+        "in_shardings/in_specs arity must match the wrapped function's "
+        "signature (out specs vs. returned tuple), and PartitionSpec/"
+        "mesh.shape axis names must come from the solver_mesh vocabulary"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        cg = build_callgraph(ctx)
+        vocab = mesh_axis_vocabulary(ctx)
+        for mod in cg.modules.values():
+            sf = mod.sf
+            p_aliases = _partition_spec_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                yield from self._check_axis_names(
+                    sf, node, p_aliases, vocab
+                )
+                if call_name(node) in _WRAPPER_CALLS:
+                    yield from self._check_arity(cg, mod, node)
+            if vocab:
+                for axis, line in mesh_shape_subscripts(sf.tree):
+                    if axis not in vocab:
+                        yield self.finding(
+                            "unknown-mesh-axis",
+                            sf,
+                            line,
+                            f"mesh.shape['{axis}'] is not in the "
+                            f"solver_mesh axis vocabulary "
+                            f"({', '.join(sorted(vocab))})",
+                        )
+
+    # -- axis vocabulary -------------------------------------------------
+
+    def _check_axis_names(self, sf, node: ast.Call, p_aliases, vocab):
+        if not vocab:
+            return  # no declaration in scope: cannot judge
+        name = call_name(node)
+        root = dotted_name(node.func) or ""
+        if name in p_aliases or root in p_aliases or (
+            name == "PartitionSpec"
+        ):
+            for arg in node.args:
+                for axis in _const_strs(arg):
+                    if axis not in vocab:
+                        yield self.finding(
+                            "unknown-mesh-axis",
+                            sf,
+                            node.lineno,
+                            f"PartitionSpec axis '{axis}' is not in the "
+                            f"solver_mesh axis vocabulary "
+                            f"({', '.join(sorted(vocab))})",
+                        )
+
+    # -- arity -----------------------------------------------------------
+
+    def _check_arity(self, cg, mod, call: ast.Call):
+        target = self._resolve_wrapped(cg, mod, call)
+        if target is None:
+            return
+        for kw in call.keywords:
+            if kw.arg in _IN_SPEC_KWARGS:
+                got = _spec_len(kw.value)
+                if got is None:
+                    continue
+                want = _positional_arity(target)
+                if want is not None and got not in want:
+                    yield self.finding(
+                        "spec-arity",
+                        mod.sf,
+                        call.lineno,
+                        f"{kw.arg} has {got} entries but "
+                        f"'{target.name}' takes "
+                        f"{want.start if len(want) == 1 else f'{want.start}..{want.stop - 1}'} "
+                        f"positional argument(s)",
+                    )
+            elif kw.arg in _OUT_SPEC_KWARGS:
+                got = _spec_len(kw.value)
+                if got is None:
+                    continue
+                ret = _return_arity(target)
+                if ret is not None and got != ret:
+                    yield self.finding(
+                        "spec-arity",
+                        mod.sf,
+                        call.lineno,
+                        f"{kw.arg} has {got} entries but "
+                        f"'{target.name}' returns a {ret}-tuple",
+                    )
+
+    def _resolve_wrapped(self, cg, mod, call: ast.Call):
+        """The wrapped def of a jit/shard_map call, or None. Name operands
+        prefer the nearest preceding same-file def (shadowing-safe), then
+        imports; Call operands resolve through factory returns."""
+        if not call.args:
+            return None
+        op = call.args[0]
+        if isinstance(op, ast.Name):
+            local = mod.by_name.get(op.id, [])
+            preceding = [
+                fi for fi in local if fi.node.lineno < call.lineno
+            ]
+            if preceding:
+                return max(preceding, key=lambda fi: fi.node.lineno).node
+            if local:
+                return None  # only defs after the call: ambiguous
+            for fi in cg.resolve_call_defs(
+                mod, ast.Call(func=op, args=[], keywords=[])
+            ):
+                return fi.node
+            return None
+        if isinstance(op, ast.Call):
+            for fi in cg.resolve_call_defs(mod, op):
+                rets = returned_local_defs(fi.node)
+                if len(rets) == 1:
+                    return rets[0]
+            return None
+        if isinstance(op, ast.Attribute):
+            chain = dotted_name(op)
+            if chain:
+                for fi in cg.resolve_call_defs(
+                    mod, ast.Call(func=op, args=[], keywords=[])
+                ):
+                    return fi.node
+        return None
+
+
+def mesh_shape_subscripts(tree: ast.AST):
+    """(axis, line) of every mesh.shape['axis'] lookup in a module."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+            and "mesh" in (dotted_name(node.value) or "").lower()
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            yield node.slice.value, node.lineno

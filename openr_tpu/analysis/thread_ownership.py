@@ -22,6 +22,16 @@ Mechanics:
     (`self.x = ...`, `self.a.b = ...`, `self.x[...] = ...`, aug-assign,
     `del`) and mutating container calls on self-rooted receivers
     (`self.links.add(...)`, `.pop`, `.update`, ...).
+  - Since 2.0 the rule also runs the alias engine (analysis/dataflow.py)
+    over every reachable method: ALIASED mutation — `d = self.x;
+    d[k] = v`, `d.update(...)` on a local that may alias owned state,
+    including through sub-object loads (`row = self.db[area]`) — is
+    flagged with the alias chain in the message (the ROADMAP
+    analysis-depth gap this version closes), and owned state handed to
+    another execution context (a `Thread(...)` target, `submit`/
+    `run_in_executor`, `call_soon_threadsafe`, a queue `put`) from a
+    ctrl-reachable method is an `escaped-state` finding: once it crosses,
+    the owner serializes nothing.
 
 Declared handovers (not flagged):
   - the entry method is marked shared — `# analysis: shared` on its `def`
@@ -35,9 +45,7 @@ Declared handovers (not flagged):
   - the attribute's `__init__` assignment carries `# analysis: shared`.
 
 Severity is advisory by default (reachability is name-based and therefore
-heuristic); `ANALYSIS_STRICT=1` promotes it. Aliased mutation
-(`d = self.x; d[k] = v`) is out of scope — the convention is to mutate
-through `self` so the analyzer can see it.
+heuristic); `ANALYSIS_STRICT=1` promotes it.
 """
 
 from __future__ import annotations
@@ -53,6 +61,7 @@ from openr_tpu.analysis.core import (
     dotted_name,
     register,
 )
+from openr_tpu.analysis.dataflow import AliasTracker, alias_chain_text
 
 # module references a CtrlServer/Monitor holds (composition in openr.py)
 MODULE_ATTRS = {
@@ -170,6 +179,27 @@ def _self_attr_root(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Name) and node.id == "self" and chain:
         return chain[-1]
     return None
+
+
+def _lock_spans(fn) -> List[Tuple[int, int]]:
+    """(start, end) line spans of `with <...lock...>:` bodies — the
+    post-filter for alias-engine findings (the engine itself is
+    context-free by design)."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = dotted_name(item.context_expr) or ""
+                if "lock" in name.lower():
+                    spans.append(
+                        (node.lineno, getattr(node, "end_lineno", node.lineno))
+                    )
+                    break
+    return spans
+
+
+def _in_spans(line: int, spans: List[Tuple[int, int]]) -> bool:
+    return any(s <= line <= e for s, e in spans)
 
 
 def _lock_guarded(stack: List[ast.AST]) -> bool:
@@ -325,6 +355,47 @@ class ThreadOwnershipRule(Rule):
                         f"'# analysis: shared' (sync only), take a "
                         f"lock, or mark the attribute shared in "
                         f"__init__",
+                    )
+                # alias-engine pass: mutations through local aliases of
+                # owned state, and owned state escaping the loop
+                tracker = AliasTracker(cur_fn).run()
+                spans = _lock_spans(cur_fn)
+                via = "" if cur == name else f" (via {cls.name}.{cur})"
+                for m in tracker.mutations:
+                    if m.direct:
+                        continue  # the attribute walk above covers these
+                    if m.alias.tag[1] in shared_attrs:
+                        continue
+                    if _in_spans(m.line, spans):
+                        continue
+                    yield self.finding(
+                        "aliased-mutation",
+                        sf,
+                        m.line,
+                        f"{cls.name}.{name} is reachable from the ctrl "
+                        f"server but mutates '{owner}'-owned state "
+                        f"through an alias: {m.desc} mutates "
+                        f"{alias_chain_text(m.alias)}{via} — mutate "
+                        f"through self so a handover can be declared, "
+                        f"or mark the method '# analysis: shared'",
+                    )
+                for esc in tracker.escapes:
+                    if esc.sink == "the return value":
+                        continue  # sync reads off the loop are the API
+                    if esc.alias.tag[1] in shared_attrs:
+                        continue
+                    if _in_spans(esc.line, spans):
+                        continue
+                    yield self.finding(
+                        "escaped-state",
+                        sf,
+                        esc.line,
+                        f"{cls.name}.{name} is reachable from the ctrl "
+                        f"server and hands '{owner}'-owned state "
+                        f"({alias_chain_text(esc.alias)}) to "
+                        f"{esc.sink}{via} — once it crosses the "
+                        f"ownership boundary the loop serializes "
+                        f"nothing; pass a copy instead",
                     )
                 for node, _ in _walk_with_stack(cur_fn):
                     if (

@@ -7,8 +7,17 @@ This rule finds the functions that trace — decorated with `jax.jit`, passed
 to a `jax.jit(...)`/`shard_map(...)` call or a transform that traces its
 operand (`grad(...)`/`value_and_grad(...)`/`vmap(...)` — the differentiable
 TE core in openr_tpu/te/ reaches its objective exclusively through
-`jax.value_and_grad`), nested inside a traced function, or called by name
-from one (per module, transitively) — and flags, inside them:
+`jax.value_and_grad`), nested inside a traced function, or called from one
+— transitively ACROSS MODULE BOUNDARIES: since 2.0 the reachability
+closure runs on the whole-package call graph (analysis/callgraph.py), so
+a jitted step in `parallel/mesh.py` that calls a helper imported from
+`ops/spf.py` drags that helper (and everything it calls, wherever it
+lives) into the traced set. Same-module calls resolve by simple name (the
+per-file behavior, collisions unioned); cross-module calls resolve only
+through an explicit `from X import f` / `import X as y` link, so
+same-named helpers in unrelated modules never alias. Factory seeds cross
+modules too: `jax.jit(factory(...), ...)` traces the nested function the
+factory returns. Flagged inside traced functions:
 
   - `python-branch`: an `if`/`while`/conditional-expression test that
     contains a jnp/jax call (tracer-valued: `if jnp.any(...)` forces a
@@ -37,6 +46,11 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from openr_tpu.analysis.callgraph import (
+    FunctionInfo,
+    build_callgraph,
+    returned_local_defs,
+)
 from openr_tpu.analysis.core import (
     AnalysisContext,
     Finding,
@@ -210,6 +224,75 @@ def _walk_shallow(fn):
             stack.extend(ast.iter_child_nodes(node))
 
 
+def traced_function_infos(ctx: AnalysisContext):
+    """(traced, direct) FunctionInfo sets for the WHOLE scanned set.
+
+    Seeds are the per-module `_traced_functions` result plus cross-module
+    jit seeds (an imported function passed to a trace-entry call, and the
+    `jax.jit(factory(...), ...)` idiom where the factory's returned nested
+    def is the thing that traces). The closure then follows lexical
+    nesting and call edges through the package call graph, so reachability
+    no longer stops at the file boundary (the ROADMAP analysis-depth gap).
+    Cached on the context: every rule in a run shares one traced set."""
+    cached = getattr(ctx, "_traced_infos", None)
+    if cached is not None:
+        return cached
+    cg = build_callgraph(ctx)
+    traced = set()
+    direct = set()
+    for sf in ctx.files:
+        t, d = _traced_functions(sf.tree)
+        for fn in t:
+            fi = cg.info(fn)
+            if fi is not None:
+                traced.add(fi)
+        for fn in d:
+            fi = cg.info(fn)
+            if fi is not None:
+                direct.add(fi)
+    # cross-module seeds: jit entries fed imported names or factory calls
+    for mod in cg.modules.values():
+        for node in ast.walk(mod.sf.tree):
+            if not (isinstance(node, ast.Call) and _is_jit_entry(node)):
+                continue
+            for arg in node.args:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    for fi in cg.resolve_call_defs(
+                        mod, ast.Call(func=arg, args=[], keywords=[])
+                    ):
+                        if fi.module != mod.name:
+                            traced.add(fi)
+                            direct.add(fi)
+                elif isinstance(arg, ast.Call):
+                    for fi in cg.resolve_call_defs(mod, arg):
+                        for ret in returned_local_defs(fi.node):
+                            ri = cg.info(ret)
+                            if ri is not None:
+                                traced.add(ri)
+    # closure over nesting + resolved call edges, package-wide
+    queue = list(traced)
+    while queue:
+        fi = queue.pop()
+        mod = cg.modules.get(fi.module)
+        if mod is None:
+            continue
+        for node in ast.walk(fi.node):
+            if node is fi.node:
+                continue
+            if isinstance(node, _FuncDef):
+                ni = cg.info(node)
+                if ni is not None and ni not in traced:
+                    traced.add(ni)
+                    queue.append(ni)
+            elif isinstance(node, ast.Call):
+                for target in cg.resolve_call_defs(mod, node):
+                    if target is not None and target not in traced:
+                        traced.add(target)
+                        queue.append(target)
+    ctx._traced_infos = (traced, direct)
+    return traced, direct
+
+
 @register
 class TraceSafetyRule(Rule):
     name = "trace-safety"
@@ -220,16 +303,19 @@ class TraceSafetyRule(Rule):
     )
 
     def run(self, ctx: AnalysisContext):
-        for sf in ctx.files:
-            yield from self._run_file(sf)
-
-    def _run_file(self, sf: SourceFile):
-        jnp = _jax_numpy_aliases(sf.tree)
-        if not jnp:
-            return  # module never touches jax; nothing can trace
-        np_aliases = _numpy_aliases(sf.tree)
-        traced, direct = _traced_functions(sf.tree)
-        for fn in traced:
+        traced, direct = traced_function_infos(ctx)
+        alias_cache: Dict[int, Tuple[Set[str], Set[str]]] = {}
+        for fi in sorted(traced, key=lambda f: (f.sf.rel, f.node.lineno)):
+            sf = fi.sf
+            cached = alias_cache.get(id(sf))
+            if cached is None:
+                cached = (
+                    _jax_numpy_aliases(sf.tree),
+                    _numpy_aliases(sf.tree),
+                )
+                alias_cache[id(sf)] = cached
+            jnp, np_aliases = cached
+            fn = fi.node
             hot = (
                 {
                     a.arg
@@ -240,7 +326,7 @@ class TraceSafetyRule(Rule):
                     )
                     if a.arg != "self"
                 }
-                if fn in direct
+                if fi in direct
                 else set()
             )
             scanner = _TestScanner(hot, jnp)

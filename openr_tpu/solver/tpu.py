@@ -752,7 +752,9 @@ class _AreaSolve:
         rows = np.concatenate(
             [rows, np.full(s_pad - len(rows), rows[0], dtype=np.int32)]
         )
-        return np.array(batched_spf(self.graph, rows))
+        cold = np.array(batched_spf(self.graph, rows))
+        self.d2h_bytes += cold.nbytes  # audit copy-back, accounted too
+        return cold
 
     # -- KSP (k-edge-disjoint shortest paths), device-batched ------------
 
@@ -842,6 +844,11 @@ class _AreaSolve:
             d_rows = np.asarray(
                 batched_spf_vw(self.graph, sources, w_rows, mesh=self.mesh)
             )
+            self.h2d_bytes += w_rows.nbytes
+        # the penalized distance rows are consumed host-side by the greedy
+        # back-trace — a real copy-back, so it rides the transfer counters
+        # like the mirror fetch does
+        self.d2h_bytes += d_rows.nbytes
         self.ksp_device_batches += 1
 
         for row, (dest, ig) in enumerate(zip(todo, ignores)):

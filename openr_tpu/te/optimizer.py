@@ -68,6 +68,10 @@ class TeOptResult:
     best_max_util: float  # worst-scenario hard MLU at w_best
     losses: np.ndarray  # soft objective per step [steps]
     steps: int
+    # device->host bytes of the trajectory copy-back (one per run); the
+    # TE service folds this into decision.te.d2h_bytes so the TE share of
+    # transfer traffic is observable next to decision.spf.*
+    d2h_bytes: int = 0
 
 
 def _loss_core(
@@ -212,8 +216,11 @@ def optimize_weights(
         rounds=rounds,
         steps=int(cfg.steps),
     )
+    # the whole optimization is one dispatch; this is its single
+    # copy-back (trajectory + losses), accounted like every other d2h
     w_hist = np.asarray(w_hist)
     losses = np.asarray(losses)
+    d2h_bytes = int(w_hist.nbytes + losses.nbytes)
 
     def worst_hard(w_int: np.ndarray) -> float:
         return max(
@@ -256,4 +263,5 @@ def optimize_weights(
         best_max_util=best_util,
         losses=losses,
         steps=int(cfg.steps),
+        d2h_bytes=d2h_bytes,
     )

@@ -145,6 +145,7 @@ class TeService(CountersMixin, HistogramsMixin):
             self._emit_degraded(area)
 
         self._bump("decision.te.steps", result.steps)
+        self._bump("decision.te.d2h_bytes", result.d2h_bytes)
         self.counters["decision.te.steps_last"] = result.steps
         self.counters["decision.te.scenarios_last"] = scenarios
         improved = result.best_max_util < result.initial_max_util

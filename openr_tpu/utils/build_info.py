@@ -33,11 +33,25 @@ def get_analysis_build_info() -> Dict[str, str]:
     """Which static-analysis invariants this binary was linted against
     (the getAnalysisVersion surface: rides ctrl getBuildInfo and `breeze
     openr version`, so deployed daemons self-report their lint contract —
-    docs/Analysis.md)."""
+    docs/Analysis.md). When an analysis ran in this process (the tier-1
+    self-run, a `--changed` pre-commit pass, an operator-triggered run),
+    its cost is surfaced too: total wall time plus per-rule
+    `<rule>=<findings>:<ms>` pairs — analysis cost is observable like
+    every other cost in this codebase."""
     from openr_tpu.analysis import get_analysis_info
 
     meta = get_analysis_info()
-    return {
+    info = {
         "build_analysis_version": meta["analysis_version"],
         "build_analysis_rules": ",".join(meta["analysis_rules"]),
     }
+    if "analysis_wall_ms" in meta:
+        info["build_analysis_wall_ms"] = f"{meta['analysis_wall_ms']:.1f}"
+        info["build_analysis_files"] = str(meta["analysis_files"])
+        info["build_analysis_rule_stats"] = ",".join(
+            f"{name}={stats['findings']}:{stats['ms']:.1f}ms"
+            for name, stats in sorted(
+                meta["analysis_rule_stats"].items()
+            )
+        )
+    return info

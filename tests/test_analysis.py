@@ -650,6 +650,9 @@ def test_self_run_covers_all_rule_families():
         "thread-ownership",
         "blocking-call",
         "registry-drift",
+        "device-transfer",
+        "recompile-risk",
+        "shard-spec",
     }
 
 
@@ -669,3 +672,646 @@ def test_analysis_metadata_surfaces_through_build_info():
     assert set(rules) == set(get_analysis_info()["analysis_rules"])
     assert analysis_main(["--list-rules"]) == 0
     assert analysis_main(["--version"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# DeepFlow (v2.0): callgraph + dataflow infrastructure
+# ---------------------------------------------------------------------------
+
+_XMOD_HELPER = '''
+import numpy as np
+
+
+def helper(x):
+    return np.asarray(x)  # analysis: ignore[trace-safety] — fixture waiver
+'''
+
+_XMOD_ENTRY = '''
+import jax
+import jax.numpy as jnp
+
+from mod_b import helper
+
+
+@jax.jit
+def entry(x):
+    return helper(x) + jnp.sum(x)
+'''
+
+
+def _strip_waivers(src: str) -> str:
+    import re
+
+    return re.sub(r"\s*# analysis: ignore\[[a-z-]+\][^\n]*", "", src)
+
+
+def test_cross_module_reachability_fixture(tmp_path):
+    """ISSUE 8 acceptance: a host sync in a helper that is only traced
+    THROUGH an import (mod_a jits entry -> entry calls mod_b.helper) is a
+    finding — and the file's waiver is the only thing keeping it quiet."""
+    _write(tmp_path, "mod_b.py", _XMOD_HELPER)
+    _write(tmp_path, "mod_a.py", _XMOD_ENTRY)
+    found, suppressed = _findings([tmp_path], rule="trace-safety")
+    assert found == [] and suppressed == 1
+    # remove the suppression: strict analysis fails on the helper's module
+    _write(tmp_path, "mod_b.py", _strip_waivers(_XMOD_HELPER))
+    assert (
+        analysis_main([str(tmp_path), "--no-baseline", "--strict"]) == 1
+    )
+    found, _ = _findings([tmp_path], rule="trace-safety")
+    assert len(found) == 1 and found[0].check == "host-sync"
+    assert found[0].path.endswith("mod_b.py")
+
+
+def test_traced_set_spans_modules_and_excludes_numpy_counterparts():
+    """The package-level traced set (callgraph closure) keeps the
+    DeltaPath extraction kernels and the TE softmin core in, and the hard
+    numpy counterparts out — the ISSUE 8 pin, now at whole-package scope
+    (the per-module pins above would miss a cross-module unhooking)."""
+    from openr_tpu.analysis import build_context
+    from openr_tpu.analysis.trace_safety import traced_function_infos
+
+    ctx = build_context([PKG])
+    traced, direct = traced_function_infos(ctx)
+    names = {(fi.module, fi.name) for fi in traced}
+    assert ("openr_tpu.ops.spf", "_delta_extract") in names
+    assert ("openr_tpu.ops.spf", "_bf_warm_core") in names
+    assert ("openr_tpu.te.objective", "_softmin_fixpoint_core") in names
+    assert ("openr_tpu.te.objective", "_soft_utilization_core") in names
+    assert ("openr_tpu.te.optimizer", "_loss_core") in names
+    for host_side in ("hard_distances", "hard_utilization", "hard_max_util"):
+        assert ("openr_tpu.te.objective", host_side) not in names
+    assert ("openr_tpu.solver.tpu", "prefetch_ksp") not in names
+    direct_names = {(fi.module, fi.name) for fi in direct}
+    assert ("openr_tpu.ops.spf", "_delta_extract") in direct_names
+
+
+def test_callgraph_classifies_solver_producers():
+    """Device-producer classification drives device-transfer: the jit
+    bindings, the factories returning jit callables, and the functions
+    whose return value flows out of one must all classify."""
+    from openr_tpu.analysis import build_context
+    from openr_tpu.analysis.callgraph import build_callgraph
+
+    ctx = build_context([PKG])
+    cg = build_callgraph(ctx)
+    spf = cg.modules["openr_tpu.ops.spf"]
+    assert "_delta_extract" in spf.jit_bindings
+    assert "_bf_fixpoint" in spf.jit_bindings
+    assert "_sell_solver_warm" in spf.factories
+    assert "_sell_solver" in spf.factories
+    assert "batched_spf" in spf.device_fns
+    opt = cg.modules["openr_tpu.te.optimizer"]
+    assert "_adam_solver" in opt.jit_bindings
+
+
+# ---------------------------------------------------------------------------
+# thread-ownership: alias + escape awareness (the ROADMAP example)
+# ---------------------------------------------------------------------------
+
+_ALIAS_BAD = _OWNERSHIP_COMMON + '''
+
+@owned_by("decision-loop")
+class Decision:
+    def __init__(self):
+        self.x = {}
+        self.q = None
+
+    def poke(self):
+        d = self.x
+        d["k"] = 1  # analysis: ignore[thread-ownership] — fixture waiver
+
+    def peek(self):
+        row = self.x
+        return dict(row)
+'''
+
+_ESCAPE_BAD = _OWNERSHIP_COMMON + '''
+
+@owned_by("decision-loop")
+class Decision:
+    def __init__(self):
+        self.x = {}
+        self.q = None
+
+    def poke(self):
+        self.q.put(self.x)
+
+    def peek(self):
+        return self.x
+'''
+
+
+def test_thread_ownership_alias_chain_regression(tmp_path):
+    """The ROADMAP carry-over verbatim: `d = self.x; d[k] = v` inside a
+    ctrl-reachable method of an @owned_by class is a finding, with the
+    alias chain in the message — and fails strict once unwaived."""
+    path = _write(tmp_path, "alias_own.py", _ALIAS_BAD)
+    found, suppressed = _findings([path], rule="thread-ownership")
+    assert found == [] and suppressed == 1
+    # peek's `row = self.x; dict(row)` is a read through an alias: quiet
+    path = _write(
+        tmp_path, "alias_own.py", _strip_waivers(_ALIAS_BAD)
+    )
+    found, _ = _findings([path], rule="thread-ownership")
+    assert [f.check for f in found] == ["aliased-mutation"], found
+    assert "d = self.x" in found[0].message
+    assert "d[...]" in found[0].message
+    assert (
+        analysis_main([str(path), "--no-baseline", "--strict"]) == 1
+    )
+    assert analysis_main([str(path), "--no-baseline"]) == 0  # advisory
+
+
+def test_thread_ownership_escape_to_queue(tmp_path):
+    path = _write(tmp_path, "escape_own.py", _ESCAPE_BAD)
+    found, _ = _findings([path], rule="thread-ownership")
+    assert [f.check for f in found] == ["escaped-state"], found
+    assert "queue" in found[0].message
+    # returning owned state from a sync handler is the ctrl API: quiet
+    assert not any("peek" in f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# device-transfer
+# ---------------------------------------------------------------------------
+
+_DEVICE_BAD = '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def _solve_core(x):
+    return x
+
+
+def consume(x):
+    d = _solve_core(x)
+    out = np.asarray(d)
+    for row in d:
+        pass
+    return float(d[0])
+'''
+
+_DEVICE_GOOD = '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def _solve_core(x):
+    return x
+
+
+class Holder:
+    def fetch(self, x):
+        d = _solve_core(x)
+        out = np.asarray(d)
+        self.d2h_bytes += out.nbytes  # sanctioned seam, by construction
+        return out
+
+
+def scalar_read(x):
+    d = _solve_core(x)
+    return int(d[0])  # int() is the sanctioned 4-byte scalar read
+
+
+def host_only(rows):
+    return np.asarray(rows)  # no device flow: plain host numpy
+'''
+
+_DELTA_PATH_SYNC = '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def _delta_extract_fixture(mask, d):
+    return mask, d
+
+
+def poll_delta(mask, d):
+    cols, dcols = _delta_extract_fixture(mask, d)
+    out = np.asarray(dcols)  # analysis: ignore[device-transfer] — fixture
+    return out
+'''
+
+
+def test_device_transfer_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_dev.py", _DEVICE_BAD)
+    found, _ = _findings([path], rule="device-transfer")
+    checks = sorted(f.check for f in found)
+    assert checks == [
+        "device-iteration", "host-sync", "host-sync",
+    ], found
+    assert any("d = _solve_core(...)" in f.message for f in found)
+
+
+def test_device_transfer_sanctioned_seams_stay_quiet(tmp_path):
+    path = _write(tmp_path, "good_dev.py", _DEVICE_GOOD)
+    found, _ = _findings([path], rule="device-transfer")
+    assert found == [], found
+
+
+def test_device_transfer_host_sync_in_delta_path_fixture(tmp_path):
+    """ISSUE 8 acceptance: the DeltaPath shape — unpack a compacted
+    extraction, np.asarray the columns WITHOUT accounting — fails strict
+    analysis the moment its waiver is removed."""
+    path = _write(tmp_path, "delta_sync.py", _DELTA_PATH_SYNC)
+    found, suppressed = _findings([path], rule="device-transfer")
+    assert found == [] and suppressed == 1
+    path = _write(
+        tmp_path, "delta_sync.py", _strip_waivers(_DELTA_PATH_SYNC)
+    )
+    assert (
+        analysis_main([str(path), "--no-baseline", "--strict"]) == 1
+    )
+    found, _ = _findings([path], rule="device-transfer")
+    assert len(found) == 1 and found[0].check == "host-sync"
+    assert "dcols" in found[0].message
+
+
+def test_device_transfer_quiet_on_shipped_solver_consumers():
+    """The real DeltaPath seams (_AreaSolve.d mirror fetch,
+    _finish_delta's compacted extraction, the KSP/audit fetches) account
+    their bytes and must stay quiet — pinned directly, not only via the
+    package self-run."""
+    targets = [PKG / "solver" / "tpu.py", PKG / "te" / "optimizer.py"]
+    found, _ = _findings(targets, rule="device-transfer")
+    assert found == [], found
+
+
+# ---------------------------------------------------------------------------
+# recompile-risk
+# ---------------------------------------------------------------------------
+
+_RECOMPILE_BAD = '''
+import jax
+
+
+def _core(x, cap):
+    return x
+
+
+solver = jax.jit(_core, static_argnames=("cap",))
+
+
+def dispatch(x):
+    solver(x, cap=len(x))
+    solver(x, len(x) + 1)
+'''
+
+_RECOMPILE_GOOD = '''
+import jax
+
+
+def _next_bucket(n, minimum=8):
+    return max(n, minimum)
+
+
+def _core(x, cap):
+    return x
+
+
+solver = jax.jit(_core, static_argnames=("cap",))
+
+
+def dispatch(x, cfg):
+    cap = _next_bucket(len(x))
+    solver(x, cap=cap)
+    solver(x, cap=cfg.cap)
+    solver(x, cap=min(len(x), 128))
+    solver(x, cap=8)
+'''
+
+_RECOMPILE_SUPPRESSED = '''
+import jax
+
+
+def _core(x, cap):
+    return x
+
+
+solver = jax.jit(_core, static_argnames=("cap",))
+
+
+def dispatch(x):
+    solver(x, cap=len(x))  # analysis: ignore[recompile-risk]
+'''
+
+
+def test_recompile_risk_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_rc.py", _RECOMPILE_BAD)
+    found, _ = _findings([path], rule="recompile-risk")
+    checks = [f.check for f in found]
+    assert checks == ["unbucketed-static", "unbucketed-static"], found
+    # keyword form names the arg, positional form names the position
+    assert any("'cap'" in f.message for f in found)
+    assert any("#1" in f.message for f in found)
+
+
+def test_recompile_risk_bucketing_idioms_stay_quiet(tmp_path):
+    path = _write(tmp_path, "good_rc.py", _RECOMPILE_GOOD)
+    found, _ = _findings([path], rule="recompile-risk")
+    assert found == [], found
+
+
+def test_recompile_risk_suppression_and_severity(tmp_path):
+    path = _write(tmp_path, "waived_rc.py", _RECOMPILE_SUPPRESSED)
+    found, suppressed = _findings([path], rule="recompile-risk")
+    assert found == [] and suppressed == 1
+    bad = _write(tmp_path, "bad_rc.py", _RECOMPILE_BAD)
+    assert analysis_main([str(bad), "--no-baseline"]) == 0  # advisory
+    assert analysis_main([str(bad), "--no-baseline", "--strict"]) == 1
+
+
+def test_recompile_risk_quiet_on_shipped_dispatchers():
+    """_delta_extract's `cap` (bucketed), _adam_solver's n/rounds/steps
+    (config + clamps): the repo's own static-arg call sites are the
+    hardest negative fixtures."""
+    targets = [
+        PKG / "solver" / "tpu.py",
+        PKG / "te" / "optimizer.py",
+        PKG / "te" / "service.py",
+    ]
+    found, _ = _findings(targets, rule="recompile-risk")
+    assert found == [], found
+
+
+# ---------------------------------------------------------------------------
+# shard-spec
+# ---------------------------------------------------------------------------
+
+_SHARD_BAD = '''
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return Mesh(np.array(devices).reshape(shape), axis_names)
+
+
+def solve(a, b, c):
+    return a, b
+
+
+def build(mesh):
+    row = NamedSharding(mesh, P("batchs"))
+    n = mesh.shape["grap"]
+    return jax.jit(
+        solve,
+        in_shardings=(row, row),
+        out_shardings=(row, row, row),
+    )
+'''
+
+_SHARD_GOOD = '''
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return Mesh(np.array(devices).reshape(shape), axis_names)
+
+
+def solve(a, b, c):
+    return a, b
+
+
+def factory():
+    def inner(a, b):
+        return a
+
+    return jax.jit(inner)
+
+
+def build(mesh, shardings):
+    row = NamedSharding(mesh, P("batch", None))
+    n = mesh.shape["batch"]
+    jax.jit(solve, in_shardings=(row, row, row), out_shardings=(row, row))
+    # computed specs are skipped, not guessed at
+    jax.jit(solve, in_shardings=shardings + (row,))
+    return n
+'''
+
+
+def test_shard_spec_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_shard.py", _SHARD_BAD)
+    found, _ = _findings([path], rule="shard-spec")
+    checks = sorted(f.check for f in found)
+    assert checks == [
+        "spec-arity",
+        "spec-arity",
+        "unknown-mesh-axis",
+        "unknown-mesh-axis",
+    ], found
+    msgs = " | ".join(f.message for f in found)
+    assert "'batchs'" in msgs and "'grap'" in msgs
+    assert "2 entries" in msgs and "3 entries" in msgs
+
+
+_SHARD_WAIVED = _SHARD_BAD.replace(
+    "    row = NamedSharding",
+    "    # analysis: ignore[shard-spec]\n    row = NamedSharding",
+).replace(
+    "    n = mesh.shape",
+    "    # analysis: ignore[shard-spec]\n    n = mesh.shape",
+).replace(
+    "    return jax.jit(",
+    "    return jax.jit(  # analysis: ignore[shard-spec]",
+)
+
+
+def test_shard_spec_negative_and_suppression(tmp_path):
+    path = _write(tmp_path, "good_shard.py", _SHARD_GOOD)
+    found, _ = _findings([path], rule="shard-spec")
+    assert found == [], found
+    path = _write(tmp_path, "waived_shard.py", _SHARD_WAIVED)
+    found, suppressed = _findings([path], rule="shard-spec")
+    assert found == [] and suppressed == 4
+
+
+def test_shard_spec_axis_check_disarms_without_vocabulary(tmp_path):
+    """A consumer module using P('batch') with no make_mesh/Mesh literal
+    in scope cannot be judged — the axis check must disarm, not guess."""
+    src = (
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "def build(mesh):\n"
+        "    return NamedSharding(mesh, P('anything'))\n"
+    )
+    path = _write(tmp_path, "consumer.py", src)
+    found, _ = _findings([path], rule="shard-spec")
+    assert found == [], found
+
+
+def test_shard_spec_quiet_on_shipped_mesh_code():
+    targets = [
+        PKG / "parallel" / "mesh.py",
+        PKG / "ops" / "spf.py",
+        PKG / "te" / "optimizer.py",
+    ]
+    found, _ = _findings(targets, rule="shard-spec")
+    assert found == [], found
+
+
+# ---------------------------------------------------------------------------
+# --changed selection, --update-baseline, stale-baseline errors
+# ---------------------------------------------------------------------------
+
+
+def test_changed_closure_selects_dependents(tmp_path):
+    pkg = tmp_path / "pkg"
+    _write(pkg, "mod_b.py", "def helper(x):\n    return x\n")
+    _write(
+        pkg,
+        "mod_a.py",
+        "from mod_b import helper\n\ndef entry(x):\n"
+        "    return helper(x)\n",
+    )
+    _write(pkg, "mod_c.py", "def unrelated():\n    return 1\n")
+    from openr_tpu.analysis.__main__ import changed_closure
+
+    selected = changed_closure(pkg, ["pkg/mod_b.py"], tmp_path)
+    rels = sorted(p.name for p in selected)
+    assert rels == ["mod_a.py", "mod_b.py"]  # dependent pulled in, c not
+    assert changed_closure(pkg, ["pkg/nothing.py"], tmp_path) == []
+
+
+def test_git_changed_files_in_scratch_repo(tmp_path):
+    import subprocess
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=repo, check=True, capture_output=True
+        )
+
+    git("init", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    _write(repo, "a.py", "x = 1\n")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    git("checkout", "-b", "feature")
+    _write(repo, "a.py", "x = 2\n")
+    git("commit", "-am", "edit")
+    _write(repo, "b.py", "y = 1\n")  # untracked counts too
+    from openr_tpu.analysis.__main__ import _git_changed_files
+
+    changed = _git_changed_files(repo)
+    assert changed is not None and set(changed) == {"a.py", "b.py"}
+
+
+def test_update_baseline_round_trip(tmp_path):
+    path = _write(tmp_path, "bad_block.py", _BLOCKING_BAD)
+    baseline = tmp_path / "baseline.txt"
+    assert analysis_main([str(path), "--no-baseline"]) == 1
+    rc = analysis_main(
+        [str(path), "--update-baseline", "--baseline", str(baseline)]
+    )
+    assert rc == 0 and baseline.exists()
+    body = baseline.read_text()
+    assert "blocking-call\t" in body and body.startswith("#")
+    # the rewritten baseline waives exactly the current findings
+    assert (
+        analysis_main([str(path), "--baseline", str(baseline)]) == 0
+    )
+
+
+def test_stale_baseline_entry_is_an_error(tmp_path):
+    """ISSUE 8 acceptance: a waived key no rule produces anymore fails
+    the (full-package) run — a stale waiver could shadow a future
+    regression with the same key."""
+    pkg = tmp_path / "pkg"
+    _write(pkg, "clean.py", "def f():\n    return 1\n")
+    # monitor/monitor.py marks the scan as full-package (core.py), which
+    # is what arms the stale check — partial scans cannot judge staleness
+    _write(pkg, "monitor/monitor.py", "")
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "blocking-call\tpkg/clean.py\tsome finding long since fixed\n"
+    )
+    result = run_analysis([pkg], baseline_path=baseline)
+    assert result["exit_code"] == 1
+    stale = [f for f in result["findings"] if f.check == "stale-entry"]
+    assert len(stale) == 1 and "blocking-call" in stale[0].message
+    # the same baseline against a partial scan is not judged
+    partial = run_analysis([pkg / "clean.py"], baseline_path=baseline)
+    assert partial["exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# registry-drift: the rule table itself
+# ---------------------------------------------------------------------------
+
+_RULE_TABLE_DOC = """# Analysis
+
+| rule | severity | invariant |
+|---|---|---|
+| `trace-safety` | error | documented |
+| `bogus-rule` | error | documented but never registered |
+"""
+
+
+def test_registry_drift_rule_table_both_ways(tmp_path):
+    root = tmp_path / "proj"
+    _write(root, "docs/Analysis.md", _RULE_TABLE_DOC)
+    _write(root, "pkg/monitor/monitor.py", "")
+    ctx = build_context([root / "pkg"], root=root)
+    assert ctx.full_package
+    found = [
+        f
+        for f in RULES["registry-drift"].run(ctx)
+        if f.check in ("undocumented-rule", "ghost-rule")
+    ]
+    ghosts = [f for f in found if f.check == "ghost-rule"]
+    undoc = [f for f in found if f.check == "undocumented-rule"]
+    assert len(ghosts) == 1 and "bogus-rule" in ghosts[0].message
+    # every registered rule except the documented one is reported
+    assert {m for f in undoc for m in [f.message]} and len(undoc) == len(
+        RULES
+    ) - 1
+    assert not any("trace-safety" in f.message for f in undoc)
+
+
+# ---------------------------------------------------------------------------
+# analysis cost through build info
+# ---------------------------------------------------------------------------
+
+
+def test_analysis_cost_surfaces_through_build_info():
+    """ISSUE 8: per-rule finding counts and wall time ride
+    get_build_info -> ctrl getBuildInfo -> `breeze openr version`. The
+    stats reflect the MOST RECENT run in the process, so run one here and
+    compare against its result dict."""
+    from openr_tpu.utils.build_info import get_build_info
+
+    result = run_analysis([PKG / "analysis"])
+    info = get_build_info()
+    assert float(info["build_analysis_wall_ms"]) > 0
+    assert int(info["build_analysis_files"]) == result["files"] > 5
+    stats = dict(
+        pair.split("=", 1)
+        for pair in info["build_analysis_rule_stats"].split(",")
+    )
+    assert set(stats) == set(RULES)
+    for name, value in stats.items():
+        findings, ms = value.split(":")
+        assert int(findings) == result["per_rule"][name]["findings"]
+        assert ms.endswith("ms")
+
+
+def test_analysis_cost_rides_ctrl_get_build_info():
+    from openr_tpu.ctrl.server import CtrlServer
+
+    run_analysis([PKG / "analysis"])
+    handler = CtrlServer.__new__(CtrlServer)
+    info = handler.m_getBuildInfo({})
+    assert "build_analysis_wall_ms" in info
+    assert "build_analysis_rule_stats" in info
+    assert info["build_analysis_version"] == ANALYSIS_VERSION
