@@ -20,7 +20,7 @@ Set BENCH_TOPO=grid for the 1k-node grid config (BASELINE.md config 1, with
 ECMP first-hop DAG extraction fused — config 4 semantics).
 
 Prints one JSON line per metric (SPF/s headline, convergence p95, TE
-optimize latency):
+optimize latency, destination-tiled scale solve):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "baseline": ...}
 plus detail lines on stderr.
 """
@@ -302,6 +302,9 @@ def _apply_smoke_env() -> None:
             ("BENCH_TE_STEPS", "6"),
             ("BENCH_TE_SCENARIOS", "2"),
             ("BENCH_TE_REPEATS", "1"),
+            ("BENCH_SCALE_N", "384"),
+            ("BENCH_SCALE_SOURCES", "8"),
+            ("BENCH_SCALE_FLAPS", "2"),
         )
     )
 
@@ -322,6 +325,9 @@ def _apply_reduced_env() -> None:
             ("BENCH_TE_STEPS", "12"),
             ("BENCH_TE_SCENARIOS", "2"),
             ("BENCH_TE_REPEATS", "1"),
+            ("BENCH_SCALE_N", "20000"),
+            ("BENCH_SCALE_SOURCES", "8"),
+            ("BENCH_SCALE_FLAPS", "2"),
         )
     )
 
@@ -446,6 +452,119 @@ def _bench_te() -> dict:
     }
 
 
+def _bench_scale() -> dict:
+    """Fourth metric line: the destination-tiled 2-D layout at scale — a
+    synthetic WAN cold solve plus a warm link-flap batch with D tiled
+    P('batch', 'graph') over every available device, per-device tile bytes
+    reported next to the [S, n_pad] replica bytes the old row-sharded
+    layout would have pinned per chip. Defaults to the 1M-node config
+    (the ROADMAP "heavy traffic from millions of users" topology class);
+    BENCH_SMOKE / cpu-fallback rounds shrink it so the line is always an
+    availability signal, never a hang."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from openr_tpu.ops.graph import INF, compile_edges
+    from openr_tpu.ops.spf import _tile_solver, _tile_solver_warm
+    from openr_tpu.parallel import make_mesh, tile_graph
+    from openr_tpu.topology import wan_edges
+
+    n = int(os.environ.get("BENCH_SCALE_N", "1000000"))
+    n_sources = int(os.environ.get("BENCH_SCALE_SOURCES", "16"))
+    flaps = int(os.environ.get("BENCH_SCALE_FLAPS", "4"))
+
+    devices = jax.devices()
+    total = 1
+    while total * 2 <= len(devices):
+        total *= 2
+    b_ax = 2 if total >= 4 else 1
+    g_ax = total // b_ax
+    mesh = make_mesh(devices[:total], shape=(b_ax, g_ax))
+
+    t0 = time.time()
+    graph = compile_edges(wan_edges(n, degree=4, seed=5))
+    if graph.n_pad % g_ax:
+        # tiny-n smoke configs can under-run the graph axis; shrink it
+        while g_ax > 1 and graph.n_pad % g_ax:
+            g_ax //= 2
+        mesh = make_mesh(devices[: b_ax * g_ax], shape=(b_ax, g_ax))
+    tiling = tile_graph(graph, g_ax)
+    _note(
+        f"scale: n={graph.n} e={graph.e} (n_pad {graph.n_pad}) built in "
+        f"{time.time()-t0:.1f}s; mesh {dict(mesh.shape)}, tile "
+        f"{graph.n_pad // g_ax} cols x {tiling.e_tile} edges/partition"
+    )
+
+    gs = NamedSharding(mesh, P("graph", None))
+    repl = NamedSharding(mesh, P())
+    rng = np.random.default_rng(11)
+    s_pad = n_sources + (-n_sources) % b_ax
+    rows = rng.choice(graph.n, size=s_pad, replace=False).astype(np.int32)
+    args = (
+        jax.device_put(
+            jnp.asarray(rows), NamedSharding(mesh, P("batch"))
+        ),
+        jax.device_put(jnp.asarray(tiling.src_l), gs),
+        jax.device_put(jnp.asarray(tiling.hseg), gs),
+        jax.device_put(jnp.asarray(tiling.w), gs),
+        jax.device_put(jnp.asarray(tiling.hcols), gs),
+        jax.device_put(jnp.asarray(graph.overloaded), repl),
+    )
+    key = tiling.shape_key() + (graph.n_pad,)
+    solve = _tile_solver(key, mesh)
+    d, rounds = solve(*args)  # compile + first run, excluded
+    t0 = time.time()
+    d, rounds = solve(*args)
+    cold_rounds = int(rounds)  # scalar read forces completion
+    cold_ms = (time.time() - t0) * 1e3
+
+    # warm link-flap batch: metric wiggles on random up edges, each event
+    # one warm dispatch against the resident tile state
+    warm = _tile_solver_warm(key, mesh)
+    ov = args[5]
+    up = np.nonzero(graph.w[: graph.e] < INF)[0]
+    w2_old = args[3]
+    warm_ms = []
+    warm_rounds = []
+    for i in range(max(flaps, 1)):
+        w_new = graph.w.copy()
+        pos = up[rng.integers(len(up))]
+        w_new[pos] = (w_new[pos] + 1 + i) % 100 + 1
+        w2_new = jax.device_put(jnp.asarray(tiling.tile_weights(w_new)), gs)
+        t0 = time.time()
+        d, r, ir, _, num = warm(
+            args[0], args[1], args[2], w2_new, w2_old, args[4], ov, ov, d
+        )
+        warm_rounds.append(int(r) + int(ir))  # forces completion
+        warm_ms.append((time.time() - t0) * 1e3)
+        w2_old = w2_new
+    warm_best = min(warm_ms)
+
+    tile_bytes = (s_pad // b_ax) * (graph.n_pad // g_ax) * 4
+    replica_bytes = s_pad * graph.n_pad * 4
+    _note(
+        f"scale: cold solve {cold_ms:.0f}ms ({cold_rounds} rounds), warm "
+        f"flap best {warm_best:.0f}ms over {len(warm_ms)} event(s); "
+        f"per-device D tile {tile_bytes / 1e6:.1f}MB vs full replica "
+        f"{replica_bytes / 1e6:.1f}MB ({replica_bytes / max(tile_bytes, 1):.0f}x)"
+    )
+    return {
+        "metric": f"scale{graph.n}_tiled_cold_solve_ms",
+        "value": round(cold_ms, 2),
+        "unit": (
+            f"ms cold {s_pad}-source solve ({graph.n}-node WAN, D tiled "
+            f"P('batch','graph') over mesh {dict(mesh.shape)})"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "warm_flap_ms": round(warm_best, 2),
+        "tile_bytes_per_device": tile_bytes,
+        "replica_bytes_per_device": replica_bytes,
+        "mesh": [mesh.shape["batch"], mesh.shape["graph"]],
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -490,6 +609,8 @@ def main(argv=None) -> None:
             results.append(_bench_convergence())
         if os.environ.get("BENCH_TE", "1") == "1":
             results.append(_bench_te())
+        if os.environ.get("BENCH_SCALE", "1") == "1":
+            results.append(_bench_scale())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

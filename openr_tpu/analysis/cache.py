@@ -1,0 +1,164 @@
+"""Persistent import-graph cache: `--changed` without a full re-parse.
+
+A diff-scoped analysis run (`python -m openr_tpu.analysis --changed`) only
+needs the package's *module dependency edges* to close over the touched
+modules' dependents — but computing them used to read and parse every file
+in the package on every invocation. This module persists exactly that
+import surface, keyed by file content hash: per file, its sha256, dotted
+module name, and the modules its import statements bind
+(callgraph.scan_imports — the same edge definition
+CallGraph.module_dependents walks, so the cached closure and the live one
+cannot diverge). An unchanged file is a cache hit (one hash, zero parses);
+an edited file re-parses and overwrites its entry. The cache file lives at
+`<repo>/.analysis-cache.json` (gitignored), versioned so schema changes
+invalidate it wholesale, and written best-effort — a read-only checkout
+just re-parses.
+
+Hit/miss counts surface in the `--changed` stderr note and, under
+`--json`, as the `callgraph_cache` footer of the report.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+CACHE_VERSION = 1
+CACHE_NAME = ".analysis-cache.json"
+
+
+def _module_name_of(rel: str) -> str:
+    """Dotted module name of a package-relative posix path:
+    openr_tpu/ops/spf.py -> openr_tpu.ops.spf; __init__.py collapses onto
+    its package (same convention as callgraph.module_name)."""
+    if rel.endswith(".py"):
+        rel = rel[: -len(".py")]
+    parts = rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _module_deps(tree: ast.AST) -> List[str]:
+    """Modules this tree's import statements bind — the dependency edges
+    module_dependents traverses (from-import source modules plus plain
+    import aliases)."""
+    from openr_tpu.analysis.callgraph import scan_imports
+
+    from_imports, module_aliases = scan_imports(tree)
+    deps: Set[str] = {mod for mod, _ in from_imports.values()}
+    deps.update(module_aliases.values())
+    return sorted(deps)
+
+
+def load_import_graph(
+    package: Path, cache_path: Optional[Path]
+) -> Tuple[Dict[str, Dict], Dict[str, int]]:
+    """The package's module dependency graph, served from the content-hash
+    cache where possible. Returns ({module: {"path", "deps"}}, stats) with
+    stats = {"hits", "misses", "files"}; when cache_path is set the cache
+    file is rewritten with the refreshed entries (best-effort)."""
+    entries: Dict[str, Dict] = {}
+    if cache_path is not None and cache_path.exists():
+        try:
+            cached = json.loads(cache_path.read_text())
+            if cached.get("version") == CACHE_VERSION:
+                entries = cached.get("files", {})
+        except (OSError, ValueError):
+            entries = {}
+    graph: Dict[str, Dict] = {}
+    new_entries: Dict[str, Dict] = {}
+    hits = misses = 0
+    # module names must match the call graph's (rel-to-analysis-root), or
+    # the cached closure and CallGraph.module_dependents would diverge
+    from openr_tpu.analysis.core import _find_root
+
+    root = _find_root([package])
+    for path in sorted(package.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        try:
+            data = path.read_bytes()
+        except OSError:
+            continue
+        digest = hashlib.sha256(data).hexdigest()
+        ent = entries.get(rel)
+        if ent is not None and ent.get("hash") == digest:
+            hits += 1
+            module, deps = ent["module"], list(ent["deps"])
+        else:
+            misses += 1
+            try:
+                tree = ast.parse(data)
+            except SyntaxError:
+                continue  # core.build_context will report it; no edges
+            module = _module_name_of(rel)
+            deps = _module_deps(tree)
+        new_entries[rel] = {"hash": digest, "module": module, "deps": deps}
+        graph[module] = {"path": path, "rel": rel, "deps": deps}
+    if cache_path is not None:
+        _write_cache(cache_path, new_entries)
+    return graph, {"hits": hits, "misses": misses, "files": hits + misses}
+
+
+def _write_cache(cache_path: Path, entries: Dict[str, Dict]) -> None:
+    payload = json.dumps(
+        {"version": CACHE_VERSION, "files": entries}, sort_keys=True
+    )
+    tmp = cache_path.with_name(cache_path.name + ".tmp")
+    try:
+        tmp.write_text(payload)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # read-only checkout: next run re-parses, nothing breaks
+
+
+def dependents_closure(
+    graph: Dict[str, Dict], changed: Iterable[str]
+) -> Set[str]:
+    """Transitive closure of modules importing any of `changed` — the same
+    traversal as CallGraph.module_dependents, on the cached edges."""
+    importers: Dict[str, Set[str]] = {m: set() for m in graph}
+    for mod, info in graph.items():
+        for dep in info["deps"]:
+            if dep in importers:
+                importers[dep].add(mod)
+    out: Set[str] = set()
+    queue = [m for m in changed if m in graph]
+    while queue:
+        cur = queue.pop()
+        if cur in out:
+            continue
+        out.add(cur)
+        queue.extend(importers.get(cur, ()))
+    return out
+
+
+def changed_closure_cached(
+    package: Path,
+    changed_files: List[str],
+    repo_root: Path,
+    cache_path: Optional[Path] = None,
+) -> Tuple[List[Path], Dict[str, int]]:
+    """The `--changed` analysis set (touched package modules plus their
+    call-graph dependents), computed from the persistent import-graph
+    cache. Returns (paths sorted by module name, cache stats)."""
+    if cache_path is None:
+        cache_path = repo_root / CACHE_NAME
+    graph, stats = load_import_graph(package, cache_path)
+    by_path = {info["path"].resolve(): mod for mod, info in graph.items()}
+    changed_modules = []
+    for f in changed_files:
+        mod = by_path.get((repo_root / f).resolve())
+        if mod is not None:
+            changed_modules.append(mod)
+    if not changed_modules:
+        return [], stats
+    selected = dependents_closure(graph, changed_modules)
+    paths = [
+        graph[mod]["path"] for mod in sorted(graph) if mod in selected
+    ]
+    return paths, stats

@@ -141,6 +141,34 @@ def returned_local_defs(fn: ast.AST) -> List[ast.AST]:
     return out
 
 
+def scan_imports(
+    tree: ast.AST,
+) -> Tuple[Dict[str, Tuple[str, str]], Dict[str, str]]:
+    """(from_imports, module_aliases) of one module tree — the import
+    surface both the call graph and the persistent `--changed` cache
+    (openr_tpu/analysis/cache.py) key their dependency edges on."""
+    from_imports: Dict[str, Tuple[str, str]] = {}
+    module_aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for a in node.names:
+                    from_imports[a.asname or a.name] = (
+                        node.module,
+                        a.name,
+                    )
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                # `import a.b.c` binds `a`; `import a.b.c as x` binds
+                # x -> a.b.c. Attribute-chain resolution re-joins the
+                # full path either way.
+                module_aliases[alias] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+    return from_imports, module_aliases
+
+
 class CallGraph:
     """Package-wide function index + import-directed call resolution."""
 
@@ -157,23 +185,7 @@ class CallGraph:
     def _index_module(self, sf: SourceFile) -> None:
         mod = ModuleInfo(name=module_name(sf), sf=sf)
         self.modules[mod.name] = mod
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.ImportFrom):
-                if node.module and node.level == 0:
-                    for a in node.names:
-                        mod.from_imports[a.asname or a.name] = (
-                            node.module,
-                            a.name,
-                        )
-            elif isinstance(node, ast.Import):
-                for a in node.names:
-                    alias = a.asname or a.name.split(".")[0]
-                    # `import a.b.c` binds `a`; `import a.b.c as x` binds
-                    # x -> a.b.c. Attribute-chain resolution re-joins the
-                    # full path either way.
-                    mod.module_aliases[alias] = (
-                        a.name if a.asname else a.name.split(".")[0]
-                    )
+        mod.from_imports, mod.module_aliases = scan_imports(sf.tree)
 
         def index_defs(parent: ast.AST, prefix: str, in_class: bool,
                        enclosing: Optional[FunctionInfo]) -> None:

@@ -141,6 +141,9 @@ class DecisionConfigSection:
     solver_probe_interval_s: float = 5.0
     solver_probe_successes: int = 2
     solver_audit_interval: int = 0
+    # partial-mesh degradation: device-loss streaks shrink the solver
+    # mesh over surviving chips before the breaker trips to the oracle
+    solver_mesh_degrade: bool = True
 
 
 @dataclass

@@ -142,6 +142,9 @@ class DecisionConfig:
     solver_probe_interval_s: float = 5.0
     solver_probe_successes: int = 2
     solver_audit_interval: int = 0
+    # partial-mesh degradation ladder: a device-loss streak re-resolves
+    # the solver mesh over surviving chips before the breaker may open
+    solver_mesh_degrade: bool = True
 
 
 # wall-clock PerfEvent descriptors mapped onto convergence-span stages:
@@ -315,6 +318,7 @@ class Decision(CountersMixin, HistogramsMixin):
                             config.solver_probe_successes
                         ),
                         audit_interval=config.solver_audit_interval,
+                        mesh_degrade=config.solver_mesh_degrade,
                     ),
                     watchdog=watchdog,
                     log_sample_fn=log_sample_fn,

@@ -271,6 +271,7 @@ class OpenrDaemon:
                 solver_probe_interval_s=dc.solver_probe_interval_s,
                 solver_probe_successes=dc.solver_probe_successes,
                 solver_audit_interval=dc.solver_audit_interval,
+                solver_mesh_degrade=dc.solver_mesh_degrade,
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,

@@ -546,6 +546,265 @@ def _bf_warm_core(
 _bf_solver_warm = jax.jit(_bf_warm_core, donate_argnums=(6,))
 
 
+# -- destination-tiled 2-D P('batch', 'graph') kernels ----------------------
+#
+# The row-sharded layouts above keep a full [S, n_pad] distance replica per
+# chip; the tiled kernels below keep only a [S/batch, n_pad/graph] tile and
+# run under shard_map over both mesh axes. Edges are regrouped by SOURCE
+# tile (openr_tpu/parallel/mesh.py:GraphTiling), so every tail read in a
+# relaxation round is tile-local; the per-round cross-chip traffic is the
+# halo exchange: each device's compact per-destination frontier minima
+# (ctr [S_l, h] plus the slot->column map) travel one hop at a time around
+# a lax.ppermute ring along 'graph', and every device scatter-mins the
+# passing frontier into the columns it owns, dropping the rest. Nothing the
+# size of a distance row ever moves.
+
+
+def _tile_fold_min(tile, ctr, cols, me, n_tile):
+    """Fold a frontier into the columns this device owns: cols outside
+    [me*n_tile, (me+1)*n_tile) map to the out-of-range sentinel and are
+    dropped by the scatter (sentinel 1<<30 padding slots included)."""
+    local = cols - me * n_tile
+    local = jnp.where((local >= 0) & (local < n_tile), local, n_tile)
+    return tile.at[:, local].min(ctr, mode="drop")
+
+
+def _tile_halo_min(ctr, cols, base, me, n_tile, g):
+    """The halo exchange: fold every partition's frontier (ctr [S_l, h],
+    cols [h]) into `base` [S_l, n_tile], rotating the frontier g-1 hops
+    around the 'graph' ring. Returns the folded tile; per hop each device
+    forwards only its compact frontier — O(h) per device, never O(n_pad)."""
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    out = _tile_fold_min(base, ctr, cols, me, n_tile)
+    for _ in range(g - 1):
+        ctr = jax.lax.ppermute(ctr, "graph", perm)
+        cols = jax.lax.ppermute(cols, "graph", perm)
+        out = _tile_fold_min(out, ctr, cols, me, n_tile)
+    return out
+
+
+def _tile_seg_min(vals, hseg, h):
+    """Per-frontier-slot minima of per-edge values [S_l, e_tile] -> [S_l, h]
+    (empty slots clamp to INF; hseg is per-tile dst-sorted, so the sorted
+    fast path holds)."""
+    out = jax.vmap(
+        lambda row: jax.ops.segment_min(
+            row, hseg, num_segments=h, indices_are_sorted=True
+        )
+    )(vals)
+    return jnp.minimum(out, INF)
+
+
+def _tile_d0_allow(sources, overloaded, me, n_tile):
+    """Cold initial tile [S_l, n_tile] + the per-source transit mask for
+    the columns this device owns (overloaded nodes relay nothing unless
+    they are the source itself — same semantics as _bf_allow)."""
+    s_l = sources.shape[0]
+    offset = me * n_tile
+    ov_t = jax.lax.dynamic_slice(overloaded, (offset,), (n_tile,))
+    ids = offset + jnp.arange(n_tile, dtype=jnp.int32)
+    allow = (~ov_t)[None, :] | (ids[None, :] == sources[:, None])
+    local = sources - offset
+    local = jnp.where((local >= 0) & (local < n_tile), local, n_tile)
+    d0 = jnp.full((s_l, n_tile), INF, dtype=jnp.int32)
+    d0 = d0.at[jnp.arange(s_l), local].set(0, mode="drop")
+    return d0, allow
+
+
+def _tile_relax(d0, allow, src_l, hseg, w2, hcols, me, *, g, n_tile, n_pad):
+    """Min-plus relaxation of the local tile to the GLOBAL fixpoint.
+
+    Each round relaxes the locally-tailed edges (src_l is tile-local by
+    construction) into compact frontier minima and halo-exchanges them;
+    convergence is the psum of per-device change flags over both mesh
+    axes, so every device leaves the loop in lockstep. Same warm-start
+    contract as _sell_relax/_bf_relax: any entrywise upper bound of the
+    true distances with the source diagonal pinned to 0 is a valid d0."""
+    h = hcols.shape[0]
+
+    def body(state):
+        d, _, it = state
+        dt = jnp.where(allow, d, INF)
+        contrib = jnp.minimum(dt[:, src_l] + w2, INF)  # [S_l, e_tile]
+        ctr = _tile_seg_min(contrib, hseg, h)
+        new_d = _tile_halo_min(ctr, hcols, d, me, n_tile, g)
+        changed = (
+            jax.lax.psum(
+                jnp.any(new_d != d).astype(jnp.int32), ("batch", "graph")
+            )
+            > 0
+        )
+        return new_d, changed, it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n_pad)
+
+    d, _, rounds = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    return d, rounds
+
+
+@functools.lru_cache(maxsize=64)
+def _tile_solver(key: Tuple, mesh):
+    """Cold destination-tiled solve: key = GraphTiling.shape_key() +
+    (n_pad,). (sources, src_l, hseg, w2, hcols, overloaded) -> (D, rounds)
+    with D sharded P('batch', 'graph') — each device keeps only its
+    [S/batch, n_pad/graph] tile."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    g, n_tile, e_tile, h, n_pad = key
+    assert mesh.shape["graph"] == g, (dict(mesh.shape), g)
+
+    def solve(sources, src_l, hseg, w2, hcols, overloaded):
+        me = jax.lax.axis_index("graph")
+        d0, allow = _tile_d0_allow(sources, overloaded, me, n_tile)
+        d, rounds = _tile_relax(
+            d0, allow, src_l[0], hseg[0], w2[0], hcols[0], me,
+            g=g, n_tile=n_tile, n_pad=n_pad,
+        )
+        return d, rounds
+
+    fn = shard_map(
+        solve,
+        mesh=mesh,
+        in_specs=(
+            P("batch"),
+            P("graph", None),
+            P("graph", None),
+            P("graph", None),
+            P("graph", None),
+            P(),
+        ),
+        out_specs=(P("batch", "graph"), P()),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _tile_solver_warm(key: Tuple, mesh):
+    """Warm-start incremental solve on the tiled layout, one dispatch per
+    LSDB event: (sources, src_l, hseg, w2_new, w2_old, hcols, ov_new,
+    ov_old, d_prev) -> (D, rounds, inv_rounds, col_changed, num_changed).
+
+    The invalidation fixpoint is halo-aware: marks cannot be pushed along
+    edges directly (a tail's owner does not hold the head's column), so
+    the old-DAG membership test runs RECEIVER-side on the same frontier
+    machinery as the relaxation. At the old fixpoint every masked tail
+    value satisfies dt_old[u] + w_old >= dp[v], so the min over any edge
+    subset's candidates equals dp[v] exactly when the subset contains an
+    old-DAG edge: each round the devices exchange per-destination minima
+    of dt_old[u] + w_old over marked-tail edges and a device marks the
+    columns where the received min matches its resident dp. Seeds use the
+    same test over the increased-edge set — weight increases derived on
+    device from w2_new > w2_old, plus the out-edges of newly-overloaded
+    nodes, which is how an overload toggle rides the warm path here too
+    (the repair relax then uses the NEW transit mask). Un-overloading
+    only adds paths, so the old tile stays a valid upper bound as-is.
+
+    col_changed comes back sharded P('graph') (each device reports its
+    own columns, reduced over 'batch'); num_changed is the replicated
+    scalar popcount the host reads to size the compacted _delta_extract
+    dispatch — the DeltaPath handshake is unchanged by the resharding."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    g, n_tile, e_tile, h, n_pad = key
+    assert mesh.shape["graph"] == g, (dict(mesh.shape), g)
+
+    def solve(
+        sources, src_l, hseg, w2_new, w2_old, hcols, ov_new, ov_old, d_prev
+    ):
+        me = jax.lax.axis_index("graph")
+        src = src_l[0]
+        seg = hseg[0]
+        wn = w2_new[0]
+        wo = w2_old[0]
+        cols = hcols[0]
+        s_l = sources.shape[0]
+        offset = me * n_tile
+        _, allow_old = _tile_d0_allow(sources, ov_old, me, n_tile)
+        _, allow_new = _tile_d0_allow(sources, ov_new, me, n_tile)
+        dp = d_prev
+        dt_old = jnp.where(allow_old, dp, INF)
+        # per-edge old-DAG candidates; down edges (w_old == INF) clamp to
+        # INF and can never match a finite dp[v]
+        cand = jnp.minimum(dt_old[:, src] + wo, INF)  # [S_l, e_tile]
+        newly_on = ov_new & ~ov_old  # [n_pad] replicated
+        seed_edge = (wn > wo) | newly_on[offset + src]
+        inf_tile = jnp.full((s_l, n_tile), INF, dtype=jnp.int32)
+        ctr0 = _tile_seg_min(jnp.where(seed_edge[None, :], cand, INF), seg, h)
+        recv0 = _tile_halo_min(ctr0, cols, inf_tile, me, n_tile, g)
+        marks0 = (recv0 == dp) & (dp < INF)
+
+        def body(state):
+            m, _, it = state
+            vals = jnp.where(m[:, src], cand, INF)
+            ctr = _tile_seg_min(vals, seg, h)
+            recv = _tile_halo_min(ctr, cols, inf_tile, me, n_tile, g)
+            new_m = m | ((recv == dp) & (dp < INF))
+            changed = (
+                jax.lax.psum(
+                    jnp.any(new_m != m).astype(jnp.int32),
+                    ("batch", "graph"),
+                )
+                > 0
+            )
+            return new_m, changed, it + 1
+
+        def cond(state):
+            _, changed, it = state
+            return changed & (it < n_pad)
+
+        # zero seed marks everywhere -> the loop is skipped whole, so
+        # decrease-only events pay one seed exchange and nothing more
+        any_seed = (
+            jax.lax.psum(
+                jnp.any(marks0).astype(jnp.int32), ("batch", "graph")
+            )
+            > 0
+        )
+        marks, _, inv_rounds = jax.lax.while_loop(
+            cond, body, (marks0, any_seed, 0)
+        )
+        d0 = jnp.where(marks, INF, dp)
+        local = sources - offset
+        local = jnp.where((local >= 0) & (local < n_tile), local, n_tile)
+        d0 = d0.at[jnp.arange(s_l), local].set(0, mode="drop")
+        d, rounds = _tile_relax(
+            d0, allow_new, src, seg, wn, cols, me,
+            g=g, n_tile=n_tile, n_pad=n_pad,
+        )
+        col_changed = jnp.any(d != dp, axis=0)  # [n_tile] this shard
+        col_changed = jax.lax.pmax(col_changed.astype(jnp.int32), "batch") > 0
+        num_changed = jax.lax.psum(
+            jnp.sum(col_changed.astype(jnp.int32)), "graph"
+        )
+        return d, rounds, inv_rounds, col_changed, num_changed
+
+    fn = shard_map(
+        solve,
+        mesh=mesh,
+        in_specs=(
+            P("batch"),
+            P("graph", None),
+            P("graph", None),
+            P("graph", None),
+            P("graph", None),
+            P("graph", None),
+            P(),
+            P(),
+            P("batch", "graph"),
+        ),
+        out_specs=(P("batch", "graph"), P(), P(), P("graph"), P()),
+        check_rep=False,
+    )
+    # d_prev is donated: the caller always replaces its resident handle
+    # and the output tile matches its shape and sharding exactly
+    return jax.jit(fn, donate_argnums=(8,))
+
+
 @functools.partial(jax.jit, static_argnames=("cap",))
 def _delta_extract(
     col_changed: jnp.ndarray,  # bool [N] device-resident changed-dest mask
@@ -763,6 +1022,8 @@ def compile_cache_stats() -> dict:
         _sell_solver_warm,
         _sell_solver_vw,
         _bf_vw_solver,
+        _tile_solver,
+        _tile_solver_warm,
     ):
         info = fn.cache_info()
         hits += info.hits

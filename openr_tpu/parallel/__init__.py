@@ -4,20 +4,37 @@ Scaling axes (the TPU analog of the reference's parallelism, SURVEY.md §2.4):
   - 'batch': the multi-source batch dimension — each device relaxes its slice
     of sources with the edge list replicated (pure data parallelism, no
     cross-chip traffic inside a relaxation round)
-  - 'graph': the edge dimension of the ECMP first-hop DAG extraction —
-    sharding the per-edge work for very large LSDBs
+  - 'graph': the destination/node dimension — with a graph axis bigger than
+    one the distance matrix is tiled P('batch', 'graph') and relaxation
+    rounds exchange only per-partition frontier minima around a ppermute
+    ring (GraphTiling / tile_graph + the ops.spf tiled kernels); the same
+    axis also shards the per-edge ECMP DAG extraction work
+
+plan_degraded_mesh walks the partial-mesh degradation ladder after a
+device-loss fault: the largest strictly-smaller (batch, graph)
+factorization over the chips still answering probes (docs/Robustness.md).
 """
 
 from openr_tpu.parallel.mesh import (
+    GraphTiling,
     make_mesh,
+    plan_degraded_mesh,
     resolve_mesh,
     sharded_batched_spf,
     sharded_spf_step,
+    shrink_candidates,
+    surviving_devices,
+    tile_graph,
 )
 
 __all__ = [
+    "GraphTiling",
     "make_mesh",
+    "plan_degraded_mesh",
     "resolve_mesh",
     "sharded_batched_spf",
     "sharded_spf_step",
+    "shrink_candidates",
+    "surviving_devices",
+    "tile_graph",
 ]

@@ -1,4 +1,4 @@
-"""Mesh construction and sharded SPF steps (pjit/GSPMD).
+"""Mesh construction, graph-axis tiling and sharded SPF steps (pjit/GSPMD).
 
 The batched min-plus solve shards its sources axis across the 'batch' mesh
 axis: D [S, N] is row-sharded, the (small) edge list is replicated, so each
@@ -15,18 +15,33 @@ invalidation boolean fixpoint runs on the same dest-major layout as the
 relaxation rounds (source axis minor, sharded), and the fixed-shape patch /
 increased-edge index arrays are replicated — so a meshed link-flap event is
 still a single collective-free dispatch per chip until D is consumed.
+
+Destination tiling (the 2-D P('batch', 'graph') layout): when the mesh has a
+'graph' axis bigger than one, the row-sharded replica above stops scaling —
+every chip still holds all n_pad destination columns. `GraphTiling`
+partitions the destination/node axis into `graph`-many contiguous column
+tiles and regroups the edge list by SOURCE tile so each device relaxes only
+the edges whose tail it owns, contributing per-destination minima into a
+compact per-tile frontier. Between relaxation rounds the frontiers — not
+the distance rows — move one hop around a `lax.ppermute` ring along the
+'graph' axis (the halo exchange); each device folds the passing frontier
+into the columns it owns with a scatter-min and drops the rest. Persistent
+per-device distance state shrinks from the full [S, n_pad] replica to a
+[S/batch, n_pad/graph] tile (docs/Decision.md "Distance layout and halo
+exchange").
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from openr_tpu.ops.graph import CompiledGraph
+from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 from openr_tpu.ops.spf import _bf_fixpoint, _ecmp_dag, _sell_solver_raw
 
 
@@ -64,6 +79,149 @@ def resolve_mesh(spec) -> Optional[Mesh]:
             f"solver_mesh {shape} needs {n} devices, have {len(devices)}"
         )
     return make_mesh(devices[:n], shape=shape)
+
+
+def shrink_candidates(shape: Tuple[int, int]) -> List[Tuple[int, int]]:
+    """Degradation ladder below a (batch, graph) mesh shape: every strictly
+    smaller power-of-two factorization, largest first, preferring to keep
+    the graph axis (the destination tiling is the memory win worth
+    preserving; batch rows re-pad cheaply)."""
+    b, g = shape
+    total = b * g
+    out: List[Tuple[int, int]] = []
+    new_total = total // 2
+    while new_total >= 1:
+        new_g = min(g, new_total)
+        out.append((new_total // new_g, new_g))
+        new_total //= 2
+    return out
+
+
+def surviving_devices(devices: Sequence) -> List:
+    """The subset of `devices` that still answers a trivial dispatch — the
+    partial-mesh degradation probe (docs/Robustness.md). A dead chip fails
+    the put or the scalar read; both classify it out of the next mesh."""
+    alive = []
+    for dev in devices:
+        try:
+            x = jax.device_put(np.int32(1), dev)
+            if int(x) == 1:
+                alive.append(dev)
+        except Exception:  # noqa: BLE001 — any failure means "not viable"
+            continue
+    return alive
+
+
+def plan_degraded_mesh(mesh: Mesh) -> Optional[Mesh]:
+    """The next rung of the partial-mesh degradation ladder: the largest
+    strictly-smaller (batch, graph) factorization that fits the devices
+    still answering probes. None when no viable smaller mesh remains (a
+    single-device mesh has no rung below it — the caller falls back to
+    the CPU oracle)."""
+    shape = (mesh.shape["batch"], mesh.shape["graph"])
+    alive = surviving_devices(list(mesh.devices.flat))
+    for b, g in shrink_candidates(shape):
+        if b * g <= len(alive):
+            return make_mesh(alive[: b * g], shape=(b, g))
+    return None
+
+
+@dataclass
+class GraphTiling:
+    """Destination-tiled edge layout for the 2-D P('batch', 'graph') solve.
+
+    The node axis is split into `g` contiguous column tiles of `n_tile`
+    ids each (n_pad is a power of two, so g | n_pad whenever g is).
+    Edges are grouped by the tile that owns their SOURCE node — the tail
+    values a relaxation round reads are then always tile-local — and
+    padded to a uniform `e_tile` per partition so the stacked arrays
+    shard P('graph', None). Each partition's distinct destination columns
+    are compacted into `h` frontier slots: `hseg` maps each edge to its
+    slot, `hcols` maps slots back to global columns (sentinel 1<<30 =
+    unused/padding, dropped by the halo fold). Slot h-1 is reserved for
+    padding edges so a full frontier can never alias one.
+    """
+
+    g: int  # graph-axis partitions
+    n_tile: int  # destination columns per partition
+    e_tile: int  # padded edges per partition (power-of-two bucket)
+    h: int  # padded frontier slots per partition
+    e: int  # real directed edge count (graph.e)
+    src_l: np.ndarray  # int32 [g, e_tile] tile-LOCAL source ids (pad 0)
+    hseg: np.ndarray  # int32 [g, e_tile] per-edge frontier slot (pad h-1)
+    w: np.ndarray  # int32 [g, e_tile] edge weights (pad INF)
+    hcols: np.ndarray  # int32 [g, h] global column per slot (pad 1<<30)
+    edge_tile: np.ndarray  # int32 [e] dst-sorted edge pos -> partition
+    edge_pos: np.ndarray  # int32 [e] dst-sorted edge pos -> slot in e_tile
+
+    def shape_key(self) -> Tuple:
+        """Static structure key: tilings with equal keys share the jitted
+        tiled-solver executables (weight patches never change it)."""
+        return (self.g, self.n_tile, self.e_tile, self.h)
+
+    def tile_weights(self, w_edges: np.ndarray) -> np.ndarray:
+        """[e_pad] dst-sorted edge weights -> the [g, e_tile] tiled form
+        (padding slots stay INF) — the per-event weight upload unit."""
+        out = np.full((self.g, self.e_tile), INF, dtype=np.int32)
+        out[self.edge_tile, self.edge_pos] = w_edges[: self.e]
+        return out
+
+
+def tile_graph(graph: CompiledGraph, g: int) -> GraphTiling:
+    """Partition a compiled graph's edge list by source tile for a
+    'graph'-axis of size g. Requires g | n_pad (both are powers of two in
+    practice; callers fall back to the row-sharded layout otherwise)."""
+    n_pad = graph.n_pad
+    assert n_pad % g == 0, (n_pad, g)
+    n_tile = n_pad // g
+    e = graph.e
+    src = graph.src[:e]
+    dst = graph.dst[:e]
+    w = graph.w[:e]
+    tile_of = (src // n_tile).astype(np.int64) if e else np.empty(0, np.int64)
+    counts = np.bincount(tile_of, minlength=g) if e else np.zeros(g, int)
+    e_tile = _next_bucket(int(counts.max()) if e else 1, minimum=8)
+    per_tile = []
+    max_u = 0
+    for t in range(g):
+        idx = np.nonzero(tile_of == t)[0]
+        # the global edge array is dst-sorted, so each partition's
+        # subsequence stays dst-sorted: slots are assigned in ascending
+        # destination order and hseg is non-decreasing — segment_min's
+        # sorted fast path holds per tile
+        uniq, seg = np.unique(dst[idx], return_inverse=True)
+        per_tile.append((idx, uniq, seg))
+        max_u = max(max_u, len(uniq))
+    h = _next_bucket(max_u + 1, minimum=8)  # +1 reserves the padding slot
+    src_l = np.zeros((g, e_tile), dtype=np.int32)
+    hseg = np.full((g, e_tile), h - 1, dtype=np.int32)
+    w2 = np.full((g, e_tile), INF, dtype=np.int32)
+    hcols = np.full((g, h), 1 << 30, dtype=np.int32)
+    edge_tile = np.zeros(e, dtype=np.int32)
+    edge_pos = np.zeros(e, dtype=np.int32)
+    for t, (idx, uniq, seg) in enumerate(per_tile):
+        k = len(idx)
+        if not k:
+            continue
+        src_l[t, :k] = src[idx] - t * n_tile
+        hseg[t, :k] = seg
+        w2[t, :k] = w[idx]
+        hcols[t, : len(uniq)] = uniq
+        edge_tile[idx] = t
+        edge_pos[idx] = np.arange(k, dtype=np.int32)
+    return GraphTiling(
+        g=g,
+        n_tile=n_tile,
+        e_tile=e_tile,
+        h=h,
+        e=e,
+        src_l=src_l,
+        hseg=hseg,
+        w=w2,
+        hcols=hcols,
+        edge_tile=edge_tile,
+        edge_pos=edge_pos,
+    )
 
 
 def _pad_sources(source_rows: np.ndarray, multiple: int) -> np.ndarray:

@@ -137,6 +137,12 @@ class SupervisorConfig:
     probe_successes_to_close: int = 2
     # shadow cold-audit every Nth successful primary solve; 0 disables
     audit_interval: int = 0
+    # partial-mesh degradation: when a device-loss streak reaches the
+    # failure threshold on a multi-chip solver_mesh, re-resolve the mesh
+    # over the surviving chips (smaller batch x graph factorization)
+    # instead of tripping straight to the CPU oracle; the breaker only
+    # opens when no viable mesh remains (docs/Robustness.md ladder)
+    mesh_degrade: bool = True
     # watchdog heartbeat name stamped around solves
     watchdog_module: str = "decision"
 
@@ -493,6 +499,8 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         self.consecutive_failures = 0
 
     def _trip(self) -> None:
+        if self._try_mesh_degrade():
+            return  # still CLOSED, serving from the smaller mesh
         log.error(
             "solver circuit breaker TRIPPED after %d consecutive failures "
             "(last fault: %s); serving from CPU oracle",
@@ -513,6 +521,43 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             {"fault_kind": self.last_fault_kind or ""},
             {"consecutive_failures": self.consecutive_failures},
         )
+
+    def _try_mesh_degrade(self) -> bool:
+        """One rung of the partial-mesh degradation ladder: on a
+        device-loss streak that would trip the breaker, ask the primary to
+        re-resolve its mesh over the surviving chips first. A successful
+        degradation resets the failure streak and keeps the breaker CLOSED
+        — hardware loss costs capacity, not the device path; the CPU
+        oracle is the LAST rung, reached only when no viable mesh remains
+        (or the fault is not device loss, where a smaller mesh would not
+        help)."""
+        if not self.config.mesh_degrade:
+            return False
+        if self.last_fault_kind != FAULT_DEVICE_LOSS:
+            return False
+        degrade = getattr(self.primary, "degrade_mesh", None)
+        if degrade is None or not degrade():
+            return False
+        mesh = getattr(self.primary, "mesh", None)
+        shape = dict(mesh.shape) if mesh is not None else None
+        log.error(
+            "solver mesh degraded after %d consecutive device-loss "
+            "failures; re-resolved over surviving chips as %s",
+            self.consecutive_failures,
+            shape,
+        )
+        failures = self.consecutive_failures
+        self.consecutive_failures = 0
+        self._sync_backend_stats(self.primary)
+        self._emit_sample(
+            "SOLVER_MESH_DEGRADED",
+            {"mesh_shape": str(shape or {})},
+            {
+                "consecutive_failures": failures,
+                "mesh_devices": int(mesh.devices.size) if mesh else 0,
+            },
+        )
+        return True
 
     def _close(self) -> None:
         log.warning(
@@ -682,9 +727,14 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
     def health(self) -> Dict:
         """Degraded-flag surface served by ctrl getSolverHealth and
         `breeze decision solver-health`."""
+        mesh = getattr(self.primary, "mesh", None)
         return {
             "degraded": self.state != CLOSED,
             "breaker_state": self.state,
+            "solver_mesh": dict(mesh.shape) if mesh is not None else None,
+            "mesh_degradations": self.counters.get(
+                "decision.spf.mesh_degradations", 0
+            ),
             "fallback_active": int(self.state != CLOSED),
             "consecutive_failures": self.consecutive_failures,
             "probe_streak": self.probe_streak,

@@ -179,6 +179,13 @@ class _AreaSolve:
         self.last_solve_warm = False
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        # halo-exchange accounting (the 2-D tiled layout's cross-chip
+        # traffic): ring-rotation count of the last solve and cumulative
+        # frontier bytes moved between chips — the destination-sharded
+        # analog of the d2h/h2d counters (docs/Monitoring.md)
+        self.halo_bytes = 0
+        self.halo_exchanges_last: Optional[int] = None
+        self._halo_synced = 0
         # DeltaPath (device-side route-delta extraction) accounting: the
         # changed-destination columns and copy-back bytes of extraction
         # dispatches — d2h_bytes grows by delta_bytes on the delta path and
@@ -278,7 +285,9 @@ class _AreaSolve:
         self._last_solve_delta = None  # set by a qualifying resident solve
         t0 = time.perf_counter()
         self.h2d_bytes += rows.nbytes
-        if self.graph.sell is not None:
+        if self._use_tiled():
+            self._d_dev, self.rounds_last = self._tile_solve_resident(rows)
+        elif self.graph.sell is not None:
             self._d_dev, self.rounds_last = self._sell_solve_resident(rows)
         elif self.mesh is not None:
             from openr_tpu.parallel import sharded_batched_spf
@@ -309,6 +318,173 @@ class _AreaSolve:
         # corruption seam (ctx = this solve): the warm-state audit tests
         # perturb the resident D here to prove divergence detection works
         fault_point("solver.tpu.warm_d", self)
+
+    def _use_tiled(self) -> bool:
+        """The destination-tiled P('batch', 'graph') layout serves whenever
+        the mesh has a real graph axis and it divides the padded node
+        count (both are powers of two in practice). A graph axis of one
+        has nothing to tile — the row-sharded replica layouts keep it."""
+        return (
+            self.mesh is not None
+            and self.mesh.shape["graph"] > 1
+            and self.graph.n_pad % self.mesh.shape["graph"] == 0
+        )
+
+    def _graph_sharded(self, x):
+        """Device placement for a per-partition tiled buffer: leading dim
+        split over the mesh 'graph' axis, replicated over 'batch'."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            jnp.asarray(x), NamedSharding(self.mesh, P("graph", None))
+        )
+
+    def _account_halo(self, exchanges: int) -> None:
+        """Fold one tiled solve's ring traffic into the halo counters:
+        `exchanges` ppermute rotations ran, and per rotation every device
+        forwarded its compact frontier (ctr [S_l, h] int32) plus the
+        slot->column map ([h] int32)."""
+        tiling = self._dev["tiling"]
+        b = self.mesh.shape["batch"]
+        g = self.mesh.shape["graph"]
+        s_l = max(len(self._dev["rows"]) // max(b, 1), 1)
+        payload = (s_l * tiling.h + tiling.h) * 4
+        self.halo_exchanges_last = exchanges
+        self.halo_bytes += exchanges * b * g * payload
+
+    def _tile_solve_resident(self, rows: np.ndarray):
+        """Destination-tiled solve against persistent device buffers;
+        returns (device distance matrix [s_pad, n_pad] sharded
+        P('batch', 'graph'), relaxation rounds).
+
+        Persistent state per device is a [s_pad/batch, n_pad/graph] tile
+        plus its partition's slice of the tiled edge arrays — no chip holds
+        the full destination axis. The warm event path uploads the whole
+        [g, e_tile] tiled weight array (the layout's native patch unit,
+        like the edge-list form) and lets the device classify increases
+        against the resident copy; overload toggles ride the same warm
+        invalidation (newly-overloaded out-edges become seed edges, the
+        repair relax uses the new transit mask), so only structural
+        rebuilds and source-batch changes force a cold solve."""
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.spf import _tile_solver, _tile_solver_warm
+        from openr_tpu.parallel import tile_graph
+
+        g = self.graph
+        g_ax = self.mesh.shape["graph"]
+        st = self._dev
+        if (
+            st is None
+            or st.get("kind") != "tile2d"
+            or st["src_ref"] is not g.src
+        ):
+            tiling = tile_graph(g, g_ax)
+            st = self._dev = {
+                "kind": "tile2d",
+                "src_ref": g.src,
+                "tiling": tiling,
+                "src_l": self._graph_sharded(tiling.src_l),
+                "hseg": self._graph_sharded(tiling.hseg),
+                "w2": self._graph_sharded(tiling.tile_weights(g.w)),
+                "hcols": self._graph_sharded(tiling.hcols),
+                "ov": self._replicated(g.overloaded),
+                "w_host": g.w.copy(),
+                "w_ver": g.version,
+                "ov_host": g.overloaded.copy(),
+                "rows": np.array(rows),
+            }
+            self.h2d_bytes += (
+                tiling.src_l.nbytes
+                + tiling.hseg.nbytes
+                + tiling.w.nbytes
+                + tiling.hcols.nbytes
+                + g.overloaded.nbytes
+            )
+        else:
+            tiling = st["tiling"]
+            ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
+            rows_same = np.array_equal(st["rows"], rows)
+            st["rows"] = np.array(rows)
+            if (
+                g.changed_edges is not None
+                and g.parent_version == st.get("w_ver")
+            ):
+                cand = g.changed_edges
+                changed = cand[st["w_host"][cand] != g.w[cand]]
+            else:
+                changed = np.nonzero(st["w_host"][: g.e] != g.w[: g.e])[0]
+            st["w_ver"] = g.version
+            if (
+                self.warm_start
+                and rows_same
+                and (len(changed) or ov_changed)
+                and self._d_dev is not None
+            ):
+                w2_new = self._graph_sharded(tiling.tile_weights(g.w))
+                self.h2d_bytes += tiling.w.nbytes
+                ov_new = st["ov"]
+                if ov_changed:
+                    ov_new = self._replicated(g.overloaded)
+                    self.h2d_bytes += g.overloaded.nbytes
+                # DeltaPath qualification: same contract as the other
+                # layouts — my own out-link metrics and the transit mask
+                # feed the route build outside D, so events touching
+                # either cannot be described by changed columns alone
+                delta_ok = not ov_changed and not np.any(
+                    g.src[changed] == rows[0]
+                )
+                fn = _tile_solver_warm(
+                    tiling.shape_key() + (g.n_pad,), self.mesh
+                )
+                d, rounds, inv_rounds, col_changed, num_changed = fn(
+                    jnp.asarray(rows, dtype=jnp.int32),
+                    st["src_l"],
+                    st["hseg"],
+                    w2_new,
+                    st["w2"],
+                    st["hcols"],
+                    ov_new,
+                    st["ov"],
+                    self._d_dev,
+                )
+                st["w2"] = w2_new
+                st["w_host"] = g.w.copy()
+                st["ov"] = ov_new
+                st["ov_host"] = g.overloaded.copy()
+                self.incremental_solves += 1
+                self.invalidation_rounds_last = int(inv_rounds)
+                rounds = int(rounds)
+                # seed exchange + one ring per invalidation and relax round
+                self._account_halo(
+                    (g_ax - 1) * (1 + int(inv_rounds) + rounds)
+                )
+                self._finish_delta(col_changed, num_changed, d, delta_ok)
+                return d, rounds
+            if len(changed):
+                st["w2"] = self._graph_sharded(tiling.tile_weights(g.w))
+                st["w_host"] = g.w.copy()
+                self.h2d_bytes += tiling.w.nbytes
+            if ov_changed:
+                st["ov"] = self._replicated(g.overloaded)
+                st["ov_host"] = g.overloaded.copy()
+                self.h2d_bytes += g.overloaded.nbytes
+
+        fn = _tile_solver(st["tiling"].shape_key() + (g.n_pad,), self.mesh)
+        d, rounds = fn(
+            jnp.asarray(rows, dtype=jnp.int32),
+            st["src_l"],
+            st["hseg"],
+            st["w2"],
+            st["hcols"],
+            st["ov"],
+        )
+        self.full_solves += 1
+        rounds = int(rounds)
+        self._account_halo((g_ax - 1) * rounds)
+        return d, rounds
 
     def _sell_solve_resident(self, rows: np.ndarray):
         """Sliced-ELL solve against persistent device buffers; returns
@@ -819,7 +995,12 @@ class _AreaSolve:
                     pos.extend((fwd, rev))
                 mask_positions.append(pos)
             mask_positions.extend([[] for _ in range(s_pad - len(todo))])
-            dev = self._dev  # persistent buffers, synced by _solve()
+            # persistent buffers, synced by _solve() — only the sliced-ELL
+            # resident state carries them (the tiled 2-D layout keeps a
+            # different buffer set; KSP re-uploads the sell layout there)
+            dev = self._dev
+            if dev is not None and dev.get("kind") != "sell":
+                dev = None
             d_rows = np.asarray(
                 sell_fixpoint_masked(
                     self.graph.sell,
@@ -1028,6 +1209,16 @@ class TpuSpfSolver(SpfSolver):
         if d_bytes:
             solve._delta_bytes_synced = solve.delta_bytes
             self._bump("decision.spf.delta_bytes", d_bytes)
+        # halo-exchange traffic of the destination-tiled layout: ring
+        # rotations of the last solve (gauge) + cumulative frontier bytes
+        d_halo = solve.halo_bytes - solve._halo_synced
+        if d_halo:
+            solve._halo_synced = solve.halo_bytes
+            self._bump("decision.spf.halo_bytes", d_halo)
+        if solve.halo_exchanges_last is not None:
+            counters["decision.spf.halo_exchanges_last"] = (
+                solve.halo_exchanges_last
+            )
         if (
             solve.delta_extracts > solve._delta_extracts_synced
             and solve.delta_extract_ms_last is not None
@@ -1072,6 +1263,32 @@ class TpuSpfSolver(SpfSolver):
         return changed if ok else None
 
     # -- fault domain (SolverSupervisor seams) ---------------------------
+
+    def degrade_mesh(self) -> bool:
+        """Partial-mesh degradation: re-resolve the solver mesh over the
+        surviving chips — the largest strictly-smaller (batch, graph)
+        factorization that still answers probes — instead of tripping all
+        the way to the CPU oracle on a single-chip loss. Returns whether a
+        smaller mesh was installed; False means no viable mesh remains
+        (single-device mesh, or no mesh at all) and the caller should trip.
+
+        Warm state cannot be re-tiled across mesh shapes (tile ownership
+        and frontier slots are functions of the factorization), so every
+        cached solve is dropped and the next event cold-starts on the new
+        mesh — re-tiled-or-cold, never silently wrong (docs/Decision.md)."""
+        if self.mesh is None:
+            return False
+        from openr_tpu.parallel import plan_degraded_mesh
+
+        new_mesh = plan_degraded_mesh(self.mesh)
+        if new_mesh is None:
+            return False
+        self.mesh = new_mesh
+        self._solves.clear()
+        counters = self._ensure_counters()
+        self._bump("decision.spf.mesh_degradations")
+        counters["decision.spf.mesh_devices"] = int(new_mesh.devices.size)
+        return True
 
     def invalidate_warm_state(self) -> None:
         """Drop every cached device solve: the next build_route_db

@@ -180,6 +180,27 @@ def test_trace_safety_reaches_delta_extraction_functions():
     assert {"_bf_relax", "_bf_allow"} <= traced_names
 
 
+def test_trace_safety_reaches_tiled_kernels():
+    """Regression (ISSUE 9): the destination-tiled shard_map kernels and
+    their halo-exchange helpers must sit inside the rule's traced set —
+    they run under jit(shard_map(...)) and a Python branch on a traced
+    value there would only surface on a real multi-chip mesh."""
+    import ast
+
+    from openr_tpu.analysis.trace_safety import _traced_functions
+
+    tree = ast.parse((PKG / "ops" / "spf.py").read_text())
+    traced, _ = _traced_functions(tree)
+    traced_names = {fn.name for fn in traced}
+    assert {
+        "_tile_relax",
+        "_tile_halo_min",
+        "_tile_fold_min",
+        "_tile_seg_min",
+        "_tile_d0_allow",
+    } <= traced_names
+
+
 def test_trace_safety_reaches_te_grad_functions():
     """Regression (ISSUE 7): the differentiable-TE core must sit inside
     the rule's traced set. The softmin fixpoint and utilization kernels
@@ -1178,6 +1199,92 @@ def test_changed_closure_selects_dependents(tmp_path):
     rels = sorted(p.name for p in selected)
     assert rels == ["mod_a.py", "mod_b.py"]  # dependent pulled in, c not
     assert changed_closure(pkg, ["pkg/nothing.py"], tmp_path) == []
+
+
+def _scratch_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    _write(pkg, "mod_b.py", "def helper(x):\n    return x\n")
+    _write(
+        pkg,
+        "mod_a.py",
+        "from mod_b import helper\n\ndef entry(x):\n"
+        "    return helper(x)\n",
+    )
+    _write(pkg, "mod_c.py", "def unrelated():\n    return 1\n")
+    return pkg
+
+
+def test_changed_closure_cache_hit_miss(tmp_path):
+    """The persistent import-graph cache: first run parses everything,
+    the second is pure hash hits, an edit re-parses exactly that file —
+    and the cached closure always equals the uncached reference."""
+    from openr_tpu.analysis.__main__ import changed_closure
+    from openr_tpu.analysis.cache import changed_closure_cached
+
+    pkg = _scratch_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    sel1, s1 = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert s1 == {"hits": 0, "misses": 3, "files": 3}
+    assert sorted(p.name for p in sel1) == ["mod_a.py", "mod_b.py"]
+    assert cache.exists()
+    sel2, s2 = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert s2 == {"hits": 3, "misses": 0, "files": 3}
+    assert sel2 == sel1
+    # the cached closure is pinned to the uncached reference
+    ref = changed_closure(pkg, ["pkg/mod_b.py"], tmp_path)
+    assert sorted(map(str, sel2)) == sorted(map(str, ref))
+    # an edit re-parses only the touched file, and a NEW dependency edge
+    # (c now imports b) changes the closure through the refreshed entry
+    _write(
+        pkg,
+        "mod_c.py",
+        "from mod_b import helper\n\ndef unrelated():\n"
+        "    return helper(1)\n",
+    )
+    sel3, s3 = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert s3 == {"hits": 2, "misses": 1, "files": 3}
+    assert sorted(p.name for p in sel3) == [
+        "mod_a.py", "mod_b.py", "mod_c.py",
+    ]
+    # untouched / unknown files select nothing, stats still returned
+    sel4, _ = changed_closure_cached(pkg, ["pkg/nothing.py"], tmp_path, cache)
+    assert sel4 == []
+
+
+def test_changed_closure_cache_survives_corruption(tmp_path):
+    from openr_tpu.analysis.cache import changed_closure_cached
+
+    pkg = _scratch_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    sel, stats = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert stats["misses"] == 3  # wholesale re-parse, no crash
+    assert sorted(p.name for p in sel) == ["mod_a.py", "mod_b.py"]
+    # a version bump invalidates entries wholesale
+    import json
+
+    payload = json.loads(cache.read_text())
+    payload["version"] = -1
+    cache.write_text(json.dumps(payload))
+    _, stats = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert stats["misses"] == 3
+
+
+def test_changed_closure_cached_matches_uncached_on_package(tmp_path):
+    """Parity on the real package: the cached closure (content-hash import
+    graph) and the uncached reference (full CallGraph) must select the
+    same module set for a hot ops-layer edit."""
+    from openr_tpu.analysis.__main__ import changed_closure
+    from openr_tpu.analysis.cache import changed_closure_cached
+
+    root = PKG.parent
+    changed = ["openr_tpu/ops/graph.py"]
+    cache = tmp_path / "cache.json"  # fresh: every module parses once
+    sel_cached, stats = changed_closure_cached(PKG, changed, root, cache)
+    sel_ref = changed_closure(PKG, changed, root)
+    assert sorted(map(str, sel_cached)) == sorted(map(str, sel_ref))
+    assert stats["files"] == stats["misses"]
+    assert len(sel_cached) > 5  # the ops layer has real dependents
 
 
 def test_git_changed_files_in_scratch_repo(tmp_path):

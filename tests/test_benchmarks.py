@@ -119,7 +119,9 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 3, "bench.py must print SPF+convergence+TE JSON lines"
+    assert len(out) >= 4, (
+        "bench.py must print SPF+convergence+TE+scale JSON lines"
+    )
     results = [json.loads(line) for line in out]
     for result in results:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
@@ -132,6 +134,17 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert results[1]["spans"] > 0
     assert results[2]["metric"] == "te_optimize_ms"
     assert results[2]["initial_max_util"] >= results[2]["optimized_max_util"]
+    # the destination-tiled scale line: per-device tile bytes must sit a
+    # full graph-axis factor under the replica bytes it replaces
+    scale = results[3]
+    assert scale["metric"].startswith("scale")
+    assert scale["metric"].endswith("_tiled_cold_solve_ms")
+    assert scale["warm_flap_ms"] > 0
+    b_ax, g_ax = scale["mesh"]
+    assert (
+        scale["tile_bytes_per_device"] * b_ax * g_ax
+        == scale["replica_bytes_per_device"]
+    )
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):

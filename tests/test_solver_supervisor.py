@@ -299,6 +299,94 @@ class TestCircuitBreaker:
         )
 
 
+def _device_lost(point):
+    return RuntimeError(f"device is lost at {point}")
+
+
+class TestPartialMeshDegradation:
+    """The degradation ladder (docs/Robustness.md): device-loss streaks
+    shrink the solver mesh over surviving chips; the CPU oracle is the
+    LAST rung, reached only when no viable mesh remains."""
+
+    def make_meshed_supervisor(self, mesh, samples=None, **cfg_kw):
+        # threshold 1: every failed build reaches a ladder/trip decision
+        cfg_kw.setdefault("failure_threshold", 1)
+        cfg_kw.setdefault("max_attempts", 1)
+        return SolverSupervisor(
+            TpuSpfSolver("g0_0", mesh=mesh),
+            SpfSolver("g0_0"),
+            SupervisorConfig(**cfg_kw),
+            log_sample_fn=(samples.append if samples is not None else None),
+            clock=FakeClock(),
+        )
+
+    def test_device_loss_degrades_mesh_instead_of_tripping(self):
+        samples = []
+        sup = self.make_meshed_supervisor((2, 2), samples=samples)
+        with injected() as inj:
+            inj.arm("solver.tpu.solve", times=1, exc=_device_lost)
+            db = sup.build_route_db(*solve_inputs())  # fails -> takes a rung
+        assert_route_db_equal(db, oracle_db())  # this event served degraded
+        assert sup.state == CLOSED  # breaker never opened
+        assert sup.consecutive_failures == 0  # streak reset by the rung
+        assert sup.counters["decision.spf.mesh_degradations"] == 1
+        assert sup.counters["decision.spf.mesh_devices"] == 2
+        assert dict(sup.primary.mesh.shape) == {"batch": 1, "graph": 2}
+        assert "decision.spf.breaker_trips" not in sup.counters
+        assert any(
+            s.get("event") == "SOLVER_MESH_DEGRADED" for s in samples
+        )
+        # the primary serves the next event on the smaller mesh
+        db2 = sup.build_route_db(*solve_inputs())
+        assert_route_db_equal(db2, oracle_db())
+        assert sup.counters.get("decision.spf.fallback_solves", 0) == 1
+
+    def test_ladder_walks_to_cpu_when_no_mesh_remains(self):
+        """Persistent device loss: (1, 2) -> (1, 1) -> no rung below a
+        single device -> the breaker finally trips to the oracle."""
+        sup = self.make_meshed_supervisor((1, 2))
+        with injected() as inj:
+            inj.arm("solver.tpu.solve", times=None, exc=_device_lost)
+            db = sup.build_route_db(*solve_inputs())  # rung: (1, 1)
+            assert sup.state == CLOSED
+            assert dict(sup.primary.mesh.shape) == {"batch": 1, "graph": 1}
+            db = sup.build_route_db(*solve_inputs())  # no rung left: trip
+            assert sup.state == OPEN
+            db = sup.build_route_db(*solve_inputs())  # served while open
+        assert_route_db_equal(db, oracle_db())
+        assert sup.counters["decision.spf.mesh_degradations"] == 1
+        assert sup.counters["decision.spf.breaker_trips"] == 1
+        assert sup.health()["mesh_degradations"] == 1
+        assert sup.health()["solver_mesh"] == {"batch": 1, "graph": 1}
+
+    def test_non_device_loss_faults_skip_the_ladder(self):
+        """A compile/runtime streak trips straight to the oracle — a
+        smaller mesh cannot heal a lowering bug."""
+        sup = self.make_meshed_supervisor((2, 2))
+        with injected() as inj:
+            inj.arm("solver.tpu.solve", times=None)  # runtime kind
+            sup.build_route_db(*solve_inputs())
+        assert sup.state == OPEN
+        assert "decision.spf.mesh_degradations" not in sup.counters
+        assert dict(sup.primary.mesh.shape) == {"batch": 2, "graph": 2}
+
+    def test_knob_disables_the_ladder(self):
+        sup = self.make_meshed_supervisor((2, 2), mesh_degrade=False)
+        with injected() as inj:
+            inj.arm("solver.tpu.solve", times=None, exc=_device_lost)
+            sup.build_route_db(*solve_inputs())
+        assert sup.state == OPEN
+        assert "decision.spf.mesh_degradations" not in sup.counters
+
+    def test_meshless_primary_trips_as_before(self):
+        sup = make_supervisor(failure_threshold=1, max_attempts=1)
+        with injected() as inj:
+            inj.arm("solver.tpu.solve", times=None, exc=_device_lost)
+            sup.build_route_db(*solve_inputs())
+        assert sup.state == OPEN
+        assert sup.health()["solver_mesh"] is None
+
+
 class TestWarmStateAudit:
     def _corrupt(self, solve):
         """Perturb one warm D entry (device + host mirror) — the injected

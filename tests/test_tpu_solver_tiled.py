@@ -1,0 +1,378 @@
+"""Destination-tiled P('batch', 'graph') solver differentials.
+
+The 2-D layout (docs/Decision.md "Distance layout and halo exchange")
+replaces the per-chip [S, n_pad] distance replica with a
+[S/batch, n_pad/graph] tile and halo-exchanges per-partition frontier
+minima between relaxation rounds. Every solve it produces must be
+bit-identical to BOTH the replicated single-device path and the CPU
+Dijkstra oracle — cold, warm (increase and decrease), overload toggles,
+and partition flaps, on grid/Clos/WAN topologies over the virtual
+8-device CPU mesh (conftest.py).
+
+Resharding contract: warm state is never re-tiled across mesh shapes —
+a mesh change (the partial-mesh degradation ladder) drops every cached
+solve and the next event cold-starts, pinned here so it can never be
+silently wrong.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.lsdb import LinkState
+from openr_tpu.ops.graph import INF
+from openr_tpu.parallel import (
+    plan_degraded_mesh,
+    resolve_mesh,
+    shrink_candidates,
+    surviving_devices,
+    tile_graph,
+)
+from openr_tpu.solver import SpfSolver, TpuSpfSolver
+from openr_tpu.solver.tpu import _AreaSolve
+from openr_tpu.topology import build_adj_dbs, fabric_edges, grid_edges, wan_edges
+
+from test_tpu_solver import (
+    apply_random_event,
+    assert_solve_matches_oracle,
+)
+from test_tpu_solver_mesh import (
+    assert_route_db_equal,
+    build_ls,
+    make_prefix_state,
+    run_parity,
+)
+
+# graph axis > 1 on every shape: these meshes exercise the tiled layout
+TILED_MESHES = [(2, 4), (2, 2), (1, 2)]
+
+PFXS = ["10.1.0.0/16", "10.2.0.0/16"]
+
+
+def run_tiled_differential(edges, me, seed, n_events, mesh_shape):
+    """Randomized event sequence: after every event the warm tiled solve
+    must be bit-identical to a fresh cold tiled solve, to a fresh
+    replicated (mesh=None) solve, AND to the CPU oracle. Returns the warm
+    _AreaSolve for counter assertions."""
+    mesh = resolve_mesh(mesh_shape)
+    rng = random.Random(seed)
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    warm = _AreaSolve(ls, me, mesh=mesh)
+    assert warm._dev is not None and warm._dev.get("kind") == "tile2d"
+    links = list(edges)
+    applied = 0
+    for _ in range(n_events):
+        before = ls.version
+        apply_random_event(rng, dbs, ls, links)
+        if ls.version == before:
+            continue
+        warm.refresh()
+        cold_tiled = _AreaSolve(ls, me, mesh=mesh)
+        cold_repl = _AreaSolve(ls, me, mesh=None)
+        np.testing.assert_array_equal(warm.d, cold_tiled.d)
+        np.testing.assert_array_equal(warm.d, cold_repl.d)
+        assert_solve_matches_oracle(ls, warm)
+        applied += 1
+    assert applied > 0
+    return warm
+
+
+class TestTiledDifferential:
+    """Sharded-vs-replicated-vs-oracle on randomized event sequences
+    (metric increase/decrease, link flap, node-overload toggle)."""
+
+    @pytest.mark.parametrize("mesh", TILED_MESHES)
+    def test_grid_random_sequences(self, mesh):
+        warm = run_tiled_differential(grid_edges(4), "g0_0", 23, 10, mesh)
+        assert warm.incremental_solves > 0
+        # the tile really is sharded over every mesh device
+        assert len(warm._d_dev.sharding.device_set) == mesh[0] * mesh[1]
+
+    def test_clos_random_sequence(self):
+        edges = fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        warm = run_tiled_differential(edges, "rsw0_0", 5, 8, (2, 4))
+        assert warm.incremental_solves > 0
+
+    def test_wan_random_sequence(self):
+        warm = run_tiled_differential(wan_edges(24, seed=2), "w0", 9, 8, (2, 2))
+        assert warm.incremental_solves > 0
+
+    def test_overload_toggle_rides_warm_path(self):
+        """A node-overload toggle must warm-start on the tiled layout
+        (newly-overloaded out-edges seed the halo-aware invalidation) and
+        still match both comparators."""
+        import dataclasses
+
+        mesh = resolve_mesh((2, 2))
+        edges = grid_edges(4)
+        dbs = build_adj_dbs(edges)
+        ls = LinkState("0")
+        for db in dbs.values():
+            ls.update_adjacency_database(db)
+        warm = _AreaSolve(ls, "g0_0", mesh=mesh)
+        for node in ("g1_1", "g2_2", "g1_1"):  # on, on, off again
+            dbs[node] = dataclasses.replace(
+                dbs[node], is_overloaded=not dbs[node].is_overloaded
+            )
+            ls.update_adjacency_database(dbs[node])
+            warm.refresh()
+            np.testing.assert_array_equal(
+                warm.d, _AreaSolve(ls, "g0_0", mesh=None).d
+            )
+            assert_solve_matches_oracle(ls, warm)
+        assert warm.incremental_solves == 3  # every toggle stayed warm
+
+    def test_partition_flap(self):
+        """Cut the single bridge between two grid islands (partition), then
+        heal it: unreachable columns must read INF on the tiled layout and
+        recover, bit-identical to the replicated path throughout."""
+        import dataclasses
+
+        mesh = resolve_mesh((2, 4))
+        edges = [
+            (f"a{i}_{j}", n, 1)
+            for i in range(3)
+            for j in range(3)
+            for n in ([f"a{i+1}_{j}"] if i < 2 else [])
+            + ([f"a{i}_{j+1}"] if j < 2 else [])
+        ]
+        edges += [
+            (f"b{i}_{j}", n, 1)
+            for i in range(3)
+            for j in range(3)
+            for n in ([f"b{i+1}_{j}"] if i < 2 else [])
+            + ([f"b{i}_{j+1}"] if j < 2 else [])
+        ]
+        edges.append(("a2_2", "b0_0", 3))  # the bridge
+        dbs = build_adj_dbs(edges)
+        ls = LinkState("0")
+        for db in dbs.values():
+            ls.update_adjacency_database(db)
+        warm = _AreaSolve(ls, "a0_0", mesh=mesh)
+        assert int(warm.d[0, warm.graph.node_index["b2_2"]]) < INF
+
+        def set_bridge(down: bool):
+            db = dbs["a2_2"]
+            db = dataclasses.replace(
+                db,
+                adjacencies=[
+                    dataclasses.replace(adj, is_overloaded=down)
+                    if adj.other_node_name == "b0_0"
+                    else adj
+                    for adj in db.adjacencies
+                ],
+            )
+            dbs["a2_2"] = db
+            ls.update_adjacency_database(db)
+
+        set_bridge(True)
+        warm.refresh()
+        np.testing.assert_array_equal(
+            warm.d, _AreaSolve(ls, "a0_0", mesh=None).d
+        )
+        assert int(warm.d[0, warm.graph.node_index["b2_2"]]) >= INF
+        assert_solve_matches_oracle(ls, warm)
+        set_bridge(False)
+        warm.refresh()
+        np.testing.assert_array_equal(
+            warm.d, _AreaSolve(ls, "a0_0", mesh=None).d
+        )
+        assert int(warm.d[0, warm.graph.node_index["b2_2"]]) < INF
+        assert_solve_matches_oracle(ls, warm)
+
+
+class TestTiledRouteDbParity:
+    """Full route-pipeline parity through TpuSpfSolver on tiled meshes —
+    the same contract as tests/test_tpu_solver_mesh.py, with the graph
+    axis doing the destination sharding."""
+
+    def test_grid_routes(self):
+        run_parity(
+            grid_edges(5),
+            {"g4_4": [PFXS[0]], "g0_4": [PFXS[1]]},
+            "g0_0",
+            (2, 4),
+        )
+
+    def test_random_graphs(self):
+        rng = random.Random(31)
+        for _ in range(4):
+            n = rng.randint(6, 13)
+            nodes = [f"n{i}" for i in range(n)]
+            edges = []
+            for i in range(1, n):
+                edges.append(
+                    (nodes[rng.randrange(i)], nodes[i], rng.randint(1, 5))
+                )
+            for _ in range(rng.randint(1, n)):
+                a, b = rng.sample(nodes, 2)
+                if not any({a, b} == {x, y} for x, y, _ in edges):
+                    edges.append((a, b, rng.randint(1, 5)))
+            overloaded = {
+                nodes[i] for i in range(1, n) if rng.random() < 0.15
+            }
+            run_parity(
+                edges,
+                {nodes[i]: [PFXS[i % 2]] for i in range(1, n) if i % 2},
+                nodes[0],
+                (2, 4),
+                overloaded=overloaded,
+            )
+
+
+class TestHaloAccounting:
+    def test_halo_counters_flow(self):
+        """Tiled solves must account their ring traffic: exchanges gauge
+        and cumulative bytes, surfaced as decision.spf.halo_* through the
+        solver counter sync."""
+        import dataclasses
+
+        edges = grid_edges(4)
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"g3_3": [PFXS[0]]})
+        tpu = TpuSpfSolver("g0_0", mesh=(2, 2))
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert tpu.counters["decision.spf.halo_exchanges_last"] > 0
+        cold_bytes = tpu.counters["decision.spf.halo_bytes"]
+        assert cold_bytes > 0
+        # a warm flap event pays the seed exchange + its (fewer) rounds
+        db = dataclasses.replace(
+            dbs["g1_0"],
+            adjacencies=[
+                dataclasses.replace(adj, metric=7)
+                if adj.other_node_name == "g1_1"
+                else adj
+                for adj in dbs["g1_0"].adjacencies
+            ],
+        )
+        ls.update_adjacency_database(db)
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert tpu.counters["decision.spf.incremental_solves"] == 1
+        assert tpu.counters["decision.spf.halo_bytes"] > cold_bytes
+
+    def test_tile_memory_is_fraction_of_replica(self):
+        """The point of the layout: the per-device distance tile holds
+        n_pad/graph columns, not the full destination axis."""
+        import jax
+
+        from openr_tpu.ops import compile_graph
+
+        ls = build_ls(grid_edges(6))
+        g = compile_graph(ls)
+        mesh = resolve_mesh((2, 4))
+        solve = _AreaSolve(ls, "g0_0", mesh=mesh)
+        shards = {
+            s.device: s.data.shape for s in solve._d_dev.addressable_shards
+        }
+        assert len(shards) == 8
+        s_pad, n_pad = solve._d_dev.shape
+        for shape in shards.values():
+            assert shape == (s_pad // 2, n_pad // 4)
+
+
+class TestResharding:
+    def test_degrade_mesh_cold_starts_never_silently_wrong(self):
+        """Mesh degradation mid-flight: warm state is dropped (tile
+        ownership is a function of the factorization), the next event
+        cold-starts on the smaller mesh, and routes still match a fresh
+        CPU oracle — re-tiled-or-cold, never silently wrong."""
+        import dataclasses
+
+        edges = grid_edges(4)
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"g3_3": [PFXS[0]]})
+        tpu = TpuSpfSolver("g0_0", mesh=(2, 4))
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert len(tpu._solves) == 1
+        assert tpu.degrade_mesh() is True
+        assert tpu.counters["decision.spf.mesh_degradations"] == 1
+        assert tpu.counters["decision.spf.mesh_devices"] == 4
+        # the ladder prefers keeping the graph axis (the memory win)
+        assert (tpu.mesh.shape["batch"], tpu.mesh.shape["graph"]) == (1, 4)
+        assert not tpu._solves  # warm state dropped, not re-tiled
+        full_before = tpu.counters.get("decision.spf.full_solves", 0)
+        db = dataclasses.replace(
+            dbs["g1_0"],
+            adjacencies=[
+                dataclasses.replace(adj, metric=5)
+                if adj.other_node_name == "g1_1"
+                else adj
+                for adj in dbs["g1_0"].adjacencies
+            ],
+        )
+        ls.update_adjacency_database(db)
+        db_tpu = tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert tpu.counters["decision.spf.full_solves"] > full_before
+        assert tpu.counters.get("decision.spf.incremental_solves", 0) == 0
+        ls_cpu = LinkState("0")
+        for name in sorted(dbs):
+            src = db if name == "g1_0" else dbs[name]
+            ls_cpu.update_adjacency_database(src)
+        assert_route_db_equal(
+            SpfSolver("g0_0").build_route_db("g0_0", {"0": ls_cpu}, ps),
+            db_tpu,
+        )
+
+    def test_ladder_shapes(self):
+        assert shrink_candidates((4, 2)) == [(2, 2), (1, 2), (1, 1)]
+        assert shrink_candidates((2, 4)) == [(1, 4), (1, 2), (1, 1)]
+        assert shrink_candidates((1, 1)) == []
+
+    def test_plan_degraded_mesh_bottoms_out(self):
+        mesh = resolve_mesh((1, 2))
+        smaller = plan_degraded_mesh(mesh)
+        assert smaller is not None
+        assert dict(smaller.shape) == {"batch": 1, "graph": 1}
+        assert plan_degraded_mesh(smaller) is None  # no rung below 1 device
+
+    def test_surviving_devices_all_alive_on_cpu_mesh(self):
+        import jax
+
+        devices = jax.devices()[:4]
+        assert surviving_devices(devices) == list(devices)
+
+
+class TestTiledDeltaPath:
+    def test_qualifying_flap_yields_device_delta(self):
+        """A warm weight event not incident to me must produce a device
+        delta on the tiled layout (col_changed sharded P('graph'), host
+        reads one popcount) exactly like the replicated layouts."""
+        import dataclasses
+
+        # a line: the flapped link is a bottleneck, so distances beyond it
+        # must actually move (grids absorb single-edge changes into ECMP)
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1), ("d", "e", 1)]
+        dbs = build_adj_dbs(edges)
+        ls = build_ls(edges)
+        tpu = TpuSpfSolver("a", mesh=(2, 2))
+        solve = tpu._area_solve(ls, "a")
+        assert solve.take_route_delta() is None  # cold solve poisons
+        db = dataclasses.replace(
+            dbs["c"],
+            adjacencies=[
+                dataclasses.replace(adj, metric=9)
+                if adj.other_node_name == "d"
+                else adj
+                for adj in dbs["c"].adjacencies
+            ],
+        )
+        ls.update_adjacency_database(db)
+        solve = tpu._area_solve(ls, "a")
+        cols = solve.take_route_delta()
+        assert cols is not None
+        names = {solve.graph.names[c] for c in cols}
+        assert names == {"d", "e"}  # exactly the columns past the flap
+        assert solve.delta_extracts == 1
+        assert solve.delta_bytes > 0
+        # the patched host mirror equals a cold fetch
+        np.testing.assert_array_equal(
+            solve.d, _AreaSolve(ls, "a", mesh=None).d
+        )
