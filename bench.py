@@ -20,7 +20,7 @@ Set BENCH_TOPO=grid for the 1k-node grid config (BASELINE.md config 1, with
 ECMP first-hop DAG extraction fused — config 4 semantics).
 
 Prints one JSON line per metric (SPF/s headline, convergence p95, TE
-optimize latency, destination-tiled scale solve):
+optimize latency, destination-tiled scale solve, exporter overhead):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "baseline": ...}
 plus detail lines on stderr.
 """
@@ -305,6 +305,7 @@ def _apply_smoke_env() -> None:
             ("BENCH_SCALE_N", "384"),
             ("BENCH_SCALE_SOURCES", "8"),
             ("BENCH_SCALE_FLAPS", "2"),
+            ("BENCH_EXPORTER_RECORDS", "200"),
         )
     )
 
@@ -328,6 +329,7 @@ def _apply_reduced_env() -> None:
             ("BENCH_SCALE_N", "20000"),
             ("BENCH_SCALE_SOURCES", "8"),
             ("BENCH_SCALE_FLAPS", "2"),
+            ("BENCH_EXPORTER_RECORDS", "500"),
         )
     )
 
@@ -369,6 +371,11 @@ def _probe_backend() -> str:
     return "cpu-fallback"
 
 
+# the convergence flap batch's summary, kept so the exporter-overhead
+# line measures on the SAME run instead of spinning a second emulator
+_CONV_SUMMARY = {}
+
+
 def _bench_convergence() -> dict:
     """Second metric line: p95 hello-to-programmed-route from an emulator
     line-topology flap run (VirtualNetwork.convergence_report), so the
@@ -380,6 +387,7 @@ def _bench_convergence() -> dict:
     flaps = int(os.environ.get("BENCH_CONV_FLAPS", "2"))
     backend = os.environ.get("BENCH_CONV_BACKEND", "tpu")
     summary = run_bench_convergence(nodes=nodes, flaps=flaps, backend=backend)
+    _CONV_SUMMARY.update(summary)
     _note(
         f"convergence: {summary['spans_total']} spans over "
         f"{summary['flaps']} flap cycles on a {summary['nodes']}-node line "
@@ -565,6 +573,46 @@ def _bench_scale() -> dict:
     }
 
 
+def _bench_exporter() -> dict:
+    """Fifth metric line: continuous-telemetry overhead on the standard
+    flap batch — best full-registry Prometheus exposition render (each
+    render parsed back, so the sample only counts if the text round-trips)
+    plus the per-record windowed-rollup fold cost, both measured on the
+    converged emulator run behind the convergence line (one emulator spin
+    serves both; with BENCH_CONVERGENCE=0 a reduced flap batch is run
+    here instead). Degraded-aware like the other lines: cpu-fallback
+    rounds reuse their reduced flap batch and are marked by main()."""
+    summary = dict(_CONV_SUMMARY)
+    if "scrape_render_ms" not in summary:
+        from openr_tpu.testing.decision_harness import run_bench_convergence
+
+        summary = run_bench_convergence(
+            nodes=int(os.environ.get("BENCH_CONV_NODES", "5")),
+            flaps=1,
+            backend=os.environ.get("BENCH_CONV_BACKEND", "tpu"),
+        )
+    _note(
+        f"exporter: {summary['metrics_series']}-family registry rendered "
+        f"in {summary['scrape_render_ms']:.3f}ms, rollup fold "
+        f"{summary['rollup_record_us']:.2f}us/span "
+        f"({summary['nodes']}-node flap batch)"
+    )
+    return {
+        "metric": "exporter_scrape_render_ms",
+        "value": summary["scrape_render_ms"],
+        "unit": (
+            f"ms best full-registry Prometheus exposition render "
+            f"({summary['metrics_series']} metric families, "
+            f"{summary['nodes']}-node line emulator flap batch, "
+            f"parse-validated)"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "rollup_record_us": summary["rollup_record_us"],
+        "metrics_series": summary["metrics_series"],
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -611,6 +659,8 @@ def main(argv=None) -> None:
             results.append(_bench_te())
         if os.environ.get("BENCH_SCALE", "1") == "1":
             results.append(_bench_scale())
+        if os.environ.get("BENCH_EXPORTER", "1") == "1":
+            results.append(_bench_exporter())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

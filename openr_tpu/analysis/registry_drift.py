@@ -62,6 +62,7 @@ ALLOWED_PREFIXES = {
     "prefix_manager",
     "convergence",
     "process",
+    "monitor",
 }
 
 # <module>.<name>[.<name>...], lowercase snake segments
@@ -435,6 +436,7 @@ class RegistryDriftRule(Rule):
             # single-file scan must not report the rest as ghosts
             return
         yield from self._check_monitoring_docs(ctx)
+        yield from self._check_exporter_metrics(ctx)
         yield from self._check_event_catalog(ctx)
         yield from self._check_fault_catalog(ctx)
         yield from self._check_config_knobs(ctx)
@@ -498,6 +500,60 @@ class RegistryDriftRule(Rule):
                 line,
                 f"histogram '{name}' is emitted but missing from the "
                 f"docs/Monitoring.md histogram table",
+            )
+
+    # -- docs/Monitoring.md exporter-metric table -----------------------
+
+    def _check_exporter_metrics(self, ctx: AnalysisContext):
+        """The exporter's own telemetry namespace (`monitor.*` — the
+        scrape/push/rollup overhead metrics riding every exposition) is
+        pinned to its docs/Monitoring.md table BOTH ways, exhaustively:
+        an emitted `monitor.*` name missing a table row is an
+        undocumented-metric, a row no code emits is a ghost-metric. The
+        general counter table is exemplary by contract; this table is
+        not — the exporter serves it to external scrapers, so drift here
+        is operator-visible dashboard breakage."""
+        doc = ctx.docs_dir / "Monitoring.md"
+        if not doc.exists():
+            return
+        sf_doc = _doc_source(ctx, doc)
+        text = doc.read_text()
+        documented = _table_names(text, header_hint="exporter metric")
+        doc_exact = {n for n in documented if not n.endswith("*")}
+        doc_stems = {n[:-1] for n in documented if n.endswith("*")}
+        emissions = {
+            (name, sf.rel, line): (name, sf, line)
+            for name, sf, line in (
+                collect_emitted_names(ctx) + collect_histogram_names(ctx)
+            )
+            if name.startswith("monitor.")
+        }
+        emitted: Set[str] = set()
+        for name, sf, line in emissions.values():
+            emitted.add(name)
+            if name in doc_exact or any(
+                name.startswith(s) for s in doc_stems
+            ):
+                continue
+            yield self.finding(
+                "undocumented-metric",
+                sf,
+                line,
+                f"exporter metric '{name}' is emitted but missing from "
+                f"the docs/Monitoring.md exporter-metric table",
+            )
+        for name in sorted(documented):
+            if name.endswith("*"):
+                if any(e.startswith(name[:-1]) for e in emitted):
+                    continue
+            elif name in emitted:
+                continue
+            yield self.finding(
+                "ghost-metric",
+                sf_doc,
+                _doc_line(text, name),
+                f"docs/Monitoring.md exporter-metric table documents "
+                f"'{name}' but no code emits it",
             )
 
     # -- docs/Monitoring.md LogSample event catalog ---------------------

@@ -273,6 +273,73 @@ def _all_shortest_paths(graph, src, dst, limit=16):
     return [(c, p) for c, p in paths]
 
 
+def cmd_soak_report(args) -> None:
+    """Render a judged soak report written by the topology-churn harness
+    (python -m openr_tpu.testing.soak --out FILE). Offline: reads the
+    JSON file, never dials a daemon."""
+    with open(args.file) as fh:
+        report = json.load(fh)
+    verdict = report.get("verdict", {})
+    checks = verdict.get("checks", {})
+    state = "PASS" if verdict.get("pass") else "FAIL"
+    print(f"soak verdict: {state} ({len(checks)} check(s))")
+    for name, check in sorted(checks.items()):
+        mark = "ok " if check.get("ok") else "FAIL"
+        print(f"  [{mark}] {name}: {check.get('detail', '')}")
+    events = report.get("events", {})
+    print(
+        f"events: {events.get('total', 0)} total = "
+        f"{events.get('windowed', 0)} windowed + "
+        f"{events.get('evicted_window_events', 0)} window-evicted; "
+        f"LogSample rings retained {events.get('spans_in_rings', 0)}"
+    )
+    waves = report.get("waves", [])
+    if waves:
+        _print_table(
+            ["Wave", "Added", "Removed", "Chaos", "Converged", "ms"],
+            [
+                [
+                    w["index"],
+                    ",".join(w["added"]) or "-",
+                    ",".join(w["removed"]) or "-",
+                    "yes" if w["faulted"] else "",
+                    "yes" if w["converged"] else "NO",
+                    w["converge_ms"],
+                ]
+                for w in waves
+            ],
+        )
+    windows = report.get("windows", [])
+    if windows:
+        print("windowed convergence trend:")
+        _print_table(
+            ["Window", "Events", "Chaos", "p50 ms", "p95 ms", "max ms"],
+            [
+                [
+                    int(w["start"]),
+                    w["events"],
+                    "yes" if w["faulted"] else "",
+                    f"{w['e2e_p50_ms']:.2f}",
+                    f"{w['e2e_p95_ms']:.2f}",
+                    f"{w['e2e_max_ms']:.2f}",
+                ]
+                for w in windows
+            ],
+        )
+    attribution = report.get("attribution")
+    if attribution:
+        clean = attribution["clean_e2e_ms"]
+        faulted = attribution["faulted_e2e_ms"]
+        print(
+            f"attribution: clean {attribution['clean_windows']} window(s) "
+            f"p95 {clean['p95']:.2f}ms vs chaos "
+            f"{attribution['faulted_windows']} window(s) "
+            f"p95 {faulted['p95']:.2f}ms"
+        )
+    if args.json:
+        _print_json(report)
+
+
 def cmd_perf(client: BlockingCtrlClient, args) -> None:
     if getattr(args, "cmd", None) == "report":
         _perf_report(client, args)
@@ -538,6 +605,11 @@ def cmd_monitor(client: BlockingCtrlClient, args) -> None:
     elif args.cmd == "logs":
         for log_json in client.call("getEventLogs"):
             print(log_json)
+    elif args.cmd == "scrape":
+        # the full registry in Prometheus text exposition format — the
+        # same bytes GET /metrics on the ctrl port serves (the scrape
+        # endpoint a stock Prometheus instance polls)
+        sys.stdout.write(client.call("getMetricsText"))
 
 
 def cmd_openr(client: BlockingCtrlClient, args) -> None:
@@ -642,6 +714,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = mon.add_parser("histograms")
     p.add_argument("--reset", action="store_true")
     mon.add_parser("logs")
+    mon.add_parser("scrape")
 
     op = sub.add_parser("openr").add_subparsers(dest="cmd", required=True)
     op.add_parser("version")
@@ -658,6 +731,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--json", action="store_true", help="dump the full aggregate too"
+    )
+    p = perf.add_parser("soak-report")
+    p.add_argument("file", help="JSON soak report (testing/soak.py --out)")
+    p.add_argument(
+        "--json", action="store_true", help="dump the full report too"
     )
 
     cfg = sub.add_parser("config").add_subparsers(dest="cmd", required=True)
@@ -686,6 +764,10 @@ _HANDLERS = {
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.module == "perf" and getattr(args, "cmd", None) == "soak-report":
+        # offline renderer: reads a report file, never dials a daemon
+        cmd_soak_report(args)
+        return 0
     ssl_ctx = None
     if args.x509_ca_path:
         from openr_tpu.utils.tls import client_ssl_context

@@ -92,9 +92,28 @@ class WatchdogConfig:
 
 @dataclass
 class MonitorConfig:
-    """OpenrConfig.thrift MonitorConfig:71."""
+    """OpenrConfig.thrift MonitorConfig:71 + the continuous-telemetry
+    knobs (docs/Monitoring.md): the event-log ring bound, the
+    eviction-proof convergence-rollup window geometry, and the optional
+    metrics push sink."""
 
+    # bound of the LogSample ring (monitor/monitor.py). Samples evicted
+    # from the ring are still covered by the windowed rollup, which folds
+    # spans at record time — raising this buys raw-sample retention, not
+    # report completeness.
     max_event_log: int = 100
+    # convergence-rollup window geometry: per-stage histograms aggregate
+    # into rollup_window_s-wide wall-clock windows, bounded at
+    # rollup_max_windows (older windows fold into the evicted-events
+    # count; their samples stay in the cumulative layer)
+    rollup_window_s: float = 60.0
+    rollup_max_windows: int = 120
+    # metrics push mode: render the Prometheus exposition every
+    # exporter_push_interval_s and push it to a sink — "host:port" (TCP)
+    # or a file path (atomic replace) — with exponential backoff on
+    # failure. None (default) = scrape-only.
+    exporter_push_target: Optional[str] = None
+    exporter_push_interval_s: float = 15.0
 
 
 @dataclass

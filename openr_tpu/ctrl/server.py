@@ -99,6 +99,7 @@ class CtrlServer:
         link_monitor=None,
         prefix_manager=None,
         monitor=None,
+        exporter=None,
         config_store=None,
         config=None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
@@ -116,6 +117,7 @@ class CtrlServer:
         self.link_monitor = link_monitor
         self.prefix_manager = prefix_manager
         self.monitor = monitor
+        self.exporter = exporter
         self.config_store = config_store
         self.config = config
         self._loop = loop
@@ -168,6 +170,13 @@ class CtrlServer:
             while True:
                 line = await reader.readline()
                 if not line:
+                    return
+                if line.startswith((b"GET ", b"HEAD ")):
+                    # plain HTTP-ish scrape handler: a stock Prometheus
+                    # scraper (or curl) polling GET /metrics on the ctrl
+                    # port gets a one-shot exposition response — no JSON
+                    # request ever starts with an HTTP method line
+                    await self._serve_http_scrape(line, reader, writer)
                     return
                 try:
                     req = json.loads(line)
@@ -303,6 +312,77 @@ class CtrlServer:
         if self.monitor is None:
             return []
         return [s.to_json() for s in self.monitor.get_event_logs()]
+
+    def m_getMetricsText(self, params) -> str:
+        """The full counter/histogram registry (plus the convergence
+        rollup's cumulative-vs-windowed split) in Prometheus text
+        exposition format — the `breeze monitor scrape` / GET /metrics
+        surface (docs/Monitoring.md exporter section)."""
+        return self._metrics_text()
+
+    def _metrics_text(self) -> str:
+        from openr_tpu.monitor import merge_module_histograms
+        from openr_tpu.monitor.exporter import render_metrics_text
+
+        if self.exporter is not None:
+            return self.exporter.render()
+        if self.monitor is not None:
+            return render_metrics_text(
+                self.monitor.get_counters(),
+                self.monitor.get_cumulative_histograms(),
+                node_name=self.node_name,
+                rollup=getattr(self.monitor, "rollup", None),
+            )
+        # monitor-less fallback: render straight off the wired modules
+        modules = [
+            m
+            for m in (self.decision, self.fib, self.link_monitor)
+            if m is not None
+        ]
+        counters: Dict[str, int] = {}
+        for module in modules:
+            if hasattr(module, "counters"):
+                counters.update(module.counters)
+        return render_metrics_text(
+            counters,
+            merge_module_histograms(modules),
+            node_name=self.node_name,
+        )
+
+    async def _serve_http_scrape(
+        self,
+        request_line: bytes,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        """Minimal HTTP response for GET/HEAD /metrics on the ctrl port
+        (one request per connection, then close — all a scraper needs)."""
+        parts = request_line.decode(errors="replace").split()
+        method = parts[0] if parts else "GET"
+        path = parts[1] if len(parts) > 1 else "/"
+        while True:  # drain request headers
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+        if path.split("?", 1)[0].rstrip("/") in ("", "/metrics"):
+            try:
+                body = self._metrics_text().encode()
+                status = "200 OK"
+            except Exception as exc:  # pragma: no cover - defensive
+                log.exception("metrics render failed")
+                body = f"metrics render failed: {exc}\n".encode()
+                status = "500 Internal Server Error"
+        else:
+            body = b"only /metrics is served here\n"
+            status = "404 Not Found"
+        head = (
+            f"HTTP/1.0 {status}\r\n"
+            "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        writer.write(head if method == "HEAD" else head + body)
+        await writer.drain()
 
     # ------------------------------------------------------------------
     # route APIs

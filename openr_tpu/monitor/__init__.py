@@ -11,8 +11,15 @@ from openr_tpu.monitor.monitor import (
     Monitor,
     merge_module_histograms,
 )
+from openr_tpu.monitor.exporter import (
+    MetricsExporter,
+    parse_metrics_text,
+    render_metrics_text,
+)
 from openr_tpu.monitor.report import (
+    ConvergenceRollup,
     aggregate_convergence_reports,
+    merge_rollup_snapshots,
     node_convergence_report,
     percentile_summary,
 )
@@ -20,7 +27,9 @@ from openr_tpu.monitor.spans import SPAN_EVENT, Span
 from openr_tpu.monitor.watchdog import Watchdog, WatchdogConfig
 
 __all__ = [
+    "ConvergenceRollup",
     "LogSample",
+    "MetricsExporter",
     "Monitor",
     "Span",
     "SPAN_EVENT",
@@ -28,6 +37,9 @@ __all__ = [
     "WatchdogConfig",
     "aggregate_convergence_reports",
     "merge_module_histograms",
+    "merge_rollup_snapshots",
     "node_convergence_report",
+    "parse_metrics_text",
     "percentile_summary",
+    "render_metrics_text",
 ]

@@ -1,0 +1,333 @@
+"""Continuous metrics export: Prometheus text exposition + push loop.
+
+Until now every metric existed only at the instant a ctrl client asked
+(`breeze monitor counters`), so perf/robustness claims were demonstrable
+over single flaps only. This module turns the registry continuous:
+
+  - `render_metrics_text(...)` renders the full counter/histogram
+    registry — plus the convergence rollup's cumulative-vs-windowed
+    split — in Prometheus text exposition format (one `# TYPE` header
+    per family, log-bucket histograms as cumulative `_bucket{le=...}`
+    series). `parse_metrics_text` is its inverse, used by round-trip
+    tests and the soak harness's scrape loop.
+  - The ctrl server serves it as `getMetricsText` and as a plain
+    HTTP-ish `GET /metrics` handler on the same port, so a stock
+    Prometheus scraper (or `curl`) can poll a daemon with zero extra
+    listeners.
+  - `MetricsExporter` optionally *pushes* the rendered text on an
+    interval to a configurable sink — `host:port` (TCP) or a file path
+    (atomic replace) — with exponential backoff on failure
+    (`monitor_config.exporter_push_{target,interval_s}`).
+
+The exporter reads `Monitor.get_cumulative_histograms()`, the
+non-resetting view: a scrape racing a `--reset` histogram snapshot from
+another consumer still exports lifetime-cumulative distributions
+(docs/Monitoring.md "reset-on-read vs the exporter").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+from typing import Any, Dict, Optional
+
+from openr_tpu.monitor.report import ConvergenceRollup
+from openr_tpu.testing.faults import fault_point
+from openr_tpu.utils.backoff import ExponentialBackoff
+from openr_tpu.utils.counters import (
+    CountersMixin,
+    Histogram,
+    HistogramsMixin,
+)
+
+PROM_PREFIX = "openr_"
+
+# counter names that are point-in-time readings, not monotone totals
+_GAUGE_MARKERS = (
+    "_last",
+    "_active",
+    ".num_routes",
+    ".num_unicast_routes",
+    ".num_mpls_routes",
+    ".mesh_devices",
+    ".uptime.seconds",
+    ".improved_last",
+)
+
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (dots collapse to
+    underscores under the `openr_` namespace); deterministic and
+    injective over the `<module>.<name>` vocabulary."""
+    return PROM_PREFIX + _INVALID_CHARS.sub("_", name)
+
+
+def _is_gauge(name: str) -> bool:
+    return name.endswith(_GAUGE_MARKERS)
+
+
+def _fmt(value: float) -> str:
+    if float(value) == int(value):
+        return str(int(value))
+    return repr(float(value))
+
+
+def _labels(node_name: str, extra: str = "") -> str:
+    parts = []
+    if node_name:
+        escaped = (
+            node_name.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+        parts.append(f'node="{escaped}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_metrics_text(
+    counters: Dict[str, int],
+    histograms: Dict[str, Histogram],
+    *,
+    node_name: str = "",
+    rollup: Optional[ConvergenceRollup] = None,
+) -> str:
+    """Full registry in Prometheus text exposition format (version 0.0.4):
+    every counter as a counter/gauge family, every Histogram as a native
+    prometheus histogram (cumulative `_bucket{le=...}` over the nonzero
+    log buckets, `_sum`, `_count`), plus — when a rollup rides along —
+    the cumulative-vs-windowed convergence split: the all-events-since-
+    start total next to the newest window's summary gauges."""
+    out = []
+    for name in sorted(counters):
+        pname = prom_name(name)
+        kind = "gauge" if _is_gauge(name) else "counter"
+        out.append(f"# TYPE {pname} {kind}")
+        out.append(f"{pname}{_labels(node_name)} {_fmt(counters[name])}")
+    for name in sorted(histograms):
+        hist = histograms[name]
+        pname = prom_name(name)
+        out.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for i, c in enumerate(hist.buckets):
+            if not c:
+                continue
+            cum += c
+            le_label = 'le="%s"' % _fmt(Histogram.bucket_bounds(i)[1])
+            out.append(
+                f"{pname}_bucket{_labels(node_name, le_label)} {cum}"
+            )
+        inf_label = 'le="+Inf"'
+        out.append(
+            f"{pname}_bucket{_labels(node_name, inf_label)} {hist.count}"
+        )
+        out.append(f"{pname}_sum{_labels(node_name)} {_fmt(hist.sum)}")
+        out.append(f"{pname}_count{_labels(node_name)} {hist.count}")
+    if rollup is not None:
+        base = PROM_PREFIX + "monitor_rollup"
+        out.append(f"# TYPE {base}_events_total counter")
+        out.append(
+            f"{base}_events_total{_labels(node_name)} "
+            f"{rollup.events_total}"
+        )
+        out.append(f"# TYPE {base}_window_seconds gauge")
+        out.append(
+            f"{base}_window_seconds{_labels(node_name)} "
+            f"{_fmt(rollup.window_s)}"
+        )
+        last = rollup.last_window()
+        if last is not None:
+            wname = PROM_PREFIX + "convergence_window"
+            out.append(f"# TYPE {wname}_events gauge")
+            out.append(
+                f"{wname}_events{_labels(node_name)} {last['events']}"
+            )
+            total = last["stages"].get(ConvergenceRollup.TOTAL_STAGE)
+            if total is not None:
+                out.append(f"# TYPE {wname}_e2e_ms gauge")
+                quantiles = (
+                    ("p50", total.percentile(50)),
+                    ("p95", total.percentile(95)),
+                    ("max", total.max or 0.0),
+                )
+                for q, value in quantiles:
+                    q_label = 'q="%s"' % q
+                    out.append(
+                        f"{wname}_e2e_ms{_labels(node_name, q_label)} "
+                        f"{_fmt(value)}"
+                    )
+    return "\n".join(out) + "\n"
+
+
+_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$"
+)
+
+
+def parse_metrics_text(text: str) -> Dict[str, Any]:
+    """Inverse of render_metrics_text: validates exposition-format syntax
+    and returns {"types": {family: kind}, "samples": {name: {labelstr:
+    value}}, "counters": {family: value}, "gauges": {...},
+    "histograms": {family: {"count", "sum", "buckets": {le: cum}}}}
+    (single-node exports: the node label is ignored for the scalar
+    views). Raises ValueError on malformed lines."""
+    types: Dict[str, str] = {}
+    samples: Dict[str, Dict[str, float]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _LINE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed exposition line {lineno}: {line!r}")
+        value = float(m.group("value").replace("+Inf", "inf"))
+        samples.setdefault(m.group("name"), {})[
+            m.group("labels") or ""
+        ] = value
+
+    def _first(series: Dict[str, float]) -> float:
+        return next(iter(series.values()))
+
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, Dict[str, Any]] = {}
+    for family, kind in types.items():
+        if kind == "histogram":
+            buckets: Dict[str, float] = {}
+            for labels, value in samples.get(family + "_bucket", {}).items():
+                le = dict(
+                    pair.split("=", 1)
+                    for pair in labels.split(",")
+                    if "=" in pair
+                ).get("le", '""')
+                buckets[le.strip('"')] = value
+            histograms[family] = {
+                "count": _first(samples.get(family + "_count", {"": 0.0})),
+                "sum": _first(samples.get(family + "_sum", {"": 0.0})),
+                "buckets": buckets,
+            }
+        elif kind == "counter" and family in samples:
+            counters[family] = _first(samples[family])
+        elif kind == "gauge" and family in samples:
+            gauges[family] = _first(samples[family])
+    return {
+        "types": types,
+        "samples": samples,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+    }
+
+
+class MetricsExporter(CountersMixin, HistogramsMixin):
+    """Renders the monitor's registry on demand (scrape) and optionally
+    pushes it on an interval (push). Registers with the monitor like any
+    module, so its own overhead metrics (`monitor.exporter.*`) ride every
+    export."""
+
+    def __init__(
+        self,
+        monitor,
+        *,
+        push_target: Optional[str] = None,
+        push_interval_s: float = 15.0,
+        backoff_min_s: float = 0.5,
+        backoff_max_s: float = 60.0,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.monitor = monitor
+        self.push_target = push_target
+        self.push_interval_s = push_interval_s
+        self._backoff = ExponentialBackoff(backoff_min_s, backoff_max_s)
+        self._loop = loop
+        self._task: Optional[asyncio.Task] = None
+        self._ensure_counters()
+        self._ensure_histograms()
+
+    # -- scrape --------------------------------------------------------
+
+    def render(self) -> str:
+        """One scrape: the full registry as exposition text. Uses the
+        non-resetting cumulative histogram view, so a concurrent
+        reset-on-read snapshot cannot drop samples from this consumer."""
+        counters = self.monitor.get_counters()
+        histograms = self.monitor.get_cumulative_histograms()
+        rollup = getattr(self.monitor, "rollup", None)
+        with self._timer("monitor.exporter.render_ms"):
+            text = render_metrics_text(
+                counters,
+                histograms,
+                node_name=self.monitor.node_name,
+                rollup=rollup,
+            )
+        self._bump("monitor.exporter.scrapes")
+        return text
+
+    # -- push ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.push_target:
+            loop = self._loop or asyncio.get_event_loop()
+            self._task = loop.create_task(self._push_loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _push_loop(self) -> None:
+        while True:
+            try:
+                text = self.render()
+                fault_point("monitor.exporter.push", self)
+                await self._push_once(text)
+                self._bump("monitor.exporter.pushes")
+                self._backoff.report_success()
+                delay = self.push_interval_s
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                self._bump("monitor.exporter.push_failures")
+                self._backoff.report_error()
+                delay = max(
+                    self._backoff.get_time_remaining_until_retry(),
+                    self._backoff.get_initial_backoff(),
+                )
+            await asyncio.sleep(delay)
+
+    async def _push_once(self, text: str) -> None:
+        host, port = _socket_target(self.push_target)
+        if port is not None:
+            writer = None
+            try:
+                _, writer = await asyncio.open_connection(host, port)
+                writer.write(text.encode())
+                await writer.drain()
+            finally:
+                if writer is not None:
+                    writer.close()
+            return
+        # file sink: atomic replace so a scraping reader never sees a
+        # half-written exposition
+        tmp = f"{self.push_target}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, self.push_target)
+
+
+def _socket_target(target: str):
+    """"host:port" -> (host, int port); anything else is a file path."""
+    host, sep, port = (target or "").rpartition(":")
+    if sep and host and port.isdigit():
+        return host, int(port)
+    return target, None

@@ -15,7 +15,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional
 
 from openr_tpu.messaging import QueueClosedError, RQueue
-from openr_tpu.utils.counters import Histogram
+from openr_tpu.utils.counters import CountersMixin, Histogram
 
 EVENT_LOG_CATEGORY = "openr.event_logs"  # Constants::kEventLogCategory
 
@@ -100,8 +100,12 @@ class LogSample:
         return sample
 
 
-class Monitor:
-    """Counter aggregation + event-log ring (MonitorBase equivalent)."""
+class Monitor(CountersMixin):
+    """Counter aggregation + event-log ring (MonitorBase equivalent), plus
+    the eviction-proof convergence rollup: finished CONVERGENCE_TRACE spans
+    fold into fixed-cost windowed aggregates at record time (monitor/
+    report.py:ConvergenceRollup), so convergence reports cover every event
+    since start even after the `max_event_log` ring evicts the samples."""
 
     def __init__(
         self,
@@ -109,16 +113,28 @@ class Monitor:
         log_sample_queue: Optional[RQueue] = None,
         max_event_log: int = 100,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        rollup_window_s: float = 60.0,
+        rollup_max_windows: int = 120,
     ) -> None:
+        from openr_tpu.monitor.report import ConvergenceRollup
+
         self.node_name = node_name
         self.log_sample_queue = log_sample_queue
         self.max_event_log = max_event_log
         self._loop = loop
         self.event_logs: List[LogSample] = []
+        self.rollup = ConvergenceRollup(
+            window_s=rollup_window_s, max_windows=rollup_max_windows
+        )
         # name -> module exposing .counters dict (CountersMixin)
         self._modules: Dict[str, object] = {}
         self._task: Optional[asyncio.Task] = None
         self.process_start = time.time()
+        self.counters: Dict[str, int] = {}
+        # histogram samples cleared by reset-on-read snapshots, preserved
+        # for the exporter's non-resetting cumulative view (see
+        # get_cumulative_histograms)
+        self._reset_accum: Dict[str, Histogram] = {}
 
     def register_module(self, name: str, module: object) -> None:
         """Modules register so their counters appear in getCounters."""
@@ -145,9 +161,16 @@ class Monitor:
     def add_event_log(self, sample: LogSample) -> None:
         if sample.get("node_name") is None:
             sample.add_string("node_name", self.node_name)
+        from openr_tpu.monitor.spans import SPAN_EVENT
+
+        if sample.get("event") == SPAN_EVENT:
+            # record-time fold: the rollup sees every span exactly once,
+            # before the bounded ring below can evict its sample
+            self.rollup.record_span(sample.values(), ts=sample.timestamp)
         self.event_logs.append(sample)
         while len(self.event_logs) > self.max_event_log:
             self.event_logs.pop(0)
+            self._bump("monitor.event_log_evictions")
 
     def get_event_logs(self) -> List[LogSample]:
         return list(self.event_logs)
@@ -158,6 +181,7 @@ class Monitor:
         merged: Dict[str, int] = {
             "process.uptime.seconds": int(time.time() - self.process_start),
         }
+        merged.update(self.counters)
         for module in self._modules.values():
             counters = getattr(module, "counters", None)
             if isinstance(counters, dict):
@@ -170,6 +194,30 @@ class Monitor:
         """Merged latency histograms of every registered module (the
         getHistograms ctrl API surface): name -> exported stats dict
         (count/sum/avg/min/max/p50/p95/p99). `reset=True` clears every
-        source histogram after export (reset-on-read windowing)."""
+        source histogram after export (reset-on-read windowing); the
+        cleared samples are preserved in the reset accumulator so the
+        exporter's cumulative view (get_cumulative_histograms) never
+        loses them to another consumer's snapshot."""
         merged = merge_module_histograms(self._modules.values(), reset=reset)
+        if reset:
+            for name, hist in merged.items():
+                acc = self._reset_accum.get(name)
+                if acc is None:
+                    self._reset_accum[name] = hist.copy()
+                else:
+                    acc.merge(hist)
         return {name: h.to_dict() for name, h in sorted(merged.items())}
+
+    def get_cumulative_histograms(self) -> Dict[str, Histogram]:
+        """Non-resetting, reset-proof histogram view (live Histogram
+        objects): the live module histograms merged with every sample a
+        `reset=True` snapshot cleared. A scrape racing a `--reset`
+        dashboard therefore still exports lifetime-cumulative
+        distributions — the exporter contract (docs/Monitoring.md)."""
+        merged = merge_module_histograms(self._modules.values(), reset=False)
+        for name, acc in self._reset_accum.items():
+            if name in merged:
+                merged[name].merge(acc)
+            else:
+                merged[name] = acc.copy()
+        return merged

@@ -24,15 +24,14 @@ routes latency, not local solve time.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Iterable, List, Optional
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from openr_tpu.monitor.spans import SPAN_EVENT
+from openr_tpu.monitor.spans import SPAN_EVENT, sample_stage_durations
+from openr_tpu.utils.counters import Histogram
 
 FLOOD_TRACE_EVENT = "FLOOD_TRACE"  # mirrors kvstore/store.py (no import
 # cycle: kvstore.store already imports monitor.monitor)
-
-# span-sample keys that are not per-stage durations
-_NON_STAGE_KEYS = {"event", "span", "node_name", "total_ms"}
 
 
 def percentile_summary(values: Iterable[float]) -> Dict[str, float]:
@@ -60,6 +59,206 @@ def percentile_summary(values: Iterable[float]) -> Dict[str, float]:
         "p50": rank(50),
         "p95": rank(95),
         "max": samples[-1],
+    }
+
+
+# ---------------------------------------------------------------------------
+# eviction-proof windowed rollups
+# ---------------------------------------------------------------------------
+
+
+class ConvergenceRollup:
+    """Fixed-cost, eviction-proof aggregation of convergence spans.
+
+    The monitor's event-log ring keeps the last `max_event_log` LogSamples
+    of ANY kind, so on a busy node a span sample lives seconds before
+    FLOOD_TRACEs push it out — which is why every convergence claim so far
+    covered single flaps only. The rollup folds each finished span into
+    two aggregate layers AT RECORD TIME (Monitor.add_event_log), before
+    the ring can evict it:
+
+      - **cumulative**: one mergeable Histogram per stage (plus the
+        `total` end-to-end pseudo-stage) covering every span since
+        process start — the layer the exporter serves and the layer that
+        must account for 100% of events regardless of ring size;
+      - **windowed**: the same per-stage histograms bucketed into
+        `window_s`-wide wall-clock windows, kept in a bounded ring of
+        `max_windows` (evicted windows fold their event count into
+        `evicted_events`; their samples stay in the cumulative layer, so
+        window eviction loses trend resolution, never data).
+
+    Memory is O(max_windows x stages), independent of event rate; one
+    record is O(stages) Histogram.record calls. Snapshots are
+    JSON-serializable (sparse histograms) and merge across nodes —
+    wall-clock window starts align inside an emulator host and are
+    NTP-close across real hosts.
+    """
+
+    TOTAL_STAGE = "total"
+
+    def __init__(
+        self,
+        window_s: float = 60.0,
+        max_windows: int = 120,
+        clock=time.time,
+    ) -> None:
+        assert window_s > 0 and max_windows >= 1
+        self.window_s = float(window_s)
+        self.max_windows = int(max_windows)
+        self._clock = clock
+        self.events_total = 0
+        self.evicted_events = 0
+        self.window_evictions = 0
+        self.cumulative: Dict[str, Histogram] = {}
+        # ordered oldest->newest: (window index, {"events": n, stages})
+        self._windows: List[Tuple[int, Dict[str, Any]]] = []
+
+    def record_span(
+        self, values: Dict[str, Any], ts: Optional[float] = None
+    ) -> None:
+        """Fold one finished span's value map (LogSample shape) into the
+        cumulative and windowed layers."""
+        stages = sample_stage_durations(values)
+        if not stages:
+            return
+        when = self._clock() if ts is None else float(ts)
+        window = self._window_for(when)
+        self.events_total += 1
+        if window is None:  # stamp predates the retained window ring
+            self.evicted_events += 1
+        else:
+            window["events"] += 1
+        for stage, ms in stages.items():
+            cum = self.cumulative.get(stage)
+            if cum is None:
+                cum = self.cumulative[stage] = Histogram()
+            cum.record(ms)
+            if window is None:
+                continue
+            win = window["stages"].get(stage)
+            if win is None:
+                win = window["stages"][stage] = Histogram()
+            win.record(ms)
+
+    def _window_for(self, when: float) -> Optional[Dict[str, Any]]:
+        """Retained window for a wall-clock stamp; None when the stamp's
+        window already left the bounded ring (the sample then counts as
+        evicted and lands only in the cumulative layer). Out-of-order
+        stamps (monitor-queue drain lag) fold into their retained window
+        rather than tearing the ring order."""
+        index = int(when // self.window_s)
+        if self._windows:
+            if self._windows[-1][0] == index:
+                return self._windows[-1][1]
+            for idx, window in reversed(self._windows):
+                if idx == index:
+                    return window
+                if idx < index:
+                    break
+            if (
+                index < self._windows[0][0]
+                and len(self._windows) >= self.max_windows
+            ):
+                return None
+        window: Dict[str, Any] = {"events": 0, "stages": {}}
+        self._windows.append((index, window))
+        self._windows.sort(key=lambda iw: iw[0])
+        while len(self._windows) > self.max_windows:
+            _, evicted = self._windows.pop(0)
+            self.window_evictions += 1
+            self.evicted_events += evicted["events"]
+        return window
+
+    def windowed_events(self) -> int:
+        """Events still resolvable to a retained window; plus
+        `evicted_events` this always equals `events_total` — the
+        no-eviction-loss invariant the soak verdict checks."""
+        return sum(w["events"] for _, w in self._windows)
+
+    def last_window(self) -> Optional[Dict[str, Any]]:
+        """Newest window (may still be filling): {"start", "events",
+        "stages": {stage: Histogram}} — the exporter's windowed gauges."""
+        if not self._windows:
+            return None
+        index, window = self._windows[-1]
+        return {
+            "start": index * self.window_s,
+            "events": window["events"],
+            "stages": window["stages"],
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable export (sparse histograms), the shape served
+        inside node_convergence_report and merged network-wide by
+        merge_rollup_snapshots."""
+        return {
+            "window_s": self.window_s,
+            "max_windows": self.max_windows,
+            "events_total": self.events_total,
+            "evicted_events": self.evicted_events,
+            "window_evictions": self.window_evictions,
+            "cumulative": {
+                stage: h.to_sparse()
+                for stage, h in sorted(self.cumulative.items())
+            },
+            "windows": [
+                {
+                    "start": index * self.window_s,
+                    "events": window["events"],
+                    "stages": {
+                        stage: h.to_sparse()
+                        for stage, h in sorted(window["stages"].items())
+                    },
+                }
+                for index, window in self._windows
+            ],
+        }
+
+
+def merge_rollup_snapshots(
+    snapshots: Iterable[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Fold per-node rollup snapshots into one network-wide rollup with
+    live Histogram objects: same-start windows merge across nodes (the
+    wall clock is the shared axis). Returns {"window_s", "events_total",
+    "evicted_events", "window_evictions", "cumulative": {stage: Histogram},
+    "windows": [{"start", "events", "stages": {stage: Histogram}}]}."""
+    window_s = 0.0
+    events_total = evicted = window_evictions = 0
+    cumulative: Dict[str, Histogram] = {}
+    windows: Dict[float, Dict[str, Any]] = {}
+    for snap in snapshots:
+        if not snap:
+            continue
+        window_s = window_s or float(snap.get("window_s", 0.0))
+        events_total += int(snap.get("events_total", 0))
+        evicted += int(snap.get("evicted_events", 0))
+        window_evictions += int(snap.get("window_evictions", 0))
+        for stage, sparse in (snap.get("cumulative") or {}).items():
+            hist = Histogram.from_sparse(sparse)
+            if stage in cumulative:
+                cumulative[stage].merge(hist)
+            else:
+                cumulative[stage] = hist
+        for window in snap.get("windows") or []:
+            start = float(window.get("start", 0.0))
+            merged = windows.setdefault(
+                start, {"start": start, "events": 0, "stages": {}}
+            )
+            merged["events"] += int(window.get("events", 0))
+            for stage, sparse in (window.get("stages") or {}).items():
+                hist = Histogram.from_sparse(sparse)
+                if stage in merged["stages"]:
+                    merged["stages"][stage].merge(hist)
+                else:
+                    merged["stages"][stage] = hist
+    return {
+        "window_s": window_s,
+        "events_total": events_total,
+        "evicted_events": evicted,
+        "window_evictions": window_evictions,
+        "cumulative": cumulative,
+        "windows": [windows[start] for start in sorted(windows)],
     }
 
 
@@ -100,6 +299,9 @@ def node_convergence_report(
     flood_stats["duplicate_ratio"] = (
         flood_stats["duplicates"] / received if received else 0.0
     )
+    # eviction-proof layer: the record-time windowed rollup covers every
+    # span since start even after the ring above evicted its sample
+    rollup = getattr(monitor, "rollup", None)
     return {
         "node": node_name,
         "spans": spans,
@@ -108,16 +310,44 @@ def node_convergence_report(
         ],
         "floods": floods,
         "flood": flood_stats,
+        "rollup": rollup.snapshot() if rollup is not None else None,
     }
 
 
 def _span_stages(span: Dict[str, Any]) -> Dict[str, float]:
+    stages = sample_stage_durations(span)
+    stages.pop(ConvergenceRollup.TOTAL_STAGE, None)  # not a pipeline stage
+    return stages
+
+
+def _aggregate_rollups(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Network-wide cumulative-vs-windowed split from the per-node rollup
+    snapshots: unlike the ring-derived sections (bounded by max_event_log),
+    `events_total` here accounts for every span since node start."""
+    merged = merge_rollup_snapshots(
+        r.get("rollup") for r in reports if r.get("rollup")
+    )
     return {
-        key[: -len("_ms")]: float(value)
-        for key, value in span.items()
-        if key.endswith("_ms")
-        and key not in _NON_STAGE_KEYS
-        and isinstance(value, (int, float))
+        "window_s": merged["window_s"],
+        "events_total": merged["events_total"],
+        "evicted_events": merged["evicted_events"],
+        "window_evictions": merged["window_evictions"],
+        "cumulative": {
+            stage: hist.to_dict()
+            for stage, hist in sorted(merged["cumulative"].items())
+        },
+        "windows": [
+            {
+                "start": window["start"],
+                "events": window["events"],
+                "e2e_ms": (
+                    window["stages"][ConvergenceRollup.TOTAL_STAGE].to_dict()
+                    if ConvergenceRollup.TOTAL_STAGE in window["stages"]
+                    else Histogram().to_dict()
+                ),
+            }
+            for window in merged["windows"]
+        ],
     }
 
 
@@ -160,6 +390,7 @@ def aggregate_convergence_reports(
             for stage, samples in sorted(stage_samples.items())
         },
         "slowest_stage": slowest,
+        "rollup": _aggregate_rollups(reports),
         "flood": {
             "received": received,
             "duplicates": duplicates,

@@ -32,6 +32,27 @@ from openr_tpu.monitor.monitor import LogSample
 
 SPAN_EVENT = "CONVERGENCE_TRACE"
 
+# finished-span sample keys that are not per-stage durations ("total_ms"
+# is the end-to-end duration, exposed as the "total" pseudo-stage)
+_NON_STAGE_KEYS = {"event", "span", "node_name"}
+
+
+def sample_stage_durations(values: Dict[str, float]) -> Dict[str, float]:
+    """stage -> ms from one finished span's LogSample value map (the
+    CONVERGENCE_TRACE export shape produced by Span.to_log_sample).
+    Shared by the point-in-time convergence report and the windowed
+    rollup so both read the same stage vocabulary; the end-to-end
+    `total_ms` field maps to the `total` pseudo-stage."""
+    out: Dict[str, float] = {}
+    for key, value in values.items():
+        if (
+            key.endswith("_ms")
+            and key not in _NON_STAGE_KEYS
+            and isinstance(value, (int, float))
+        ):
+            out[key[: -len("_ms")]] = float(value)
+    return out
+
 
 class Span:
     """Ordered (stage, monotonic-ts) marks over one event's pipeline pass.

@@ -29,7 +29,12 @@ from openr_tpu.fib import Fib, FibConfig
 from openr_tpu.kvstore import KvStore, KvStoreClient, KvStoreParams
 from openr_tpu.linkmonitor.link_monitor import LinkMonitor, LinkMonitorConfig
 from openr_tpu.messaging import ReplicateQueue
-from openr_tpu.monitor import Monitor, Watchdog, WatchdogConfig
+from openr_tpu.monitor import (
+    MetricsExporter,
+    Monitor,
+    Watchdog,
+    WatchdogConfig,
+)
 from openr_tpu.platform import MockFibHandler
 from openr_tpu.prefixmanager import PrefixManager, PrefixManagerConfig
 from openr_tpu.spark.spark import Spark, SparkConfig as SparkModuleConfig
@@ -83,13 +88,25 @@ class OpenrDaemon:
             loop=loop,
         )
 
-        # --- monitor + watchdog ---------------------------------------
+        # --- monitor + watchdog + exporter ----------------------------
+        mc = c.monitor_config
         self.monitor = Monitor(
             node,
             self.log_sample_queue.get_reader(),
-            max_event_log=c.monitor_config.max_event_log,
+            max_event_log=mc.max_event_log,
+            rollup_window_s=mc.rollup_window_s,
+            rollup_max_windows=mc.rollup_max_windows,
             loop=loop,
         )
+        self.exporter = MetricsExporter(
+            self.monitor,
+            push_target=mc.exporter_push_target,
+            push_interval_s=mc.exporter_push_interval_s,
+            loop=loop,
+        )
+        # the exporter registers like any module so its own overhead
+        # metrics (monitor.exporter.*) ride every scrape
+        self.monitor.register_module("monitor", self.exporter)
         self.watchdog: Optional[Watchdog] = None
         if c.enable_watchdog:
             self.watchdog = Watchdog(
@@ -335,6 +352,7 @@ class OpenrDaemon:
             link_monitor=self.link_monitor,
             prefix_manager=self.prefix_manager,
             monitor=self.monitor,
+            exporter=self.exporter,
             config_store=self.config_store,
             config=config,
             loop=loop,
@@ -362,6 +380,7 @@ class OpenrDaemon:
             await self.kvstore_server.start()
             self.spark.config.kvstore_cmd_port = self.kvstore_server.port
         self.monitor.start()
+        self.exporter.start()  # push loop only when a sink is configured
         if self.watchdog is not None:
             for name in ("kvstore", "decision", "fib", "link_monitor"):
                 self.watchdog.add_module(name)
@@ -420,6 +439,7 @@ class OpenrDaemon:
         self.kvstore.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
+        self.exporter.stop()
         self.monitor.stop()
         self.config_store.stop()
         for q in (

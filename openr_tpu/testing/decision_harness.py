@@ -354,6 +354,50 @@ def run_fault_smoke() -> dict:
         loop.close()
 
 
+def _measure_exporter_overhead(net) -> dict:
+    """Exporter-overhead measurement on a converged emulator run (the
+    bench 'exporter_scrape_render_ms' line): best full-registry render
+    latency across nodes (each render parsed back to keep the sample
+    honest — an exposition that stops parsing is a failure, not a fast
+    render), plus the per-record windowed-rollup cost measured by
+    replaying the run's real span samples into a fresh rollup."""
+    import os
+
+    from openr_tpu.monitor.exporter import parse_metrics_text
+    from openr_tpu.monitor.report import ConvergenceRollup
+
+    render_ms: List[float] = []
+    series = 0
+    for wrapper in net.wrappers.values():
+        wrapper.daemon.exporter.render()  # warm the self-metric families
+        t0 = time.perf_counter()
+        text = wrapper.daemon.exporter.render()
+        render_ms.append((time.perf_counter() - t0) * 1e3)
+        series = max(series, len(parse_metrics_text(text)["types"]))
+
+    spans = [
+        span for report in net.node_reports() for span in report["spans"]
+    ]
+    records = max(1, int(os.environ.get("BENCH_EXPORTER_RECORDS", "2000")))
+    rollup = ConvergenceRollup(window_s=60.0)
+    replayed = 0
+    t0 = time.perf_counter()
+    while spans and replayed < records:
+        for span in spans:
+            rollup.record_span(span)
+            replayed += 1
+            if replayed >= records:
+                break
+    elapsed = time.perf_counter() - t0
+    return {
+        "scrape_render_ms": round(min(render_ms), 4) if render_ms else 0.0,
+        "rollup_record_us": (
+            round(elapsed / replayed * 1e6, 3) if replayed else 0.0
+        ),
+        "metrics_series": series,
+    }
+
+
 # stage-duration keys every node's flap span must carry (the spark→fib
 # chain; flood-hop stages are topology-dependent and checked separately)
 TRACE_SMOKE_STAGES = (
@@ -510,7 +554,10 @@ def run_decision_backend_parity(
 
 
 def run_bench_convergence(
-    nodes: int = 5, flaps: int = 2, backend: str = "tpu"
+    nodes: int = 5,
+    flaps: int = 2,
+    backend: str = "tpu",
+    measure_exporter: bool = True,
 ) -> dict:
     """Hello-to-programmed-route percentiles from an emulator flap run —
     bench.py's second metric line (ROADMAP "relight the benchmark").
@@ -572,6 +619,9 @@ def run_bench_convergence(
                 )
                 await wait_until(converged, timeout=60.0)
             agg = net.convergence_report()
+            exporter_stats = (
+                _measure_exporter_overhead(net) if measure_exporter else {}
+            )
         finally:
             await net.stop_all()
 
@@ -584,6 +634,7 @@ def run_bench_convergence(
             "e2e_p50_ms": e2e["p50"],
             "e2e_p95_ms": e2e["p95"],
             "e2e_max_ms": e2e["max"],
+            **exporter_stats,
         }
 
     loop = asyncio.new_event_loop()

@@ -212,3 +212,5 @@ def injected(injector: Optional[FaultInjector] = None):
 #   spark.packet_send     outbound datagram seam, ctx=iface (spark/spark.py)
 #   spark.packet_recv     inbound datagram seam, ctx=ReceivedPacket
 #   te.optimize           TE optimization device dispatch (te/service.py)
+#   monitor.exporter.push metrics push-sink write, ctx=MetricsExporter
+#                         (monitor/exporter.py)

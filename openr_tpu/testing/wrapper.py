@@ -83,11 +83,22 @@ class VirtualNetwork:
     def convergence_report(self) -> dict:
         """Network-wide convergence report over all emulated nodes —
         p50/p95/max node-to-converge, per-stage distributions with
-        slowest-hop attribution, flood-health stats (what `breeze perf
-        report --hosts ...` computes for real deployments)."""
+        slowest-hop attribution, flood-health stats, plus the
+        eviction-proof rollup's cumulative-vs-windowed split (what
+        `breeze perf report --hosts ...` computes for real
+        deployments)."""
         from openr_tpu.monitor.report import aggregate_convergence_reports
 
         return aggregate_convergence_reports(self.node_reports())
+
+    def scrape_all(self) -> Dict[str, str]:
+        """Per-node Prometheus exposition text — the in-process
+        equivalent of polling GET /metrics on every daemon's ctrl port
+        (what the soak harness's scrape loop does each wave)."""
+        return {
+            name: wrapper.daemon.exporter.render()
+            for name, wrapper in self.wrappers.items()
+        }
 
 
 # tightened timers for in-process convergence (OpenrSystemTest.cpp:23-35)

@@ -170,6 +170,32 @@ class Histogram:
             "p99": round(self.p99, 6),
         }
 
+    def to_sparse(self) -> Dict[str, object]:
+        """Lossless JSON-serializable form: only nonzero buckets ride. Unlike
+        to_dict (stats only), a sparse export can be rehydrated with
+        from_sparse and merged — the shape convergence-report rollups use to
+        fold per-node windowed histograms network-wide."""
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "buckets": {
+                str(i): c for i, c in enumerate(self.buckets) if c
+            },
+        }
+
+    @classmethod
+    def from_sparse(cls, data: Dict[str, object]) -> "Histogram":
+        out = cls()
+        for key, c in dict(data.get("buckets") or {}).items():
+            out.buckets[int(key)] = int(c)
+        out.count = int(data.get("count", 0))
+        out.sum = float(data.get("sum", 0.0))
+        out.min = None if data.get("min") is None else float(data["min"])
+        out.max = None if data.get("max") is None else float(data["max"])
+        return out
+
 
 class Timer:
     """Context manager recording elapsed milliseconds into a histogram.

@@ -497,6 +497,13 @@ _DRIFT_MONITORING = """# Monitoring
 | `GOOD_TRACE` | emitted and documented |
 | `PHANTOM_EVENT` | documented but never emitted |
 | `BRACE_{UP,DOWN}` | brace family, UP emitted below |
+
+## Exporter
+
+| exporter metric | meaning |
+|---|---|
+| `monitor.good_metric` | emitted and documented |
+| `monitor.phantom_metric` | documented but never emitted |
 """
 
 _DRIFT_ROBUSTNESS = """# Robustness
@@ -523,6 +530,8 @@ class Widget(CountersMixin):
     def work(self):
         self._bump("fib.good_counter")
         self._bump("fib.family.alpha")
+        self._bump("monitor.good_metric")
+        self._bump("monitor.rogue_metric")
         self._bump("not a counter name")
         self._observe("fib.work_ms", 1.0)
         self._observe("fib.secret_ms", 1.0)
@@ -598,9 +607,19 @@ def test_registry_drift_fixture_violations(tmp_path):
     assert any("ROGUE_EVENT" in m for m in by_check["undocumented-event"])
     assert any("PHANTOM_EVENT" in m for m in by_check["ghost-event"])
     assert any("BRACE_DOWN" in m for m in by_check["ghost-event"])
+    # the exporter-metric table (monitor.* namespace), both directions:
+    # emitted-but-undocumented and documented-but-never-emitted
+    assert any(
+        "monitor.rogue_metric" in m
+        for m in by_check["undocumented-metric"]
+    )
+    assert any(
+        "monitor.phantom_metric" in m for m in by_check["ghost-metric"]
+    )
     # the consistent names stay quiet
     joined = " ".join(m for ms in by_check.values() for m in ms)
     assert "fib.good_counter" not in joined
+    assert "'monitor.good_metric'" not in joined
     assert "'fib.work_ms'" not in joined
     assert "'fib.io'" not in joined
     assert "GOOD_TRACE" not in joined

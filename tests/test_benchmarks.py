@@ -119,8 +119,8 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 4, (
-        "bench.py must print SPF+convergence+TE+scale JSON lines"
+    assert len(out) >= 5, (
+        "bench.py must print SPF+convergence+TE+scale+exporter JSON lines"
     )
     results = [json.loads(line) for line in out]
     for result in results:
@@ -145,6 +145,13 @@ def test_bench_py_smoke(capsys, monkeypatch):
         scale["tile_bytes_per_device"] * b_ax * g_ax
         == scale["replica_bytes_per_device"]
     )
+    # the exporter-overhead line (continuous-telemetry cost on the same
+    # flap batch as the convergence line): a parse-validated render and a
+    # measured per-span rollup fold cost must both be present and nonzero
+    exporter = results[4]
+    assert exporter["metric"] == "exporter_scrape_render_ms"
+    assert exporter["rollup_record_us"] > 0
+    assert exporter["metrics_series"] > 0
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):

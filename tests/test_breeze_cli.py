@@ -303,3 +303,79 @@ def test_perf_view_renders_events(capsys):
     assert "DECISION_RECEIVED" in out
     assert "+0ms" in out
     assert "+3ms" in out
+
+
+def test_monitor_scrape_renders_exposition(ctrl_endpoint, capsys):
+    """`breeze monitor scrape` prints the registry in Prometheus text
+    exposition format — the same bytes GET /metrics serves."""
+    from openr_tpu.monitor.exporter import parse_metrics_text
+
+    host, port = ctrl_endpoint
+    assert breeze(host, port, "monitor", "scrape") == 0
+    out = capsys.readouterr().out
+    parsed = parse_metrics_text(out)
+    assert "openr_process_uptime_seconds" in parsed["gauges"]
+    hist = parsed["histograms"]["openr_decision_spf_solve_ms"]
+    assert hist["count"] == 3
+
+
+def test_perf_soak_report_renders_offline(capsys, tmp_path):
+    """`breeze perf soak-report FILE` renders a judged soak report from
+    disk without dialing any daemon (no ctrl endpoint in this test)."""
+    import json as json_mod
+
+    report = {
+        "verdict": {
+            "pass": True,
+            "checks": {
+                "no_eviction_loss": {
+                    "ok": True,
+                    "detail": "rollup counted 40 of 40 spans",
+                },
+                "scrape_health": {"ok": True, "detail": "12 scrapes"},
+            },
+        },
+        "events": {
+            "total": 40,
+            "windowed": 38,
+            "evicted_window_events": 2,
+            "spans_in_rings": 9,
+        },
+        "waves": [
+            {
+                "index": 0,
+                "added": ["n0-n2"],
+                "removed": [],
+                "faulted": True,
+                "converged": True,
+                "converge_ms": 41.2,
+            }
+        ],
+        "windows": [
+            {
+                "start": 1000.0,
+                "events": 38,
+                "faulted": True,
+                "e2e_p50_ms": 12.5,
+                "e2e_p95_ms": 31.0,
+                "e2e_max_ms": 44.0,
+            }
+        ],
+        "attribution": {
+            "clean_windows": 0,
+            "faulted_windows": 1,
+            "clean_e2e_ms": {"p95": 0.0},
+            "faulted_e2e_ms": {"p95": 31.0},
+        },
+    }
+    path = tmp_path / "soak.json"
+    path.write_text(json_mod.dumps(report))
+    assert breeze_main(["perf", "soak-report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "soak verdict: PASS (2 check(s))" in out
+    assert "no_eviction_loss" in out
+    assert "40 total = 38 windowed + 2 window-evicted" in out
+    assert "n0-n2" in out
+    assert "windowed convergence trend:" in out
+    assert "31.00" in out
+    assert "attribution: clean 0 window(s)" in out

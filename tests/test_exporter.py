@@ -1,0 +1,403 @@
+"""Exporter tests: Prometheus exposition render/parse round-trip, the
+non-resetting cumulative view (reset-race double-consumer contract), the
+push loop with backoff, and the ctrl scrape surfaces (getMetricsText +
+the HTTP-ish GET /metrics handler on the ctrl port)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.ctrl import CtrlClient, CtrlServer
+from openr_tpu.monitor import (
+    LogSample,
+    MetricsExporter,
+    Monitor,
+    parse_metrics_text,
+    render_metrics_text,
+)
+from openr_tpu.monitor.exporter import prom_name
+from openr_tpu.monitor.spans import Span
+from openr_tpu.utils.counters import Histogram
+
+
+def run(coro, timeout=15.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def _module(counters=None, histograms=None):
+    class Fake:
+        pass
+
+    mod = Fake()
+    mod.counters = dict(counters or {})
+    mod.histograms = dict(histograms or {})
+    return mod
+
+
+def _hist(*values):
+    h = Histogram()
+    for v in values:
+        h.record(v)
+    return h
+
+
+def _monitor_with_registry():
+    mon = Monitor("n1", rollup_window_s=1.0)
+    mon.register_module(
+        "decision",
+        _module(
+            counters={
+                "decision.spf.full_solves": 4,
+                "decision.spf.rounds_last": 9,  # gauge-typed
+            },
+            histograms={"decision.spf.solve_ms": _hist(0.5, 2.0, 40.0)},
+        ),
+    )
+    mon.register_module(
+        "fib",
+        _module(
+            counters={"fib.num_of_route_updates": 7},
+            histograms={"fib.program_ms": _hist(1.25)},
+        ),
+    )
+    return mon
+
+
+class TestRenderParse:
+    def test_round_trip_covers_every_registered_name(self):
+        """The acceptance contract: the exposition parses and covers every
+        registered counter and histogram (the exporter's own overhead
+        metrics appear from the second scrape on, so scrape twice)."""
+        mon = _monitor_with_registry()
+        exporter = MetricsExporter(mon)
+        mon.register_module("monitor", exporter)
+        exporter.render()
+        text = exporter.render()
+        parsed = parse_metrics_text(text)
+        exported = set(parsed["samples"])
+        for name in mon.get_counters():
+            assert prom_name(name) in exported, name
+        for name in mon.get_cumulative_histograms():
+            assert prom_name(name) + "_count" in exported, name
+        # self-telemetry rode along
+        assert parsed["counters"]["openr_monitor_exporter_scrapes"] == 1
+        assert "openr_monitor_exporter_render_ms" in parsed["histograms"]
+
+    def test_counter_and_histogram_values_round_trip(self):
+        counters = {"decision.spf.full_solves": 4}
+        hist = _hist(0.5, 2.0, 40.0)
+        text = render_metrics_text(
+            counters, {"decision.spf.solve_ms": hist}, node_name="n1"
+        )
+        parsed = parse_metrics_text(text)
+        assert parsed["counters"]["openr_decision_spf_full_solves"] == 4
+        h = parsed["histograms"]["openr_decision_spf_solve_ms"]
+        assert h["count"] == 3
+        assert h["sum"] == pytest.approx(42.5)
+        # bucket series is cumulative and ends at the +Inf total
+        assert h["buckets"]["+Inf"] == 3
+        assert sorted(h["buckets"].values())[-1] == 3
+
+    def test_gauge_vs_counter_typing(self):
+        text = render_metrics_text(
+            {
+                "decision.spf.rounds_last": 3,
+                "decision.spf.fallback_active": 1,
+                "process.uptime.seconds": 12,
+                "decision.spf.full_solves": 9,
+            },
+            {},
+        )
+        types = parse_metrics_text(text)["types"]
+        assert types["openr_decision_spf_rounds_last"] == "gauge"
+        assert types["openr_decision_spf_fallback_active"] == "gauge"
+        assert types["openr_process_uptime_seconds"] == "gauge"
+        assert types["openr_decision_spf_full_solves"] == "counter"
+
+    def test_rollup_split_rides_the_exposition(self):
+        mon = Monitor("n1", rollup_window_s=60.0)
+        span = Span("flap")
+        span.mark("kvstore.publish")
+        span.mark("fib.program")
+        mon.add_event_log(span.to_log_sample())
+        text = render_metrics_text(
+            {}, {}, node_name="n1", rollup=mon.rollup
+        )
+        parsed = parse_metrics_text(text)
+        assert parsed["counters"]["openr_monitor_rollup_events_total"] == 1
+        assert parsed["gauges"]["openr_convergence_window_events"] == 1
+        assert (
+            parsed["types"]["openr_convergence_window_e2e_ms"] == "gauge"
+        )
+
+    def test_malformed_text_raises(self):
+        with pytest.raises(ValueError):
+            parse_metrics_text("this is { not exposition\n")
+
+    def test_node_label_escaped(self):
+        text = render_metrics_text(
+            {"decision.adj_db_update": 1}, {}, node_name='we"ird'
+        )
+        parsed = parse_metrics_text(text)
+        assert parsed["counters"]["openr_decision_adj_db_update"] == 1
+
+
+class TestResetRace:
+    def test_exporter_view_survives_reset_on_read(self):
+        """The double-consumer contract: a --reset histogram snapshot
+        racing the exporter must not drop samples from the scrape — the
+        cumulative view folds in everything a reset cleared."""
+        hist = _hist(1.0, 2.0)
+        mon = Monitor("n1")
+        mon.register_module(
+            "decision", _module(histograms={"decision.spf.solve_ms": hist})
+        )
+        # consumer A: reset-on-read dashboard takes a snapshot
+        snap1 = mon.get_histograms(reset=True)
+        assert snap1["decision.spf.solve_ms"]["count"] == 2
+        assert hist.count == 0  # sources cleared
+        hist.record(5.0)
+        # consumer B: the exporter still sees ALL three samples
+        cum = mon.get_cumulative_histograms()
+        assert cum["decision.spf.solve_ms"].count == 3
+        assert cum["decision.spf.solve_ms"].max == 5.0
+        # a second reset window and another scrape: still cumulative
+        snap2 = mon.get_histograms(reset=True)
+        assert snap2["decision.spf.solve_ms"]["count"] == 1
+        cum = mon.get_cumulative_histograms()
+        assert cum["decision.spf.solve_ms"].count == 3
+        # while the reset consumer keeps seeing disjoint windows
+        assert mon.get_histograms(reset=True)[
+            "decision.spf.solve_ms"
+        ]["count"] == 0
+
+
+class TestPushLoop:
+    def test_push_to_file_sink(self, tmp_path):
+        """Push mode renders on the interval and atomically replaces the
+        sink file with parseable exposition text."""
+        target = tmp_path / "metrics.prom"
+
+        async def body():
+            mon = _monitor_with_registry()
+            exporter = MetricsExporter(
+                mon,
+                push_target=str(target),
+                push_interval_s=0.02,
+            )
+            mon.register_module("monitor", exporter)
+            exporter.start()
+            try:
+                for _ in range(200):
+                    if (
+                        target.exists()
+                        and exporter.counters.get(
+                            "monitor.exporter.pushes", 0
+                        )
+                        >= 2
+                    ):
+                        break
+                    await asyncio.sleep(0.01)
+                parsed = parse_metrics_text(target.read_text())
+                assert (
+                    "openr_decision_spf_full_solves" in parsed["counters"]
+                )
+                assert (
+                    exporter.counters["monitor.exporter.pushes"] >= 2
+                )
+                assert (
+                    exporter.counters.get(
+                        "monitor.exporter.push_failures", 0
+                    )
+                    == 0
+                )
+            finally:
+                exporter.stop()
+
+        run(body())
+
+    def test_push_failure_backs_off_and_recovers(self, tmp_path):
+        """An injected sink failure counts a push_failure, arms the
+        backoff, and the loop keeps going (later pushes succeed)."""
+        from openr_tpu.testing.faults import FaultInjector, injected
+
+        target = tmp_path / "metrics.prom"
+
+        async def body():
+            mon = _monitor_with_registry()
+            exporter = MetricsExporter(
+                mon,
+                push_target=str(target),
+                push_interval_s=0.01,
+                backoff_min_s=0.01,
+                backoff_max_s=0.05,
+            )
+            with injected(FaultInjector(seed=1)) as inj:
+                inj.arm("monitor.exporter.push", times=2)
+                exporter.start()
+                try:
+                    for _ in range(400):
+                        if (
+                            exporter.counters.get(
+                                "monitor.exporter.pushes", 0
+                            )
+                            >= 1
+                        ):
+                            break
+                        await asyncio.sleep(0.01)
+                finally:
+                    exporter.stop()
+                assert (
+                    exporter.counters["monitor.exporter.push_failures"]
+                    == 2
+                )
+                assert exporter.counters["monitor.exporter.pushes"] >= 1
+                assert inj.fired("monitor.exporter.push") == 2
+
+        run(body())
+
+    def test_socket_sink_target_parsing(self):
+        from openr_tpu.monitor.exporter import _socket_target
+
+        assert _socket_target("127.0.0.1:9091") == ("127.0.0.1", 9091)
+        assert _socket_target("/var/run/metrics.prom")[1] is None
+        assert _socket_target("relative/path.prom")[1] is None
+
+    def test_push_to_socket_sink(self):
+        """host:port sinks get one TCP write per interval."""
+
+        async def body():
+            received = []
+
+            async def sink(reader, writer):
+                received.append(await reader.read())
+                writer.close()
+
+            server = await asyncio.start_server(sink, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            mon = _monitor_with_registry()
+            exporter = MetricsExporter(
+                mon,
+                push_target=f"127.0.0.1:{port}",
+                push_interval_s=0.02,
+            )
+            exporter.start()
+            try:
+                for _ in range(200):
+                    if received:
+                        break
+                    await asyncio.sleep(0.01)
+            finally:
+                exporter.stop()
+                server.close()
+                await server.wait_closed()
+            assert received
+            parsed = parse_metrics_text(received[0].decode())
+            assert "openr_decision_spf_full_solves" in parsed["counters"]
+
+        run(body())
+
+
+class TestCtrlScrape:
+    async def _server(self):
+        mon = _monitor_with_registry()
+        exporter = MetricsExporter(mon)
+        mon.register_module("monitor", exporter)
+        server = CtrlServer(
+            "scrape-node", port=0, monitor=mon, exporter=exporter
+        )
+        port = await server.start()
+        return server, port
+
+    def test_get_metrics_text_method(self):
+        async def body():
+            server, port = await self._server()
+            client = await CtrlClient("127.0.0.1", port).connect()
+            text = await client.call("getMetricsText")
+            parsed = parse_metrics_text(text)
+            assert (
+                parsed["counters"]["openr_decision_spf_full_solves"] == 4
+            )
+            # same connection still serves JSON afterwards
+            assert await client.call("getMyNodeName") == "scrape-node"
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+    def test_http_get_metrics_on_ctrl_port(self):
+        """A stock HTTP GET against the ctrl port returns a one-shot
+        text/plain exposition response (the Prometheus scrape path)."""
+
+        async def http_get(port, path):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                "Accept: */*\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            head, _, body = raw.partition(b"\r\n\r\n")
+            return head.decode(), body.decode()
+
+        async def body():
+            server, port = await self._server()
+            head, text = await http_get(port, "/metrics")
+            assert head.startswith("HTTP/1.0 200 OK")
+            assert "text/plain; version=0.0.4" in head
+            parsed = parse_metrics_text(text)
+            assert (
+                parsed["counters"]["openr_decision_spf_full_solves"] == 4
+            )
+            head, _ = await http_get(port, "/nope")
+            assert head.startswith("HTTP/1.0 404")
+            await server.stop()
+
+        run(body())
+
+    def test_monitorless_fallback_renders_modules(self):
+        async def body():
+            fib = _module(
+                counters={"fib.num_of_route_updates": 2},
+                histograms={"fib.program_ms": _hist(3.0)},
+            )
+            server = CtrlServer("bare-node", port=0, fib=fib)
+            port = await server.start()
+            client = await CtrlClient("127.0.0.1", port).connect()
+            parsed = parse_metrics_text(
+                await client.call("getMetricsText")
+            )
+            assert (
+                parsed["counters"]["openr_fib_num_of_route_updates"] == 2
+            )
+            assert "openr_fib_program_ms" in parsed["histograms"]
+            await client.close()
+            await server.stop()
+
+        run(body())
+
+
+class TestLogSampleTimestamp:
+    def test_span_rollup_uses_sample_timestamp(self):
+        """Spans fold into the window of their LogSample stamp, not the
+        drain time — queue lag cannot smear events across windows."""
+        mon = Monitor("n1", rollup_window_s=10.0, rollup_max_windows=4)
+        span = Span("flap")
+        span.mark("fib.program")
+        sample = span.to_log_sample()
+        sample.timestamp = 1005.0
+        mon.add_event_log(sample)
+        snap = mon.rollup.snapshot()
+        assert snap["windows"][0]["start"] == 1000.0
+        assert snap["events_total"] == 1
+
+    def test_non_span_samples_do_not_touch_rollup(self):
+        mon = Monitor("n1")
+        mon.add_event_log(LogSample().add_string("event", "FLOOD_TRACE"))
+        assert mon.rollup.events_total == 0

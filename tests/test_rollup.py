@@ -1,0 +1,282 @@
+"""Windowed-rollup tests: the eviction-proof invariant (windowed totals
+account for every span even when the LogSample ring only retains a
+tail), window-ring bounds, sparse-histogram round-trips, cross-node
+snapshot merging, and the report/aggregate surfaces that carry the
+cumulative-vs-windowed split."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.monitor import LogSample, Monitor
+from openr_tpu.monitor.report import (
+    ConvergenceRollup,
+    aggregate_convergence_reports,
+    merge_rollup_snapshots,
+    node_convergence_report,
+)
+from openr_tpu.monitor.spans import Span
+from openr_tpu.utils.counters import Histogram
+
+
+def _span_values(total_ms=5.0, **stages):
+    values = {"event": "CONVERGENCE_TRACE", "span": "flap"}
+    values.update({f"{k}_ms": v for k, v in stages.items()})
+    values["total_ms"] = total_ms
+    return values
+
+
+class TestConvergenceRollup:
+    def test_windows_and_cumulative_split(self):
+        clock = {"t": 100.0}
+        rollup = ConvergenceRollup(
+            window_s=10.0, max_windows=8, clock=lambda: clock["t"]
+        )
+        rollup.record_span(_span_values(3.0, fib_program=1.0))
+        clock["t"] = 112.0
+        rollup.record_span(_span_values(7.0, fib_program=2.0))
+        snap = rollup.snapshot()
+        assert snap["events_total"] == 2
+        assert [w["start"] for w in snap["windows"]] == [100.0, 110.0]
+        assert all(w["events"] == 1 for w in snap["windows"])
+        cum = Histogram.from_sparse(snap["cumulative"]["total"])
+        assert cum.count == 2 and cum.max == 7.0
+
+    def test_window_ring_bounded_with_eviction_accounting(self):
+        clock = {"t": 0.0}
+        rollup = ConvergenceRollup(
+            window_s=1.0, max_windows=3, clock=lambda: clock["t"]
+        )
+        for i in range(10):
+            clock["t"] = float(i)
+            rollup.record_span(_span_values(1.0))
+        snap = rollup.snapshot()
+        assert len(snap["windows"]) == 3
+        assert snap["window_evictions"] == 7
+        assert snap["evicted_events"] == 7
+        # the invariant: windowed + evicted == total, nothing lost
+        assert (
+            sum(w["events"] for w in snap["windows"])
+            + snap["evicted_events"]
+            == snap["events_total"]
+            == 10
+        )
+        # cumulative layer kept every sample
+        assert snap["cumulative"]["total"]["count"] == 10
+
+    def test_out_of_order_stamp_folds_into_its_window(self):
+        clock = {"t": 0.0}
+        rollup = ConvergenceRollup(
+            window_s=10.0, max_windows=8, clock=lambda: clock["t"]
+        )
+        rollup.record_span(_span_values(1.0), ts=5.0)
+        rollup.record_span(_span_values(1.0), ts=25.0)
+        rollup.record_span(_span_values(1.0), ts=7.0)  # late drain
+        snap = rollup.snapshot()
+        assert [w["events"] for w in snap["windows"]] == [2, 1]
+        assert snap["evicted_events"] == 0
+
+    def test_stamp_older_than_ring_counts_as_evicted(self):
+        rollup = ConvergenceRollup(window_s=1.0, max_windows=2)
+        for ts in (100.0, 101.0):
+            rollup.record_span(_span_values(1.0), ts=ts)
+        rollup.record_span(_span_values(1.0), ts=50.0)  # pre-ring
+        snap = rollup.snapshot()
+        assert snap["events_total"] == 3
+        assert snap["evicted_events"] == 1
+        assert sum(w["events"] for w in snap["windows"]) == 2
+        assert snap["cumulative"]["total"]["count"] == 3
+
+    def test_spanless_sample_ignored(self):
+        rollup = ConvergenceRollup()
+        rollup.record_span({"event": "CONVERGENCE_TRACE"})
+        assert rollup.events_total == 0
+
+
+class TestSparseHistogram:
+    def test_round_trip_preserves_stats_and_percentiles(self):
+        h = Histogram()
+        for v in (0.0005, 1.5, 2.5, 40.0, 4000.0):
+            h.record(v)
+        back = Histogram.from_sparse(h.to_sparse())
+        assert back.count == h.count
+        assert back.sum == pytest.approx(h.sum)
+        assert back.min == h.min and back.max == h.max
+        for p in (50, 95, 99):
+            assert back.percentile(p) == pytest.approx(h.percentile(p))
+
+    def test_empty_round_trip(self):
+        back = Histogram.from_sparse(Histogram().to_sparse())
+        assert back.count == 0 and back.min is None
+
+
+class TestMergeSnapshots:
+    def test_same_window_merges_across_nodes(self):
+        snaps = []
+        for node_ms in (2.0, 8.0):
+            rollup = ConvergenceRollup(window_s=10.0)
+            rollup.record_span(_span_values(node_ms), ts=105.0)
+            snaps.append(rollup.snapshot())
+        merged = merge_rollup_snapshots(snaps)
+        assert merged["events_total"] == 2
+        assert len(merged["windows"]) == 1
+        window = merged["windows"][0]
+        assert window["start"] == 100.0 and window["events"] == 2
+        total = window["stages"]["total"]
+        assert total.count == 2 and total.max == 8.0
+        assert merged["cumulative"]["total"].count == 2
+
+    def test_empty_and_none_snapshots_tolerated(self):
+        merged = merge_rollup_snapshots([None, {}, {"windows": []}])
+        assert merged["events_total"] == 0 and merged["windows"] == []
+
+
+class TestMonitorRecordTimeFold:
+    def test_ring_evicts_but_rollup_counts_everything(self):
+        """The headline invariant at the Monitor level: push 25 spans
+        through a 4-deep ring — the ring holds the tail, the rollup
+        holds history."""
+        mon = Monitor("n1", max_event_log=4, rollup_window_s=60.0)
+        for i in range(25):
+            span = Span("flap")
+            span.mark("fib.program")
+            mon.add_event_log(span.to_log_sample())
+            # interleave flood noise, the realistic eviction pressure
+            mon.add_event_log(
+                LogSample().add_string("event", "FLOOD_TRACE")
+            )
+        assert len(mon.get_event_logs()) == 4
+        assert mon.rollup.events_total == 25
+        assert mon.counters["monitor.event_log_evictions"] == 46
+        report = node_convergence_report("n1", mon)
+        assert len(report["spans"]) <= 4
+        assert report["rollup"]["events_total"] == 25
+
+    def test_aggregate_report_carries_rollup_section(self):
+        monitors = []
+        for node in ("a", "b"):
+            mon = Monitor(node, max_event_log=2, rollup_window_s=60.0)
+            for _ in range(6):
+                span = Span("flap")
+                span.mark("decision.route_build")
+                span.mark("fib.program")
+                mon.add_event_log(span.to_log_sample())
+            monitors.append(mon)
+        agg = aggregate_convergence_reports(
+            node_convergence_report(m.node_name, m) for m in monitors
+        )
+        rollup = agg["rollup"]
+        assert rollup["events_total"] == 12
+        assert rollup["evicted_events"] == 0
+        assert rollup["cumulative"]["total"]["count"] == 12
+        assert rollup["windows"] and all(
+            "e2e_ms" in w for w in rollup["windows"]
+        )
+        # the ring-derived section only saw the retained tail
+        assert agg["spans_total"] == 4
+
+    def test_reports_without_rollup_still_aggregate(self):
+        """breeze perf report may fold reports from older daemons whose
+        JSON carries no rollup key."""
+        agg = aggregate_convergence_reports(
+            [{"node": "old", "spans": [], "e2e_ms": [], "floods": []}]
+        )
+        assert agg["rollup"]["events_total"] == 0
+
+
+class TestEmulatorEvictionProof:
+    def test_flap_events_beyond_ring_all_counted(self):
+        """The satellite contract: more flap events than max_event_log on
+        a small VirtualNetwork — the windowed report counts every event
+        Fib ever closed while the LogSample rings hold only the tail."""
+        from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+        n, flaps, ring = 3, 4, 2
+
+        async def body():
+            net = VirtualNetwork()
+            for i in range(n):
+                net.add_node(
+                    f"n{i}",
+                    loopback_prefix=f"10.{i}.0.0/24",
+                    config_overrides={
+                        "monitor_config": {
+                            "max_event_log": ring,
+                            "rollup_window_s": 0.5,
+                        }
+                    },
+                )
+            await net.start_all()
+            for i in range(n - 1):
+                net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+            def converged():
+                for i in range(n):
+                    got = set(
+                        net.wrappers[f"n{i}"].programmed_prefixes()
+                    )
+                    want = {
+                        f"10.{j}.0.0/24" for j in range(n) if j != i
+                    }
+                    if not want.issubset(got):
+                        return False
+                return True
+
+            def partitioned():
+                return "10.2.0.0/24" not in net.wrappers[
+                    "n0"
+                ].programmed_prefixes()
+
+            try:
+                await wait_until(converged, timeout=60.0)
+                for _ in range(flaps):
+                    net.fail_link("n1", "if1r", "n2", "if2l")
+                    await wait_until(partitioned, timeout=60.0)
+                    net.restore_link("n1", "if1r", "n2", "if2l")
+                    await wait_until(converged, timeout=60.0)
+
+                def fib_spans():
+                    return sum(
+                        w.daemon.fib.counters.get(
+                            "fib.convergence_spans", 0
+                        )
+                        for w in net.wrappers.values()
+                    )
+
+                def rollup_events():
+                    return sum(
+                        w.daemon.monitor.rollup.events_total
+                        for w in net.wrappers.values()
+                    )
+
+                await wait_until(
+                    lambda: rollup_events() >= fib_spans()
+                    and fib_spans() > 0,
+                    timeout=20.0,
+                )
+                agg = net.convergence_report()
+                closed = fib_spans()
+            finally:
+                await net.stop_all()
+
+            rollup = agg["rollup"]
+            # every span Fib closed is accounted, and there were more of
+            # them than any ring could hold
+            assert rollup["events_total"] == closed
+            assert closed > ring
+            assert (
+                sum(w["events"] for w in rollup["windows"])
+                + rollup["evicted_events"]
+                == rollup["events_total"]
+            )
+            # the rings really did evict: the point-in-time section is
+            # strictly smaller than history
+            assert agg["spans_total"] <= n * ring
+            assert agg["spans_total"] < rollup["events_total"]
+            assert rollup["cumulative"]["total"]["count"] == closed
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(body(), 120.0))
+        finally:
+            loop.close()

@@ -1,0 +1,27 @@
+"""SOAK_SMOKE tier-1 smoke (the churn sibling of FAULT_SMOKE and
+TRACE_SMOKE): a seconds-long topology-churn soak — 3-node line, one
+OCS-style reconfiguration wave, one injected fault — must drive the whole
+continuous-telemetry loop end to end: the judged report machinery runs,
+the windowed rollup accounts for 100% of convergence events while the
+deliberately tiny LogSample ring only retains a tail (the eviction-proof
+invariant), every scrape parses as valid exposition with full registry
+coverage, and the verdict block carries every check."""
+
+from openr_tpu.testing.soak import run_soak_smoke
+
+
+def test_soak_smoke():
+    report = run_soak_smoke()
+    # the assertions live inside run_soak_smoke (shared with the driver
+    # dry-run); re-pin the headline evidence here so a future refactor
+    # cannot silently hollow the smoke out
+    assert report["verdict"]["pass"] is True
+    events = report["events"]
+    assert events["total"] > report["config"]["max_event_log"]
+    assert events["spans_in_rings"] < events["total"]
+    assert (
+        events["windowed"] + events["evicted_window_events"]
+        == events["total"]
+    )
+    assert report["faults"]["fired"]["fib.program"] == 1
+    assert len(report["waves"]) == 1 and report["waves"][0]["converged"]
