@@ -306,6 +306,7 @@ def _apply_smoke_env() -> None:
             ("BENCH_SCALE_SOURCES", "8"),
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "200"),
+            ("BENCH_STREAM_SUBS", "8"),
         )
     )
 
@@ -330,6 +331,7 @@ def _apply_reduced_env() -> None:
             ("BENCH_SCALE_SOURCES", "8"),
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "500"),
+            ("BENCH_STREAM_SUBS", "16"),
         )
     )
 
@@ -613,6 +615,65 @@ def _bench_exporter() -> dict:
     }
 
 
+def _bench_stream() -> dict:
+    """Sixth metric line: streaming control-plane fan-out throughput —
+    the standard convergence flap batch re-run with BENCH_STREAM_SUBS
+    concurrent `subscribeKvStore` subscriptions riding every node's real
+    ctrl socket (docs/Streaming.md). The metric is sustained
+    delta-delivery rate summed across subscribers (deliveries/s); the
+    line also carries the run's convergence e2e p95 next to the
+    zero-subscriber baseline's (the convergence line measured earlier on
+    the same config), asserting fan-out does not move the convergence
+    path outside noise. Degraded-aware like every line: cpu-fallback
+    rounds run the reduced batch and are marked by main()."""
+    from openr_tpu.testing.decision_harness import run_bench_convergence
+
+    nodes = int(os.environ.get("BENCH_CONV_NODES", "5"))
+    flaps = int(os.environ.get("BENCH_CONV_FLAPS", "2"))
+    backend = os.environ.get("BENCH_CONV_BACKEND", "tpu")
+    subscribers = int(os.environ.get("BENCH_STREAM_SUBS", "64"))
+    summary = run_bench_convergence(
+        nodes=nodes,
+        flaps=flaps,
+        backend=backend,
+        measure_exporter=False,
+        subscribers=subscribers,
+    )
+    baseline_p95 = _CONV_SUMMARY.get("e2e_p95_ms", 0.0)
+    p95 = summary["e2e_p95_ms"]
+    if baseline_p95 > 0:
+        # "held flat": generous noise envelope — an emulator flap batch
+        # on shared CI jitters; a real fan-out regression (subscribers
+        # serialized into the convergence path) blows through 5x+250ms
+        assert p95 <= baseline_p95 * 5.0 + 250.0, (
+            f"convergence p95 {p95:.1f}ms with {subscribers} subscribers "
+            f"vs {baseline_p95:.1f}ms baseline: fan-out is not isolated"
+        )
+    _note(
+        f"stream: {subscribers} subscriber(s) x {summary['nodes']}-node "
+        f"flap batch -> {summary['stream_deltas']} deliveries "
+        f"({summary['stream_events_per_s']:,.0f}/s), "
+        f"{summary['stream_resyncs']} resync(s); e2e p95 {p95:.1f}ms "
+        f"vs {baseline_p95:.1f}ms without subscribers"
+    )
+    return {
+        "metric": "stream_fanout_events_s",
+        "value": round(summary["stream_events_per_s"], 1),
+        "unit": (
+            f"delta deliveries/s across {subscribers} concurrent "
+            f"subscribeKvStore subscriber(s) ({summary['nodes']}-node "
+            f"line emulator, {summary['flaps']} flap cycles)"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "subscribers": subscribers,
+        "deliveries": summary["stream_deltas"],
+        "resyncs": summary["stream_resyncs"],
+        "e2e_p95_ms": round(p95, 2),
+        "baseline_e2e_p95_ms": round(baseline_p95, 2),
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -661,6 +722,13 @@ def main(argv=None) -> None:
             results.append(_bench_scale())
         if os.environ.get("BENCH_EXPORTER", "1") == "1":
             results.append(_bench_exporter())
+        if (
+            os.environ.get("BENCH_STREAM", "1") == "1"
+            and os.environ.get("BENCH_CONVERGENCE", "1") == "1"
+        ):
+            # defined against the convergence flap batch: without the
+            # baseline run there is no held-flat comparison to make
+            results.append(_bench_stream())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

@@ -33,6 +33,12 @@ bug: "self.x aliased as 'd' (line 12), mutated via d[k] = ... (line 14)".
     handoff sinks, queue puts, or returned
   - syncs():      host-sync expressions over device-tagged values
     (np.asarray/np.array, .item()/.tolist(), float(), `for _ in d`)
+
+Two producer-flow evidence streams feed interprocedural fixpoints
+(device-transfer's attribute/method producers): `attr_stores` records
+every plain `self.<attr> = value` store with the value's tags, and
+`returns` records every `return value`'s tags; `classify_attr` closes
+the loop by tagging later `self.<attr>` loads.
 """
 
 from __future__ import annotations
@@ -132,15 +138,27 @@ class AliasTracker:
         classify_call: Optional[Callable[[ast.Call], Optional[Tag]]] = None,
         np_aliases: Optional[Set[str]] = None,
         track_self_attrs: bool = True,
+        classify_attr: Optional[Callable[[str], Optional[Tag]]] = None,
     ):
         self.fn = fn
         self.classify_call = classify_call or (lambda call: None)
+        # per-attribute tagging hook: `self.<attr>` loads gain the
+        # returned tag (the device-transfer rule's attribute-held
+        # producers — `self._d_dev`-style resident arrays)
+        self.classify_attr = classify_attr or (lambda attr: None)
         self.np_aliases = np_aliases or set()
         self.track_self_attrs = track_self_attrs
         self.state: Dict[str, Set[Alias]] = {}
         self.mutations: List[Mutation] = []
         self.escapes: List[Escape] = []
         self.syncs: List[HostSync] = []
+        # producer-flow evidence for interprocedural rules:
+        # (line, attr, value tags) for every plain `self.<attr> = value`
+        # store, and (line, tags) for every `return value` — the
+        # device-transfer rule's per-class fixpoint reads both to learn
+        # which attributes/methods carry device arrays
+        self.attr_stores: List[Tuple[int, str, Set[Alias]]] = []
+        self.returns: List[Tuple[int, Set[Alias]]] = []
         self._ran = False
 
     # -- public ----------------------------------------------------------
@@ -159,10 +177,18 @@ class AliasTracker:
         if isinstance(node, ast.Name):
             return set(self.state.get(node.id, ()))
         if isinstance(node, (ast.Attribute, ast.Subscript)):
-            if self.track_self_attrs:
-                attr = self_attr_root(node)
-                if attr is not None:
-                    return {Alias(("attr", attr), ())}
+            attr = self_attr_root(node)
+            if attr is not None:
+                out: Set[Alias] = set()
+                if self.track_self_attrs:
+                    out.add(Alias(("attr", attr), ()))
+                extra = self.classify_attr(attr)
+                if extra is not None:
+                    # attribute-held producer: self._d_dev and loads off
+                    # it (self._d_dev[i]) carry the producer tag
+                    out.add(Alias(extra, (f"self.{attr}",)))
+                if out:
+                    return out
             # a load off a tagged root stays tagged: d[0] of a device d is
             # a device scalar; self.x's element is still owned state
             root = node
@@ -238,7 +264,9 @@ class AliasTracker:
         if isinstance(stmt, ast.Return):
             if stmt.value is not None:
                 self._scan_expr(stmt.value)
-                for alias in self.tags_of(stmt.value):
+                tags = self.tags_of(stmt.value)
+                self.returns.append((stmt.lineno, tags))
+                for alias in tags:
                     if alias.tag[0] == "attr":
                         self.escapes.append(
                             Escape(stmt.lineno, alias, "the return value")
@@ -319,10 +347,25 @@ class AliasTracker:
                         for a in tags
                     }
                 elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    self._record_attr_store(t, tags, line)
                     self._store_mutation(t, line)
             return
         if isinstance(target, (ast.Attribute, ast.Subscript)):
+            self._record_attr_store(target, self.tags_of(value), line)
             self._store_mutation(target, line)
+
+    def _record_attr_store(
+        self, target: ast.AST, tags: Set[Alias], line: int
+    ) -> None:
+        """Plain `self.<attr> = value` stores (no subscripts, no deeper
+        chains) feed the producer-flow evidence: the device-transfer
+        rule learns attribute-held device arrays from these."""
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            self.attr_stores.append((line, target.attr, tags))
 
     def _store_mutation(
         self, target: ast.AST, line: int, aug: bool = False,

@@ -14,6 +14,15 @@ Mechanics (callgraph + dataflow):
     `X = jax.jit(f, ...)`), solver factories (functions returning a jit
     callable — `fn = _sell_solver(key); d = fn(...)`), and functions whose
     return value flows out of one of those (`batched_spf`).
+  - the producer set crosses **method boundaries inside a class**: a
+    per-class fixpoint learns (a) *device attributes* — `self._d_dev =
+    fn(...)`-style stores of device-tagged values (tuple unpacking
+    included), after which every `self._d_dev` load is device-tagged in
+    every method — and (b) *device-returning methods* — `return` of a
+    device-tagged value, after which `self._solve_resident(...)` call
+    sites are producers too. This closes the ROADMAP carry-over where
+    `self._d_dev` was only covered because its consumers happened to
+    account bytes.
   - the alias tracker (analysis/dataflow.py) follows the producer's value
     through local bindings, tuple unpacking (`d, rounds = fn(...)`), and
     sub-object loads, then reports host syncs with the flow chain in the
@@ -74,6 +83,86 @@ def _accounts_transfer(fn) -> bool:
     return False
 
 
+class _ClassDeviceEnv:
+    """Per-class device-producer facts learned by fixpoint."""
+
+    __slots__ = ("device_attrs", "device_methods")
+
+    def __init__(self) -> None:
+        self.device_attrs: Set[str] = set()
+        self.device_methods: Set[str] = set()
+
+
+def _attr_classifier(env: Optional[_ClassDeviceEnv]):
+    if env is None:
+        return None
+
+    def classify_attr(attr: str):
+        if attr in env.device_attrs:
+            return ("device", f"self.{attr}")
+        return None
+
+    return classify_attr
+
+
+def _with_class_env(classify, env: Optional[_ClassDeviceEnv]):
+    """Extend the module-level producer classifier with the class's
+    device-returning methods: `self._solve_resident(...)` is a producer
+    once the fixpoint saw the method return a device value."""
+    if env is None:
+        return classify
+
+    def combined(call: ast.Call):
+        base = classify(call)
+        if base is not None:
+            return base
+        if isinstance(call.func, ast.Attribute):
+            chain = dotted_name(call.func)
+            if chain and chain.startswith("self."):
+                name = chain[len("self."):]
+                if name in env.device_methods:
+                    return ("device", f"{chain}(...)")
+        return None
+
+    return combined
+
+
+def _class_device_env(
+    cls: ast.ClassDef, classify, np_aliases
+) -> _ClassDeviceEnv:
+    """Fixpoint over the class's methods: an attribute stored from a
+    device-tagged value becomes a device attribute (its loads are then
+    device-tagged everywhere in the class); a method returning a
+    device-tagged value becomes a device producer (its `self.` call
+    sites then tag their results). Iterates until neither set grows —
+    bounded by #attrs + #methods."""
+    env = _ClassDeviceEnv()
+    methods = [n for n in cls.body if isinstance(n, _FuncDef)]
+    changed = True
+    while changed:
+        changed = False
+        for fn in methods:
+            tracker = AliasTracker(
+                fn,
+                classify_call=_with_class_env(classify, env),
+                np_aliases=np_aliases,
+                classify_attr=_attr_classifier(env),
+            ).run()
+            for _, attr, tags in tracker.attr_stores:
+                if attr not in env.device_attrs and any(
+                    t.tag[0] == "device" for t in tags
+                ):
+                    env.device_attrs.add(attr)
+                    changed = True
+            if fn.name not in env.device_methods and any(
+                any(t.tag[0] == "device" for t in tags)
+                for _, tags in tracker.returns
+            ):
+                env.device_methods.add(fn.name)
+                changed = True
+    return env
+
+
 @register
 class DeviceTransferRule(Rule):
     name = "device-transfer"
@@ -116,6 +205,17 @@ class DeviceTransferRule(Rule):
                         return ("device", f"{inner}(...)(...)")
                 return None
 
+            # per-class producer fixpoint: device attributes + methods
+            # whose returns carry device values (the past-function-
+            # boundary extension); methods map onto their class env
+            method_env: dict = {}
+            for cls in ast.walk(mod.sf.tree):
+                if isinstance(cls, ast.ClassDef):
+                    env = _class_device_env(cls, classify, np_aliases)
+                    for node in cls.body:
+                        if isinstance(node, _FuncDef):
+                            method_env[id(node)] = env
+
             for infos in mod.by_name.values():
                 for fi in infos:
                     if id(fi.node) in traced_nodes:
@@ -126,10 +226,12 @@ class DeviceTransferRule(Rule):
                         continue
                     if _accounts_transfer(fi.node):
                         continue  # sanctioned seam, by construction
+                    env = method_env.get(id(fi.node))
                     tracker = AliasTracker(
                         fi.node,
-                        classify_call=classify,
+                        classify_call=_with_class_env(classify, env),
                         np_aliases=np_aliases,
+                        classify_attr=_attr_classifier(env),
                     ).run()
                     for sync in tracker.syncs:
                         check = (

@@ -52,7 +52,9 @@ from openr_tpu.analysis.core import (
 MIXINS = {"CountersMixin", "HistogramsMixin"}
 
 # module prefixes registered with the Monitor (openr.py) plus the
-# cross-module end-to-end namespace and process-level stats
+# cross-module end-to-end namespace and process-level stats; "ctrl"
+# covers the streaming control plane's fan-out + admission layers
+# (ctrl.stream.* / ctrl.admission.*, docs/Streaming.md)
 ALLOWED_PREFIXES = {
     "decision",
     "kvstore",
@@ -63,6 +65,7 @@ ALLOWED_PREFIXES = {
     "convergence",
     "process",
     "monitor",
+    "ctrl",
 }
 
 # <module>.<name>[.<name>...], lowercase snake segments

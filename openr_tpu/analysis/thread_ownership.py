@@ -43,6 +43,18 @@ Declared handovers (not flagged):
   - the mutation is lexically inside `with`/`async with` on a context
     whose name mentions a lock (`self._program_lock`, ...).
   - the attribute's `__init__` assignment carries `# analysis: shared`.
+  - **subscriber-queue handover** (`# analysis: queue` on the attribute's
+    `__init__` assignment): the attribute is a bounded subscriber
+    queue/registry whose *publisher-side enqueue is the sanctioned seam*
+    (the streaming fan-out pattern, docs/Streaming.md — ctrl connection
+    tasks register/deregister, the owner's dispatch task enqueues; all
+    interleaving happens at awaits on one loop). Unlike `# analysis:
+    shared` on a method — which waives the whole method body — the queue
+    marker waives only mutations OF THAT ATTRIBUTE, so an unrelated
+    mutation in the same method is still flagged. The sanction requires
+    the entry method to be synchronous: a queue-attr mutation reachable
+    from an *async* ctrl-facing method is an `async-enqueue` finding
+    (it can interleave with the dispatching owner mid-enqueue).
 
 Severity is advisory by default (reachability is name-based and therefore
 heuristic); `ANALYSIS_STRICT=1` promotes it.
@@ -63,7 +75,10 @@ from openr_tpu.analysis.core import (
 )
 from openr_tpu.analysis.dataflow import AliasTracker, alias_chain_text
 
-# module references a CtrlServer/Monitor holds (composition in openr.py)
+# module references a CtrlServer/Monitor holds (composition in openr.py);
+# stream_manager is the streaming control plane's fan-out registry
+# (docs/Streaming.md) — its subscriber add/remove/enqueue methods are
+# ctrl-reachable like any module method
 MODULE_ATTRS = {
     "kvstore",
     "decision",
@@ -74,6 +89,7 @@ MODULE_ATTRS = {
     "monitor",
     "config_store",
     "spark",
+    "stream_manager",
 }
 # attributes the Monitor aggregates directly off module objects: rebinding
 # them from an external path swaps the object under the aggregator
@@ -93,6 +109,7 @@ _MUTATOR_METHODS = {
     "clear",
 }
 _SHARED_RE = re.compile(r"#\s*analysis:\s*shared\b")
+_QUEUE_RE = re.compile(r"#\s*analysis:\s*queue\b")
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
@@ -112,8 +129,16 @@ def _decorator_owner(node) -> Optional[str]:
     return None
 
 
+# CtrlServer methods that run in the daemon's lifecycle context (owner
+# side), not from client connection tasks: module calls made there are
+# not externally reachable and must not widen the surface (the server
+# starting/stopping its own stream manager is the owner acting)
+_LIFECYCLE_METHODS = {"__init__", "start", "stop"}
+
+
 def external_surface(ctx: AnalysisContext) -> Set[str]:
-    """Method names invoked on module references from the ctrl server."""
+    """Method names invoked on module references from the ctrl server's
+    request paths (lifecycle methods excluded)."""
     surface: Set[str] = set()
     for sf in ctx.files:
         for node in ast.walk(sf.tree):
@@ -121,20 +146,29 @@ def external_surface(ctx: AnalysisContext) -> Set[str]:
                 isinstance(node, ast.ClassDef) and node.name == "CtrlServer"
             ):
                 continue
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Call) and isinstance(
-                    sub.func, ast.Attribute
-                ):
-                    chain = dotted_name(sub.func)
-                    if chain is None:
-                        continue
-                    parts = chain.split(".")
-                    if (
-                        len(parts) >= 3
-                        and parts[0] == "self"
-                        and parts[1] in MODULE_ATTRS
+            request_methods = [
+                n
+                for n in node.body
+                if not (
+                    isinstance(n, _FuncDef)
+                    and n.name in _LIFECYCLE_METHODS
+                )
+            ]
+            for method in request_methods:
+                for sub in ast.walk(method):
+                    if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute
                     ):
-                        surface.add(parts[-1])
+                        chain = dotted_name(sub.func)
+                        if chain is None:
+                            continue
+                        parts = chain.split(".")
+                        if (
+                            len(parts) >= 3
+                            and parts[0] == "self"
+                            and parts[1] in MODULE_ATTRS
+                        ):
+                            surface.add(parts[-1])
     return surface
 
 
@@ -147,9 +181,9 @@ def _method_is_shared(sf: SourceFile, fn) -> bool:
     return False
 
 
-def _shared_attrs(sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
-    """Attributes whose __init__ assignment is marked `# analysis: shared`."""
-    shared: Set[str] = set()
+def _marked_attrs(sf: SourceFile, cls: ast.ClassDef, marker) -> Set[str]:
+    """Attributes whose __init__ assignment line matches `marker`."""
+    marked: Set[str] = set()
     for node in cls.body:
         if isinstance(node, _FuncDef) and node.name == "__init__":
             for sub in ast.walk(node):
@@ -161,11 +195,24 @@ def _shared_attrs(sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
                     )
                     for t in targets:
                         attr = _self_attr_root(t)
-                        if attr and _SHARED_RE.search(
+                        if attr and marker.search(
                             sf.lines[sub.lineno - 1]
                         ):
-                            shared.add(attr)
-    return shared
+                            marked.add(attr)
+    return marked
+
+
+def _shared_attrs(sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
+    """Attributes whose __init__ assignment is marked `# analysis: shared`."""
+    return _marked_attrs(sf, cls, _SHARED_RE)
+
+
+def _queue_attrs(sf: SourceFile, cls: ast.ClassDef) -> Set[str]:
+    """Attributes declared as subscriber-queue handovers
+    (`# analysis: queue` on their __init__ assignment): mutations of
+    them from SYNC ctrl-reachable methods are the sanctioned
+    publisher-side enqueue seam; from async methods they are flagged."""
+    return _marked_attrs(sf, cls, _QUEUE_RE)
 
 
 def _self_attr_root(node: ast.AST) -> Optional[str]:
@@ -287,6 +334,7 @@ class ThreadOwnershipRule(Rule):
             n.name: n for n in cls.body if isinstance(n, _FuncDef)
         }
         shared_attrs = _shared_attrs(sf, cls)
+        queue_attrs = _queue_attrs(sf, cls)
         # the monitor aggregates module.counters / module.histograms by
         # reference: rebinding either outside __init__ swaps the object
         # under the aggregator — flag it from ANY method of an owned class
@@ -341,10 +389,29 @@ class ThreadOwnershipRule(Rule):
                     continue
                 if cur != name and _method_is_shared(sf, cur_fn):
                     continue
+                entry_async = isinstance(fn, ast.AsyncFunctionDef)
                 for line, attr, what in _mutations(cur_fn):
                     if attr in shared_attrs:
                         continue
                     via = "" if cur == name else f" (via {cls.name}.{cur})"
+                    if attr in queue_attrs:
+                        # subscriber-queue handover: publisher-side
+                        # enqueue from a SYNC ctrl-facing method is the
+                        # sanctioned seam (docs/Streaming.md); an async
+                        # entry can interleave mid-enqueue and is not
+                        if entry_async:
+                            yield self.finding(
+                                "async-enqueue",
+                                sf,
+                                line,
+                                f"{cls.name}.{name} is async but "
+                                f"mutates subscriber-queue attribute "
+                                f"self.{attr} ({what}){via}: the "
+                                f"'# analysis: queue' handover only "
+                                f"sanctions synchronous enqueue — make "
+                                f"the entry method sync or take a lock",
+                            )
+                        continue
                     yield self.finding(
                         "unowned-mutation",
                         sf,
@@ -353,8 +420,9 @@ class ThreadOwnershipRule(Rule):
                         f"server but mutates '{owner}'-owned state: "
                         f"{what}{via} — mark the method "
                         f"'# analysis: shared' (sync only), take a "
-                        f"lock, or mark the attribute shared in "
-                        f"__init__",
+                        f"lock, mark the attribute shared in __init__, "
+                        f"or declare a subscriber-queue handover "
+                        f"('# analysis: queue')",
                     )
                 # alias-engine pass: mutations through local aliases of
                 # owned state, and owned state escaping the loop
@@ -366,6 +434,8 @@ class ThreadOwnershipRule(Rule):
                         continue  # the attribute walk above covers these
                     if m.alias.tag[1] in shared_attrs:
                         continue
+                    if m.alias.tag[1] in queue_attrs and not entry_async:
+                        continue  # sanctioned enqueue seam, via alias
                     if _in_spans(m.line, spans):
                         continue
                     yield self.finding(

@@ -120,6 +120,34 @@ def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
                 )
             for key in delta.get("expired_keys", []):
                 print(f"{key} EXPIRED")
+    elif args.cmd == "subscribe":
+        # the streaming control plane's typed frames (docs/Streaming.md):
+        # snapshot -> deltas, with marked snapshot-resyncs after a
+        # bounded fan-out overflow ("[RESYNC]": replace local state)
+        for frame in client.subscribe(
+            "subscribeKvStore",
+            area=args.area,
+            prefixes=[args.prefix] if args.prefix else [],
+            originators=args.originator or [],
+            client=args.client,
+        ):
+            kind = frame.get("type", "delta")
+            pub = frame.get("pub", {})
+            tag = {"snapshot": "[SNAPSHOT]", "resync": "[RESYNC]"}.get(
+                kind, ""
+            )
+            if tag:
+                print(
+                    f"{tag} seq={frame.get('seq')} "
+                    f"{len(pub.get('key_vals', {}))} key(s)"
+                )
+            for key, val in sorted(pub.get("key_vals", {}).items()):
+                print(
+                    f"{key} v={val['version']} "
+                    f"from={val['originator_id']} ttl={val['ttl']}"
+                )
+            for key in pub.get("expired_keys", []):
+                print(f"{key} EXPIRED")
 
 
 def cmd_decision(client: BlockingCtrlClient, args) -> None:
@@ -213,6 +241,31 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
                 ["Src", "Dst", "Util"],
                 [[l["src"], l["dst"], l["util"]] for l in hottest],
             )
+    elif args.cmd == "subscribe-routes":
+        # initial RIB snapshot then per-event DecisionRouteUpdate deltas
+        # fed from Decision's DeltaPath stream (docs/Streaming.md)
+        for frame in client.subscribe(
+            "subscribeRouteDb", client=args.client
+        ):
+            kind = frame.get("type", "delta")
+            if kind in ("snapshot", "resync"):
+                print(
+                    f"[{kind.upper()}] seq={frame.get('seq')} "
+                    f"{len(frame.get('unicast_to_update', []))} unicast, "
+                    f"{len(frame.get('mpls_to_update', []))} mpls route(s)"
+                )
+            for blob in frame.get("unicast_to_update", []):
+                route = decode_obj(blob)
+                print(f"+ {route.dest} via {_fmt_nexthops(route)}")
+            for prefix in frame.get("unicast_to_delete", []):
+                print(f"- {prefix}")
+            for blob in frame.get("mpls_to_update", []):
+                route = decode_obj(blob)
+                print(
+                    f"+ label {route.top_label} via {_fmt_nexthops(route)}"
+                )
+            for label in frame.get("mpls_to_delete", []):
+                print(f"- label {label}")
     elif args.cmd == "path":
         # all shortest paths src -> dst over the live adjacency dump
         # (py/openr/cli/commands/decision.py PathCmd equivalent)
@@ -325,6 +378,31 @@ def cmd_soak_report(args) -> None:
                 ]
                 for w in windows
             ],
+        )
+    trend = report.get("trend")
+    if trend:
+        print(
+            f"trend: p95 slope {trend['p95_slope_ms_per_window']:+.3f} "
+            f"ms/window over {trend['windows']} window(s)"
+        )
+        step = trend.get("step")
+        if step:
+            stages = ", ".join(
+                s["stage"] for s in trend.get("attributed_stages", [])
+            )
+            print(
+                f"  step break at window {step['index']}: "
+                f"{step['before_ms']} -> {step['after_ms']} ms "
+                f"({'fault-attributed' if step['faulted'] else 'CLEAN'}"
+                + (f"; stages: {stages}" if stages else "")
+                + ")"
+            )
+    stream = report.get("stream")
+    if stream and stream.get("enabled"):
+        print(
+            f"stream scrapes: {stream['frames_total']} frame(s), "
+            f"{stream['resyncs_total']} resync(s) over "
+            f"{len(stream.get('nodes', {}))} subscription(s)"
         )
     attribution = report.get("attribution")
     if attribution:
@@ -610,6 +688,9 @@ def cmd_monitor(client: BlockingCtrlClient, args) -> None:
         # same bytes GET /metrics on the ctrl port serves (the scrape
         # endpoint a stock Prometheus instance polls)
         sys.stdout.write(client.call("getMetricsText"))
+    elif args.cmd == "stream-stats":
+        # live fan-out + admission state (docs/Streaming.md)
+        _print_json(client.call("getStreamStats"))
 
 
 def cmd_openr(client: BlockingCtrlClient, args) -> None:
@@ -659,6 +740,20 @@ def build_parser() -> argparse.ArgumentParser:
     p = kv.add_parser("snoop")
     p.add_argument("--prefix", default="")
     p.add_argument("--area", default="0")
+    p = kv.add_parser("subscribe")
+    p.add_argument("--prefix", default="")
+    p.add_argument(
+        "--originator",
+        action="append",
+        default=None,
+        help="originator-id filter (repeatable)",
+    )
+    p.add_argument("--area", default="0")
+    p.add_argument(
+        "--client",
+        default="breeze",
+        help="client label (admission fairness / stream stats)",
+    )
 
     dec = sub.add_parser("decision").add_subparsers(dest="cmd", required=True)
     dec.add_parser("adj")
@@ -677,6 +772,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scenarios", type=int, default=None)
     p.add_argument(
         "--json", action="store_true", help="dump the full report"
+    )
+    p = dec.add_parser("subscribe-routes")
+    p.add_argument(
+        "--client",
+        default="breeze",
+        help="client label (admission fairness / stream stats)",
     )
     p = dec.add_parser("path")
     p.add_argument("src")
@@ -715,6 +816,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reset", action="store_true")
     mon.add_parser("logs")
     mon.add_parser("scrape")
+    mon.add_parser("stream-stats")
 
     op = sub.add_parser("openr").add_subparsers(dest="cmd", required=True)
     op.add_parser("version")
@@ -763,6 +865,8 @@ _HANDLERS = {
 
 
 def main(argv=None) -> int:
+    from openr_tpu.ctrl.client import CtrlError
+
     args = build_parser().parse_args(argv)
     if args.module == "perf" and getattr(args, "cmd", None) == "soak-report":
         # offline renderer: reads a report file, never dials a daemon
@@ -781,6 +885,17 @@ def main(argv=None) -> int:
         ) as client:
             _HANDLERS[args.module](client, args)
         return 0
+    except CtrlError as exc:
+        if exc.server_busy:
+            # typed admission rejection: the daemon is shedding load, not
+            # broken — report the backoff hint and exit distinctly
+            retry = exc.retry_after_ms or 0
+            print(
+                f"server busy: {exc} (retry in ~{retry}ms)",
+                file=sys.stderr,
+            )
+            return 2
+        raise
     except ConnectionRefusedError:
         print(
             f"cannot connect to openr-tpu at {args.host}:{args.port}",

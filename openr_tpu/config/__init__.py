@@ -10,6 +10,7 @@ from openr_tpu.config.config import (
     PrefixAllocationConfig,
     SparkConfig,
     StepDetectorConfig,
+    StreamConfigSection,
     WatchdogConfig,
 )
 
@@ -23,5 +24,6 @@ __all__ = [
     "PrefixAllocationConfig",
     "SparkConfig",
     "StepDetectorConfig",
+    "StreamConfigSection",
     "WatchdogConfig",
 ]

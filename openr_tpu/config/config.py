@@ -166,6 +166,28 @@ class DecisionConfigSection:
 
 
 @dataclass
+class StreamConfigSection:
+    """Streaming control plane knobs (docs/Streaming.md): the ctrl
+    server's delta-subscription fan-out bounds and the admission queue
+    in front of expensive RPCs."""
+
+    # frames buffered per subscriber before coalescing kicks in
+    subscriber_max_pending: int = 64
+    # merged-delta entry budget after coalescing; beyond it the
+    # subscriber's queue is dropped and a marked snapshot-resync is sent
+    coalesce_budget: int = 4096
+    # hard cap on concurrent subscriptions (typed server-busy beyond)
+    max_subscribers: int = 1024
+    # admission queue for runTeOptimize / getRouteDbComputed /
+    # getConvergenceReport: concurrent cost units, bounded queue wait,
+    # queue depth caps (global + per client — the fairness bound)
+    admission_capacity: int = 2
+    admission_max_wait_s: float = 2.0
+    admission_max_queue: int = 16
+    admission_max_queue_per_client: int = 4
+
+
+@dataclass
 class OpenrConfig:
     """OpenrConfig.thrift OpenrConfig:180."""
 
@@ -206,6 +228,9 @@ class OpenrConfig:
     fib_port: int = 60100
     enable_rib_policy: bool = False
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    stream_config: StreamConfigSection = field(
+        default_factory=StreamConfigSection
+    )
     enable_bgp_peering: bool = False
     bgp_use_igp_metric: bool = False
     # mutual TLS for the ctrl server and KvStore TCP peering

@@ -29,7 +29,31 @@ def encode_obj(obj) -> str:
 
 
 class CtrlError(RuntimeError):
-    pass
+    """Server-reported error. Typed rejections (admission control,
+    subscriber limits) carry `kind` ("server_busy") and a
+    `retry_after_ms` backoff hint (docs/Streaming.md)."""
+
+    def __init__(
+        self,
+        message: str,
+        kind: Optional[str] = None,
+        retry_after_ms: Optional[int] = None,
+    ) -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.retry_after_ms = retry_after_ms
+
+    @property
+    def server_busy(self) -> bool:
+        return self.kind == "server_busy"
+
+
+def _raise_ctrl_error(resp: Dict) -> None:
+    raise CtrlError(
+        resp["error"],
+        kind=resp.get("error_kind"),
+        retry_after_ms=resp.get("retry_after_ms"),
+    )
 
 
 class CtrlClient:
@@ -77,7 +101,7 @@ class CtrlClient:
             raise CtrlError("connection closed")
         resp = json.loads(line)
         if "error" in resp:
-            raise CtrlError(resp["error"])
+            _raise_ctrl_error(resp)
         return resp.get("result")
 
     async def subscribe(self, method: str, **params):
@@ -93,7 +117,7 @@ class CtrlClient:
                 return
             frame = json.loads(line)
             if "error" in frame:
-                raise CtrlError(frame["error"])
+                _raise_ctrl_error(frame)
             if frame.get("done"):
                 return
             yield frame["stream"]
@@ -138,7 +162,7 @@ class BlockingCtrlClient:
             raise CtrlError("connection closed")
         resp = json.loads(line)
         if "error" in resp:
-            raise CtrlError(resp["error"])
+            _raise_ctrl_error(resp)
         return resp.get("result")
 
     def subscribe(self, method: str, **params) -> Iterator[Dict]:
@@ -152,7 +176,7 @@ class BlockingCtrlClient:
                 return
             frame = json.loads(line)
             if "error" in frame:
-                raise CtrlError(frame["error"])
+                _raise_ctrl_error(frame)
             if frame.get("done"):
                 return
             yield frame["stream"]

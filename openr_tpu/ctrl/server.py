@@ -14,6 +14,18 @@ same request/response + streaming semantics with zero extra dependencies):
   request:   {"id": N, "method": "...", "params": {...}}
   response:  {"id": N, "result": ...} | {"id": N, "error": "..."}
   streaming: {"id": N, "stream": ...}* then {"id": N, "done": true}
+  typed err: {"id": N, "error": "...", "error_kind": "server_busy",
+              "retry_after_ms": M}
+
+The streaming control plane (docs/Streaming.md) rides this transport:
+`subscribeKvStore` / `subscribeRouteDb` stream typed frames ("snapshot",
+then "delta"s, with marked "resync" snapshots after fan-out overflow)
+through the daemon's StreamManager (bounded per-subscriber queues —
+a stalled reader can never block publication or other subscribers), and
+the expensive RPCs (`runTeOptimize`, `getRouteDbComputed`,
+`getConvergenceReport`) pass through the AdmissionController's weighted
+fair queue, rejecting with the typed server-busy error above when the
+bounded wait expires.
 """
 
 from __future__ import annotations
@@ -102,6 +114,9 @@ class CtrlServer:
         exporter=None,
         config_store=None,
         config=None,
+        stream_manager=None,
+        admission=None,
+        route_updates=None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
         ssl_context=None,
         tls_acceptable_peers=None,
@@ -120,6 +135,13 @@ class CtrlServer:
         self.exporter = exporter
         self.config_store = config_store
         self.config = config
+        # streaming control plane (docs/Streaming.md): in the daemon both
+        # are built by openr.py and shared with the monitor; standalone
+        # embeddings (tests, tools) get defaults built in start()
+        self.stream_manager = stream_manager
+        self.admission = admission
+        self._route_updates = route_updates
+        self._own_stream_manager = False
         self._loop = loop
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
@@ -134,6 +156,28 @@ class CtrlServer:
     # ------------------------------------------------------------------
 
     async def start(self) -> int:
+        if self.stream_manager is None and (
+            self.kvstore is not None or self._route_updates is not None
+        ):
+            # standalone embedding: own a default-config fan-out layer
+            from openr_tpu.streaming import StreamManager
+
+            self.stream_manager = StreamManager(
+                kvstore_updates=(
+                    self.kvstore.updates_queue
+                    if self.kvstore is not None
+                    else None
+                ),
+                route_updates=self._route_updates,
+                loop=self._loop,
+            )
+            self._own_stream_manager = True
+        if self.stream_manager is not None and self._own_stream_manager:
+            self.stream_manager.start()
+        if self.admission is None:
+            from openr_tpu.streaming import AdmissionController
+
+            self.admission = AdmissionController()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, ssl=self._ssl_context
         )
@@ -141,6 +185,8 @@ class CtrlServer:
         return self.port
 
     async def stop(self) -> None:
+        if self.stream_manager is not None and self._own_stream_manager:
+            self.stream_manager.stop()
         if self._server is not None:
             self._server.close()
             # cancel in-flight handlers (streaming subscriptions block on
@@ -180,14 +226,28 @@ class CtrlServer:
                     return
                 try:
                     req = json.loads(line)
-                    method = self._methods.get(req.get("method", ""))
+                    name = req.get("method", "")
+                    method = self._methods.get(name)
                     if method is None:
                         resp = {
                             "id": req.get("id"),
-                            "error": f"unknown method {req.get('method')}",
+                            "error": f"unknown method {name}",
                         }
                     else:
-                        result = method(req.get("params") or {})
+                        params = req.get("params") or {}
+                        if self.admission is not None and (
+                            self.admission.guards(name)
+                        ):
+                            # expensive RPC: weighted fair admission with
+                            # bounded wait + typed server-busy rejection
+                            # (docs/Streaming.md admission section)
+                            result = await self.admission.run(
+                                name,
+                                self._client_id(writer, params),
+                                lambda: method(params),
+                            )
+                        else:
+                            result = method(params)
                         if asyncio.iscoroutine(result):
                             result = await result
                         if result is _STREAMING:
@@ -198,8 +258,17 @@ class CtrlServer:
                     await stream.run(req.get("id"), writer)
                     continue
                 except Exception as exc:  # per-request isolation
-                    log.exception("ctrl method failed")
                     resp = {"id": req.get("id"), "error": str(exc)}
+                    kind = getattr(exc, "error_kind", None)
+                    if kind is not None:
+                        # typed rejection (server_busy): clients back off
+                        # on retry_after_ms instead of piling on
+                        resp["error_kind"] = kind
+                        retry = getattr(exc, "retry_after_ms", None)
+                        if retry is not None:
+                            resp["retry_after_ms"] = int(retry)
+                    else:
+                        log.exception("ctrl method failed")
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
         except (ConnectionResetError, asyncio.CancelledError):
@@ -664,58 +733,224 @@ class CtrlServer:
     def m_subscribeKvStoreFilter(self, params):
         """Server-streaming KvStore subscription
         (OpenrCtrlHandler.h:207-211): initial full dump frame, then every
-        matching publication as a stream frame."""
+        matching publication as a stream frame. Legacy frame shape (bare
+        publication JSON); rides the same bounded fan-out as
+        subscribeKvStore — an overflow resync arrives as a full-dump
+        publication, which per-key merge clients absorb unmarked."""
         assert self.kvstore is not None
+        if self.stream_manager is not None:
+            self.stream_manager.ensure_capacity()
+        raise _Streaming(self._kvstore_stream_legacy, params)
+
+    def m_subscribeKvStore(self, params):
+        """Streaming KvStore delta subscription (docs/Streaming.md):
+        typed frames {"type": "snapshot"|"delta"|"resync", "seq": N,
+        "pub": {...}} — initial full-sync snapshot, then per-publication
+        deltas (key-prefix/originator filtered), with marked
+        snapshot-resyncs after bounded fan-out overflow.
+        params: area, prefixes, originators, client (fairness label)."""
+        assert self.kvstore is not None
+        if self.stream_manager is not None:
+            # typed server-busy BEFORE the stream starts: the rejection
+            # rides the normal error response with retry_after_ms
+            self.stream_manager.ensure_capacity()
         raise _Streaming(self._kvstore_stream, params)
 
-    async def _kvstore_stream(self, req_id, writer, params) -> None:
+    def m_subscribeRouteDb(self, params):
+        """Streaming RIB subscription (docs/Streaming.md): initial
+        computed-RIB snapshot, then every DecisionRouteUpdate the
+        DeltaPath emits, with marked snapshot-resyncs after overflow.
+        Frames: {"type": ..., "seq": N, "unicast_to_update": [b64...],
+        "unicast_to_delete": [...], "mpls_to_update": [...],
+        "mpls_to_delete": [...]}; snapshots/resyncs carry the full RIB
+        in the *_to_update fields."""
+        assert self.decision is not None
+        if self.stream_manager is not None:
+            self.stream_manager.ensure_capacity()
+        raise _Streaming(self._route_stream, params)
+
+    def m_getStreamStats(self, params) -> Dict[str, Any]:
+        """Live fan-out + admission state (docs/Streaming.md)."""
+        out: Dict[str, Any] = {}
+        if self.stream_manager is not None:
+            out["stream"] = self.stream_manager.stats()
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
+        return out
+
+    def _client_id(self, writer, params) -> str:
+        """Admission fairness identity: the client-declared label when
+        present (breeze --client), else the peer address."""
+        label = params.get("client")
+        if label:
+            return str(label)
+        peer = writer.get_extra_info("peername")
+        return str(peer[0]) if peer else "unknown"
+
+    def _kv_snapshot(self, area, prefixes, originators) -> Publication:
         from openr_tpu.kvstore import KvStoreFilters
 
+        filters = None
+        if prefixes or originators:
+            filters = KvStoreFilters(
+                key_prefixes=list(prefixes or []),
+                originator_ids=set(originators or ()),
+            )
+        return self.kvstore.dump_all(area=area, filters=filters)
+
+    async def _send_frame(self, writer, req_id, payload) -> None:
+        writer.write(
+            json.dumps({"id": req_id, "stream": payload}).encode() + b"\n"
+        )
+        await writer.drain()
+
+    async def _deliver_gate(self, sub) -> None:
+        """Per-frame delivery seam: the `ctrl.stream.deliver` fault point
+        (ctx=subscription) fires here — an armed exception tears the
+        stream down (the client reconnects and resyncs), an armed action
+        may set `sub.throttle_s` to emulate a slow client; the throttle
+        is consumed one-shot per frame."""
+        from openr_tpu.testing.faults import fault_point
+
+        fault_point("ctrl.stream.deliver", sub)
+        delay, sub.throttle_s = sub.throttle_s, 0.0
+        if delay:
+            await asyncio.sleep(delay)
+
+    async def _kvstore_stream(
+        self, req_id, writer, params, legacy: bool = False
+    ) -> None:
+        assert self.stream_manager is not None, "stream manager not wired"
         area = params.get("area", "0")
         prefixes = params.get("prefixes") or []
-        filters = (
-            KvStoreFilters(key_prefixes=prefixes) if prefixes else None
+        originators = params.get("originators") or []
+        sub = self.stream_manager.add_kvstore_subscriber(
+            area=area,
+            prefixes=prefixes,
+            originators=set(originators),
+            label=str(params.get("client") or ""),
         )
-        snapshot = self.kvstore.dump_all(area=area, filters=filters)
-        frame = {
-            "id": req_id,
-            "stream": _publication_to_json(snapshot),
-        }
-        writer.write(json.dumps(frame).encode() + b"\n")
-        await writer.drain()
-        reader = self.kvstore.updates_queue.get_reader()
         try:
+            # register-then-snapshot: a publication landing between the
+            # two shows up in the snapshot AND as a delta — per-key
+            # version merge makes the replay idempotent, nothing is lost
+            snapshot = self._kv_snapshot(area, prefixes, originators)
+            seq = 0
+            await self._send_frame(
+                writer,
+                req_id,
+                _publication_to_json(snapshot)
+                if legacy
+                else {
+                    "type": "snapshot",
+                    "seq": seq,
+                    "area": area,
+                    "pub": _publication_to_json(snapshot),
+                },
+            )
             while True:
-                pub = await reader.get()
-                if pub.area != area:
-                    continue
-                if prefixes:
-                    key_vals = {
-                        k: v
-                        for k, v in pub.key_vals.items()
-                        if any(k.startswith(p) for p in prefixes)
+                kind, pub, t_enq = await sub.next_frame()
+                if kind == "closed":
+                    return
+                await self._deliver_gate(sub)
+                seq += 1
+                if kind == "resync":
+                    pub = self._kv_snapshot(area, prefixes, originators)
+                payload = _publication_to_json(pub)
+                if not legacy:
+                    payload = {
+                        "type": kind,
+                        "seq": seq,
+                        "area": area,
+                        "pub": payload,
                     }
-                    expired = [
-                        k
-                        for k in pub.expired_keys
-                        if any(k.startswith(p) for p in prefixes)
-                    ]
-                    if not key_vals and not expired:
-                        continue
-                    pub = Publication(
-                        key_vals=key_vals, expired_keys=expired, area=area
-                    )
-                frame = {"id": req_id, "stream": _publication_to_json(pub)}
-                writer.write(json.dumps(frame).encode() + b"\n")
-                await writer.drain()
+                await self._send_frame(writer, req_id, payload)
+                self.stream_manager.mark_delivered(sub, t_enq)
         except (
             QueueClosedError,
             ConnectionResetError,
+            BrokenPipeError,
             asyncio.CancelledError,
         ):
             pass
         finally:
-            reader.close()
+            self.stream_manager.remove_subscriber(sub)
+
+    async def _kvstore_stream_legacy(self, req_id, writer, params) -> None:
+        await self._kvstore_stream(req_id, writer, params, legacy=True)
+
+    def _route_db_payload(self, kind: str, seq: int) -> Dict[str, Any]:
+        """Full computed RIB as a snapshot/resync frame payload."""
+        db = self.decision.get_decision_route_db(None)
+        unicast = mpls = []
+        if db is not None:
+            unicast = [
+                _obj_to_json(e.to_unicast_route())
+                for e in db.unicast_entries.values()
+            ]
+            mpls = [
+                _obj_to_json(e.to_mpls_route())
+                for e in db.mpls_entries.values()
+            ]
+        return {
+            "type": kind,
+            "seq": seq,
+            "unicast_to_update": unicast,
+            "unicast_to_delete": [],
+            "mpls_to_update": mpls,
+            "mpls_to_delete": [],
+        }
+
+    async def _route_stream(self, req_id, writer, params) -> None:
+        assert self.stream_manager is not None, "stream manager not wired"
+        sub = self.stream_manager.add_route_subscriber(
+            label=str(params.get("client") or "")
+        )
+        try:
+            seq = 0
+            await self._send_frame(
+                writer, req_id, self._route_db_payload("snapshot", seq)
+            )
+            while True:
+                kind, update, t_enq = await sub.next_frame()
+                if kind == "closed":
+                    return
+                await self._deliver_gate(sub)
+                seq += 1
+                if kind == "resync":
+                    payload = self._route_db_payload("resync", seq)
+                else:
+                    payload = {
+                        "type": "delta",
+                        "seq": seq,
+                        "unicast_to_update": [
+                            _obj_to_json(e.to_unicast_route())
+                            for e in update.unicast_routes_to_update
+                        ],
+                        "unicast_to_delete": [
+                            str(p)
+                            for p in update.unicast_routes_to_delete
+                        ],
+                        "mpls_to_update": [
+                            _obj_to_json(e.to_mpls_route())
+                            for e in update.mpls_routes_to_update
+                        ],
+                        "mpls_to_delete": [
+                            int(label)
+                            for label in update.mpls_routes_to_delete
+                        ],
+                    }
+                await self._send_frame(writer, req_id, payload)
+                self.stream_manager.mark_delivered(sub, t_enq)
+        except (
+            QueueClosedError,
+            ConnectionResetError,
+            BrokenPipeError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            self.stream_manager.remove_subscriber(sub)
 
     # ------------------------------------------------------------------
     # link monitor APIs (drain / metric overrides)

@@ -341,6 +341,34 @@ class OpenrDaemon:
             loop=loop,
         )
 
+        # --- streaming control plane (docs/Streaming.md) ---------------
+        from openr_tpu.streaming import (
+            AdmissionConfig,
+            AdmissionController,
+            StreamConfig,
+            StreamManager,
+        )
+
+        stc = c.stream_config
+        self.stream_manager = StreamManager(
+            kvstore_updates=self.kvstore.updates_queue,
+            route_updates=self.route_updates_queue,
+            config=StreamConfig(
+                subscriber_max_pending=stc.subscriber_max_pending,
+                coalesce_budget=stc.coalesce_budget,
+                max_subscribers=stc.max_subscribers,
+            ),
+            loop=loop,
+        )
+        self.admission = AdmissionController(
+            AdmissionConfig(
+                capacity=stc.admission_capacity,
+                max_wait_s=stc.admission_max_wait_s,
+                max_queue=stc.admission_max_queue,
+                max_queue_per_client=stc.admission_max_queue_per_client,
+            )
+        )
+
         # --- ctrl server ----------------------------------------------
         self.ctrl_server = CtrlServer(
             node,
@@ -355,6 +383,8 @@ class OpenrDaemon:
             exporter=self.exporter,
             config_store=self.config_store,
             config=config,
+            stream_manager=self.stream_manager,
+            admission=self.admission,
             loop=loop,
             ssl_context=self._server_ssl,
             tls_acceptable_peers=c.tls_acceptable_peers or None,
@@ -367,6 +397,10 @@ class OpenrDaemon:
             ("link_monitor", self.link_monitor),
             ("spark", self.spark),
             ("prefix_manager", self.prefix_manager),
+            # the fan-out + admission layers register like modules so
+            # ctrl.stream.* / ctrl.admission.* ride every scrape
+            ("ctrl_stream", self.stream_manager),
+            ("ctrl_admission", self.admission),
         ):
             self.monitor.register_module(name, module)
 
@@ -391,6 +425,9 @@ class OpenrDaemon:
         self.link_monitor.start()
         self.decision.start()
         self.fib.start()
+        # fan-out dispatch must drain before the ctrl server can accept
+        # subscribers (its readers consume the module queues continuously)
+        self.stream_manager.start()
         port = await self.ctrl_server.start()
         if self.config.config.enable_bgp_peering:
             # extension seam (Main.cpp:589-595, plugin/Plugin.h:24-34);
@@ -424,6 +461,7 @@ class OpenrDaemon:
 
             plugin_stop()
         await self.ctrl_server.stop()
+        self.stream_manager.stop()
         self.fib.stop()
         self.decision.stop()
         self.link_monitor.stop()

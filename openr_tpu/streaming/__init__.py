@@ -1,0 +1,28 @@
+"""Streaming control plane: delta subscriptions, bounded fan-out, and
+admission control for expensive ctrl RPCs (docs/Streaming.md)."""
+
+from openr_tpu.streaming.admission import (
+    DEFAULT_COSTS,
+    AdmissionConfig,
+    AdmissionController,
+    ServerBusyError,
+)
+from openr_tpu.streaming.subscription import (
+    KvSubscription,
+    RouteSubscription,
+    StreamConfig,
+    StreamManager,
+    SubscriberLimitError,
+)
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "DEFAULT_COSTS",
+    "KvSubscription",
+    "RouteSubscription",
+    "ServerBusyError",
+    "StreamConfig",
+    "StreamManager",
+    "SubscriberLimitError",
+]

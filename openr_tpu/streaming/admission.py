@@ -1,0 +1,272 @@
+"""Admission control for expensive ctrl RPCs.
+
+The ctrl server runs on the same asyncio loop as the convergence path
+(Decision rebuilds, Fib programming). Expensive calls — `runTeOptimize`
+(a full gradient-descent optimization), `getRouteDbComputed` (an SPF
+solve when asked for another node's perspective), `getConvergenceReport`
+(full span/rollup aggregation) — cost milliseconds to seconds each, and
+heavy client traffic used to queue them back to back ahead of route
+programming with no bound at all.
+
+`AdmissionController` puts a weighted admission queue in front of them:
+
+  - **Concurrency cap**: each method carries a cost weight; at most
+    `capacity` units run at once. Excess callers queue.
+  - **Bounded wait + typed rejection**: a caller waits at most
+    `max_wait_s` for a slot; a full queue or an expired wait raises
+    `ServerBusyError`, which the ctrl server maps to a typed
+    `error_kind: "server_busy"` response with a `retry_after_ms` hint —
+    clients back off instead of piling on.
+  - **Fairness**: waiters queue per client id and slots are granted
+    round-robin across clients, with a per-client pending cap — one
+    client hammering `runTeOptimize` cannot occupy every queue slot, and
+    the bounded total means expensive work admitted ahead of the
+    convergence path is always O(capacity + queue), never O(clients).
+
+The controller never moves work off the loop — admitted handlers run
+where they always ran (loop-serialized with the module owners, which is
+what the thread-ownership analyzer's `# analysis: shared` handovers
+assume). What it guarantees is that the *total* expensive work in front
+of route programming is bounded and fairly shared; async handlers (used
+by tests to model slow optimizations) are awaited under the slot without
+blocking the loop at all.
+
+Fault point: `ctrl.admission.dispatch` fires before each admitted call
+(docs/Robustness.md) — injected failures exercise the typed-error path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Optional
+
+from openr_tpu.testing.faults import fault_point
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
+
+# default cost weights of the guarded ctrl methods: runTeOptimize is a
+# whole optimization loop, the other two are one solve / one aggregation
+DEFAULT_COSTS: Dict[str, int] = {
+    "runTeOptimize": 2,
+    "getRouteDbComputed": 1,
+    "getConvergenceReport": 1,
+}
+
+
+class ServerBusyError(RuntimeError):
+    """Typed server-busy rejection (wire shape: error_kind=server_busy)."""
+
+    error_kind = "server_busy"
+
+    def __init__(
+        self, method: str, reason: str, retry_after_ms: int
+    ) -> None:
+        super().__init__(
+            f"server busy: {method} {reason} "
+            f"(retry after {retry_after_ms}ms)"
+        )
+        self.method = method
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
+
+
+@dataclass
+class AdmissionConfig:
+    """Admission knobs (config `stream_config` section)."""
+
+    capacity: int = 2  # concurrent cost units
+    max_wait_s: float = 2.0  # bounded queue wait per caller
+    max_queue: int = 16  # total queued waiters
+    max_queue_per_client: int = 4  # fairness: per-client pending cap
+    costs: Dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_COSTS)
+    )
+
+
+class _Waiter:
+    __slots__ = ("client", "cost", "future")
+
+    def __init__(self, client: str, cost: int, future: asyncio.Future):
+        self.client = client
+        self.cost = cost
+        self.future = future
+
+
+class AdmissionController(CountersMixin, HistogramsMixin):
+    """Weighted fair admission queue (one per daemon, `ctrl_admission`
+    monitor module — `ctrl.admission.*` counters/histograms)."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None) -> None:
+        self.config = config or AdmissionConfig()
+        self._inflight = 0
+        # per-client FIFO queues, granted round-robin via _rotation
+        self._waiters: Dict[str, Deque[_Waiter]] = {}
+        self._rotation: Deque[str] = collections.deque()
+        self._ensure_counters()
+        self._ensure_histograms()
+
+    # -- public ---------------------------------------------------------
+
+    def guards(self, method: str) -> bool:
+        return method in self.config.costs
+
+    async def run(
+        self, method: str, client: str, fn: Callable[[], Any]
+    ) -> Any:
+        """Admit, run, release. `fn` may return a value or a coroutine
+        (awaited under the slot). Raises ServerBusyError on rejection."""
+        cost = self.config.costs.get(method, 1)
+        t0 = time.perf_counter()
+        await self._acquire(method, client, cost)
+        self._observe(
+            "ctrl.admission.wait_ms", (time.perf_counter() - t0) * 1e3
+        )
+        self._bump("ctrl.admission.admitted")
+        t_run = time.perf_counter()
+        try:
+            # named fault seam: injected dispatch failures exercise the
+            # per-request error isolation without touching the modules
+            fault_point("ctrl.admission.dispatch", method)
+            result = fn()
+            if asyncio.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._observe(
+                "ctrl.admission.run_ms", (time.perf_counter() - t_run) * 1e3
+            )
+            self._release(cost)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "capacity": self.config.capacity,
+            "in_flight": self._inflight,
+            "queued": sum(len(q) for q in self._waiters.values()),
+            "max_wait_s": self.config.max_wait_s,
+            "costs": dict(self.config.costs),
+            "counters": dict(self._ensure_counters()),
+        }
+
+    # -- internals ------------------------------------------------------
+
+    def _retry_hint_ms(self) -> int:
+        return int(self.config.max_wait_s * 1e3)
+
+    async def _acquire(self, method: str, client: str, cost: int) -> None:
+        queued_total = sum(len(q) for q in self._waiters.values())
+        if queued_total == 0 and (
+            self._inflight + cost <= self.config.capacity
+        ):
+            # fast path: capacity free and nobody queued ahead
+            self._inflight += cost
+            self._gauge()
+            return
+        mine = self._waiters.get(client)
+        if (
+            mine is not None
+            and len(mine) >= self.config.max_queue_per_client
+        ):
+            # checked before the global bound: "YOU are over your cap"
+            # beats "the queue is full" for a client deciding how to
+            # back off (fairness attribution)
+            self._bump("ctrl.admission.rejected_client_cap")
+            raise ServerBusyError(
+                method,
+                f"client has {len(mine)} requests queued",
+                self._retry_hint_ms(),
+            )
+        if queued_total >= self.config.max_queue:
+            self._bump("ctrl.admission.rejected_queue_full")
+            raise ServerBusyError(
+                method, "admission queue full", self._retry_hint_ms()
+            )
+        mine = self._waiters.setdefault(client, collections.deque())
+        if client not in self._rotation:
+            self._rotation.append(client)
+        waiter = _Waiter(
+            client, cost, asyncio.get_running_loop().create_future()
+        )
+        mine.append(waiter)
+        self._bump("ctrl.admission.queued")
+        self._gauge()
+        try:
+            await asyncio.wait_for(waiter.future, self.config.max_wait_s)
+        except asyncio.TimeoutError:
+            self._discard(waiter)
+            self._bump("ctrl.admission.timeouts")
+            # the timed-out waiter may have blocked grantable capacity
+            self._grant()
+            raise ServerBusyError(
+                method,
+                f"no slot within {self.config.max_wait_s}s",
+                self._retry_hint_ms(),
+            )
+        except BaseException:
+            granted = waiter.future.done() and not waiter.future.cancelled()
+            self._discard(waiter)
+            if granted:
+                # the grant raced our cancellation: give the slot back
+                self._release(cost)
+            raise
+        # granted: _grant() already charged our cost to _inflight
+
+    def _discard(self, waiter: _Waiter) -> None:
+        queue = self._waiters.get(waiter.client)
+        if queue is not None:
+            try:
+                queue.remove(waiter)
+            except ValueError:
+                pass
+            if not queue:
+                self._waiters.pop(waiter.client, None)
+        self._gauge()
+
+    def _release(self, cost: int) -> None:
+        self._inflight = max(0, self._inflight - cost)
+        self._grant()
+        self._gauge()
+
+    def _grant(self) -> None:
+        """Round-robin across client queues while capacity lasts — the
+        fairness rule: after a client is granted, the rotation pointer
+        moves past it (and PERSISTS across grant rounds), so a heavy
+        client's queued burst yields to every other client between its
+        own grants and cannot starve anyone."""
+        attempts = len(self._rotation)
+        while self._rotation and attempts > 0:
+            client = self._rotation[0]
+            queue = self._waiters.get(client)
+            if not queue:
+                self._rotation.popleft()
+                attempts = len(self._rotation)
+                continue
+            head = queue[0]
+            if self._inflight + head.cost > self.config.capacity:
+                # head doesn't fit: give the other clients a look, but
+                # a full fruitless scan ends the round (position intact:
+                # rotating len(rotation) times is the identity)
+                self._rotation.rotate(-1)
+                attempts -= 1
+                continue
+            queue.popleft()
+            if not queue:
+                self._waiters.pop(client, None)
+                self._rotation.popleft()
+            else:
+                self._rotation.rotate(-1)
+            self._inflight += head.cost
+            if not head.future.done():
+                head.future.set_result(None)
+            else:  # cancelled while granting: return the slot
+                self._inflight -= head.cost
+            attempts = len(self._rotation)
+        self._gauge()
+
+    def _gauge(self) -> None:
+        counters = self._ensure_counters()
+        counters["ctrl.admission.in_flight_last"] = self._inflight
+        counters["ctrl.admission.queued_last"] = sum(
+            len(q) for q in self._waiters.values()
+        )

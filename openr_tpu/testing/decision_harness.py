@@ -558,6 +558,7 @@ def run_bench_convergence(
     flaps: int = 2,
     backend: str = "tpu",
     measure_exporter: bool = True,
+    subscribers: int = 0,
 ) -> dict:
     """Hello-to-programmed-route percentiles from an emulator flap run —
     bench.py's second metric line (ROADMAP "relight the benchmark").
@@ -569,7 +570,14 @@ def run_bench_convergence(
     Returns the aggregate e2e percentiles, so DeltaPath / solver wins show
     up in the benchmark trajectory as `convergence.e2e_ms`, not just raw
     SPF/s. The daemons run the requested Decision solver backend (tpu by
-    default: this is the path the delta extraction serves)."""
+    default: this is the path the delta extraction serves).
+
+    With `subscribers` > 0 the same flap batch additionally carries N
+    concurrent `subscribeKvStore` streams (spread round-robin across the
+    nodes' real ctrl sockets) — bench.py's `stream_fanout_events_s` line:
+    the summary gains stream_{subscribers,frames,deltas,resyncs,
+    events_per_s} so delta-delivery throughput and the convergence-p95
+    cost of fan-out are measured on one run (docs/Streaming.md)."""
     from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
 
     n = max(3, nodes)
@@ -588,6 +596,38 @@ def run_bench_convergence(
         await net.start_all()
         for i in range(n - 1):
             net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+        counts = {"frames": 0, "deltas": 0, "resyncs": 0}
+        sub_tasks: list = []
+        sub_clients: list = []
+
+        async def watch(client) -> None:
+            try:
+                async for frame in client.subscribe(
+                    "subscribeKvStore", area="0", client="bench"
+                ):
+                    counts["frames"] += 1
+                    kind = frame.get("type")
+                    if kind == "delta":
+                        counts["deltas"] += 1
+                    elif kind == "resync":
+                        counts["resyncs"] += 1
+            except Exception:
+                pass
+
+        async def start_subscribers() -> None:
+            from openr_tpu.ctrl.client import CtrlClient
+
+            wrappers = list(net.wrappers.values())
+            for i in range(subscribers):
+                wrapper = wrappers[i % len(wrappers)]
+                client = await CtrlClient(
+                    "127.0.0.1", wrapper.ctrl_port
+                ).connect()
+                sub_clients.append(client)
+                sub_tasks.append(
+                    asyncio.get_running_loop().create_task(watch(client))
+                )
 
         def converged() -> bool:
             for i in range(n):
@@ -609,6 +649,9 @@ def run_bench_convergence(
 
         try:
             await wait_until(converged, timeout=60.0)
+            if subscribers:
+                await start_subscribers()
+            t_stream0 = time.perf_counter()
             for _ in range(max(1, flaps)):
                 net.fail_link(
                     f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
@@ -618,14 +661,37 @@ def run_bench_convergence(
                     f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
                 )
                 await wait_until(converged, timeout=60.0)
+            stream_elapsed = time.perf_counter() - t_stream0
+            if subscribers:
+                # drain: deliveries race the last convergence check
+                await asyncio.sleep(0.2)
             agg = net.convergence_report()
             exporter_stats = (
                 _measure_exporter_overhead(net) if measure_exporter else {}
             )
         finally:
+            for task in sub_tasks:
+                task.cancel()
+            if sub_tasks:
+                await asyncio.gather(*sub_tasks, return_exceptions=True)
+            for client in sub_clients:
+                await client.close()
             await net.stop_all()
 
         e2e = agg["e2e_ms"]
+        stream_stats = {}
+        if subscribers:
+            stream_stats = {
+                "stream_subscribers": subscribers,
+                "stream_frames": counts["frames"],
+                "stream_deltas": counts["deltas"],
+                "stream_resyncs": counts["resyncs"],
+                "stream_events_per_s": (
+                    counts["deltas"] / stream_elapsed
+                    if stream_elapsed > 0
+                    else 0.0
+                ),
+            }
         return {
             "nodes": n,
             "flaps": max(1, flaps),
@@ -635,6 +701,7 @@ def run_bench_convergence(
             "e2e_p95_ms": e2e["p95"],
             "e2e_max_ms": e2e["max"],
             **exporter_stats,
+            **stream_stats,
         }
 
     loop = asyncio.new_event_loop()

@@ -214,3 +214,9 @@ def injected(injector: Optional[FaultInjector] = None):
 #   te.optimize           TE optimization device dispatch (te/service.py)
 #   monitor.exporter.push metrics push-sink write, ctx=MetricsExporter
 #                         (monitor/exporter.py)
+#   ctrl.stream.publish   streaming fan-out dispatch, ctx=item
+#                         (streaming/subscription.py)
+#   ctrl.stream.deliver   per-frame stream delivery, ctx=subscription;
+#                         actions may set sub.throttle_s (ctrl/server.py)
+#   ctrl.admission.dispatch  admitted expensive-RPC dispatch, ctx=method
+#                         (streaming/admission.py)

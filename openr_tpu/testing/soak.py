@@ -74,6 +74,11 @@ class SoakConfig:
     max_event_log: int = 100
     window_s: float = 1.0
     max_windows: int = 600
+    # streaming scrape mode (docs/Streaming.md): every node gets a
+    # `subscribeKvStore` adj-delta subscription over its real ctrl
+    # socket, wave scrapes trigger on stream activity instead of a poll,
+    # and the report gains a `stream` section (frames/resyncs per node)
+    stream_scrapes: bool = False
 
 
 def _chord_pool(n: int) -> List[Tuple[int, int]]:
@@ -144,6 +149,96 @@ def _window_overlaps(
     return any(t0 < end and start < t1 for t0, t1 in intervals)
 
 
+def series_slope(series: List[float]) -> float:
+    """Least-squares slope (ms per window) of a windowed series — the
+    drift detector: a sustained positive slope over a long soak means
+    convergence latency is trending up even if no single window broke."""
+    n = len(series)
+    if n < 2:
+        return 0.0
+    xs = range(n)
+    mean_x = (n - 1) / 2.0
+    mean_y = sum(series) / n
+    num = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, series))
+    den = sum((x - mean_x) ** 2 for x in xs)
+    return num / den if den else 0.0
+
+
+def detect_step(
+    series: List[float],
+    *,
+    min_side: int = 2,
+    min_ratio: float = 2.0,
+    min_delta_ms: float = 5.0,
+) -> Optional[Dict[str, float]]:
+    """Step-change detector over a windowed p95 series: the split point
+    maximizing the after-mean/before-mean jump, reported only when the
+    jump clears BOTH a relative (`min_ratio`) and an absolute
+    (`min_delta_ms`) threshold with at least `min_side` windows on each
+    side — double-gating keeps µs-scale emulator noise from flagging.
+    Returns {"index", "before_ms", "after_ms", "ratio"} or None."""
+    n = len(series)
+    best: Optional[Dict[str, float]] = None
+    for split in range(min_side, n - min_side + 1):
+        before = series[:split]
+        after = series[split:]
+        mean_b = sum(before) / len(before)
+        mean_a = sum(after) / len(after)
+        delta = mean_a - mean_b
+        if delta < min_delta_ms:
+            continue
+        ratio = mean_a / mean_b if mean_b > 0 else float("inf")
+        if ratio < min_ratio:
+            continue
+        if best is None or delta > best["after_ms"] - best["before_ms"]:
+            best = {
+                "index": split,
+                "before_ms": round(mean_b, 3),
+                "after_ms": round(mean_a, 3),
+                "ratio": round(ratio, 3) if ratio != float("inf") else -1.0,
+            }
+    return best
+
+
+def analyze_trend(
+    windows: List[Dict[str, Any]],
+    stage_series: Dict[str, List[float]],
+    fault_intervals: List[Tuple[float, float]],
+    window_s: float,
+) -> Dict[str, Any]:
+    """The sharpened soak judge: windowed p95 slope + step detection on
+    the end-to-end series, with per-stage attribution of a detected
+    break — the stages whose own p95 series step at (or within one
+    window of) the same split are the likely cause, turning "p95 got
+    worse" into "fib.program regressed at wave 7"."""
+    p95_series = [w["e2e_p95_ms"] for w in windows if w["events"]]
+    live = [w for w in windows if w["events"]]
+    trend: Dict[str, Any] = {
+        "windows": len(p95_series),
+        "p95_slope_ms_per_window": round(series_slope(p95_series), 4),
+        "step": None,
+        "attributed_stages": [],
+    }
+    step = detect_step(p95_series)
+    if step is not None:
+        idx = int(step["index"])
+        window = live[min(idx, len(live) - 1)]
+        step["window_start"] = window["start"]
+        step["faulted"] = _window_overlaps(
+            window["start"], window_s, fault_intervals
+        )
+        trend["step"] = step
+        for stage, series in sorted(stage_series.items()):
+            stage_step = detect_step(series)
+            if stage_step is not None and abs(
+                int(stage_step["index"]) - idx
+            ) <= 1:
+                trend["attributed_stages"].append(
+                    {"stage": stage, **stage_step}
+                )
+    return trend
+
+
 def _judge(
     merged: Dict[str, Any],
     fault_intervals: List[Tuple[float, float]],
@@ -161,6 +256,7 @@ def _judge(
     faulted = Histogram()
     clean_windows = faulted_windows = 0
     p95_series: List[float] = []
+    stage_series: Dict[str, List[float]] = {}
     for window in merged["windows"]:
         total = window["stages"].get(ConvergenceRollup.TOTAL_STAGE)
         is_faulted = _window_overlaps(
@@ -179,12 +275,27 @@ def _judge(
         )
         if total is not None and window["events"]:
             p95_series.append(stats["p95"])
+            # aligned per-stage p95 series (0.0-filled where a stage had
+            # no samples) so a step in the e2e series can be attributed
+            # to the pipeline stage that broke at the same window
+            seen = set()
+            for stage, hist in window["stages"].items():
+                if stage == ConvergenceRollup.TOTAL_STAGE:
+                    continue
+                seen.add(stage)
+                stage_series.setdefault(
+                    stage, [0.0] * (len(p95_series) - 1)
+                ).append(hist.percentile(95))
+            for stage, series in stage_series.items():
+                if stage not in seen:
+                    series.append(0.0)
             if is_faulted:
                 faulted.merge(total)
                 faulted_windows += 1
             else:
                 clean.merge(total)
                 clean_windows += 1
+    trend = analyze_trend(windows, stage_series, fault_intervals, window_s)
 
     checks: Dict[str, Dict[str, Any]] = {}
 
@@ -230,8 +341,34 @@ def _judge(
         f"window(s): "
         + "/".join(f"{v:.1f}" for v in p95_series[:16]),
     )
+    step = trend["step"]
+    clean_break = step is not None and not step["faulted"]
+    check(
+        "no_clean_trend_break",
+        not clean_break,
+        (
+            "no p95 step break detected"
+            if step is None
+            else (
+                f"p95 step at window {step['index']} "
+                f"({step['before_ms']:.1f} -> {step['after_ms']:.1f}ms, "
+                f"{'fault-attributed' if step['faulted'] else 'CLEAN'}"
+                + (
+                    ", stages: "
+                    + ",".join(
+                        s["stage"] for s in trend["attributed_stages"]
+                    )
+                    if trend["attributed_stages"]
+                    else ""
+                )
+                + f"); slope "
+                f"{trend['p95_slope_ms_per_window']:+.3f}ms/window"
+            )
+        ),
+    )
     return {
         "windows": windows,
+        "trend": trend,
         "attribution": {
             "clean_windows": clean_windows,
             "faulted_windows": faulted_windows,
@@ -318,11 +455,62 @@ def run_soak(
             for name, wrapper in net.wrappers.items():
                 scrapes.scrape(name, wrapper.daemon)
 
+        # streaming scrape mode: each node carries a live
+        # `subscribeKvStore` adj-delta subscription over its real ctrl
+        # socket; wave scrapes trigger on delivered stream frames
+        # instead of polling (docs/Streaming.md)
+        stream_counts: Dict[str, Dict[str, int]] = {}
+        stream_tasks: List[asyncio.Task] = []
+        stream_clients: List[Any] = []
+
+        async def _watch_stream(name: str, client) -> None:
+            try:
+                async for frame in client.subscribe(
+                    "subscribeKvStore",
+                    area="0",
+                    prefixes=["adj:"],
+                    client="soak-scrape",
+                ):
+                    stream_counts[name]["frames"] += 1
+                    if frame.get("type") == "resync":
+                        stream_counts[name]["resyncs"] += 1
+            except Exception:
+                stream_counts[name]["errors"] = (
+                    stream_counts[name].get("errors", 0) + 1
+                )
+
+        async def _start_streams() -> None:
+            from openr_tpu.ctrl.client import CtrlClient
+
+            for name, wrapper in net.wrappers.items():
+                client = await CtrlClient(
+                    "127.0.0.1", wrapper.ctrl_port
+                ).connect()
+                stream_clients.append(client)
+                stream_counts[name] = {"frames": 0, "resyncs": 0}
+                stream_tasks.append(
+                    asyncio.get_running_loop().create_task(
+                        _watch_stream(name, client)
+                    )
+                )
+
+        def stream_frames_total() -> int:
+            return sum(c["frames"] for c in stream_counts.values())
+
         with injected(FaultInjector(seed=cfg.seed)) as inj:
             try:
                 await wait_until(
                     converged, timeout=cfg.converge_timeout_s
                 )
+                if cfg.stream_scrapes:
+                    await _start_streams()
+                    # the initial snapshot frames prove every stream is up
+                    await wait_until(
+                        lambda: all(
+                            c["frames"] >= 1 for c in stream_counts.values()
+                        ),
+                        timeout=cfg.converge_timeout_s,
+                    )
                 scrape_all()
                 for wave_i in range(cfg.waves):
                     chaos = (
@@ -334,6 +522,7 @@ def run_soak(
                         fault_t0 = time.time()
                     # the OCS bulk reconfiguration: remove up-chords,
                     # add down-chords, all in one batch
+                    frames_before = stream_frames_total()
                     ups = [c for c in chords if chord_state[c] == "up"]
                     downs = [c for c in chords if chord_state[c] != "up"]
                     rng.shuffle(ups)
@@ -365,6 +554,14 @@ def run_soak(
                     except AssertionError:
                         wave_ok = False
                     converge_ms = (time.time() - t0) * 1e3
+                    if cfg.stream_scrapes and wave_ok:
+                        # scrape on push, not poll: the wave's adjacency
+                        # deltas must arrive over the subscription
+                        # streams before the post-wave scrape fires
+                        await wait_until(
+                            lambda: stream_frames_total() > frames_before,
+                            timeout=cfg.converge_timeout_s,
+                        )
                     await asyncio.sleep(cfg.settle_s)
                     if chaos:
                         for point in ("fib.program", "kvstore.flood_send",
@@ -415,6 +612,14 @@ def run_soak(
                 fib_spans_closed = fib_spans()
                 reports = net.node_reports()
             finally:
+                for task in stream_tasks:
+                    task.cancel()
+                if stream_tasks:
+                    await asyncio.gather(
+                        *stream_tasks, return_exceptions=True
+                    )
+                for client in stream_clients:
+                    await client.close()
                 await net.stop_all()
 
         merged = merge_rollup_snapshots(
@@ -438,6 +643,14 @@ def run_soak(
                 "intervals": [list(iv) for iv in fault_intervals],
             },
             "scrapes": scrapes.summary(),
+            "stream": {
+                "enabled": cfg.stream_scrapes,
+                "nodes": dict(stream_counts),
+                "frames_total": stream_frames_total(),
+                "resyncs_total": sum(
+                    c["resyncs"] for c in stream_counts.values()
+                ),
+            },
             "events": {
                 "total": merged["events_total"],
                 "windowed": sum(
@@ -502,6 +715,7 @@ def run_soak_smoke() -> Dict[str, Any]:
         "waves_converged",
         "scrape_health",
         "no_monotonic_regression",
+        "no_clean_trend_break",
     ):
         assert name in checks, sorted(checks)
         assert checks[name]["ok"], (name, checks[name])

@@ -385,6 +385,73 @@ def test_thread_ownership_monitor_rebind(tmp_path):
     assert [f.check for f in found] == ["monitor-rebind"], found
 
 
+_OWNERSHIP_QUEUE = '''
+def owned_by(owner):
+    def mark(obj):
+        return obj
+    return mark
+
+
+class CtrlServer:
+    def m_sub(self, params):
+        return self.stream_manager.add_kvstore_subscriber()
+
+    def m_unsub(self, params):
+        return self.stream_manager.remove_subscriber(params["sub"])
+
+    def m_push(self, params):
+        return self.stream_manager.enqueue_async(params)
+
+
+@owned_by("ctrl-loop")
+class StreamManager:
+    def __init__(self):
+        self._subs = []  # analysis: queue
+        self.other = 0
+
+    def add_kvstore_subscriber(self):
+        self._subs.append(object())  # sanctioned: sync enqueue seam
+        return self._subs[-1]
+
+    def remove_subscriber(self, sub):
+        self._subs.remove(sub)  # sanctioned (same handover)
+        self.other += 1  # NOT the queue attr: still flagged
+
+    async def enqueue_async(self, params):
+        self._subs.append(params)  # async entry: NOT sanctioned
+'''
+
+
+def test_thread_ownership_queue_handover(tmp_path):
+    """The subscriber-queue handover (docs/Streaming.md): mutations of
+    `# analysis: queue` attributes from SYNC ctrl-reachable methods are
+    the sanctioned publisher-side enqueue seam; the marker is
+    per-attribute (unlike '# analysis: shared' it does not waive the
+    rest of the method), and an async enqueue is still flagged."""
+    path = _write(tmp_path, "queue_own.py", _OWNERSHIP_QUEUE)
+    found, _ = _findings([path], rule="thread-ownership")
+    checks = sorted(f.check for f in found)
+    assert checks == ["async-enqueue", "unowned-mutation"], found
+    by_check = {f.check: f for f in found}
+    assert "self.other" in by_check["unowned-mutation"].message
+    assert "enqueue_async" in by_check["async-enqueue"].message
+    assert "_subs" in by_check["async-enqueue"].message
+
+
+def test_thread_ownership_queue_handover_on_shipped_stream_manager():
+    """The real StreamManager's add/remove/enqueue methods ride the
+    queue handover (no blanket '# analysis: shared' waivers) and must
+    stay quiet — pinned directly, not only via the package self-run.
+    The ctrl server file is included so the external surface contains
+    the subscriber-registry method names."""
+    targets = [
+        PKG / "streaming" / "subscription.py",
+        PKG / "ctrl" / "server.py",
+    ]
+    found, _ = _findings(targets, rule="thread-ownership")
+    assert found == [], found
+
+
 def test_thread_ownership_is_advisory_unless_strict(tmp_path):
     path = _write(tmp_path, "bad_own.py", _OWNERSHIP_BAD)
     # advisory by default: CLI exits 0 ... but --strict promotes to error
@@ -980,6 +1047,74 @@ def test_device_transfer_quiet_on_shipped_solver_consumers():
     targets = [PKG / "solver" / "tpu.py", PKG / "te" / "optimizer.py"]
     found, _ = _findings(targets, rule="device-transfer")
     assert found == [], found
+
+
+_DEVICE_ATTR_PRODUCERS = '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def solve(x):
+    return x
+
+
+class Holder:
+    def __init__(self):
+        self._d_dev = None
+
+    def fill(self):
+        # tuple-unpacked store: BOTH attributes become device-tagged
+        self._d_dev, self.rounds_last = self._resident(1)
+
+    def _resident(self, x):
+        # device-returning METHOD: self._resident(...) call sites are
+        # producers after the per-class fixpoint
+        return solve(x), 0
+
+    def bad_attr_consumer(self):
+        return np.asarray(self._d_dev)
+
+    def bad_method_consumer(self):
+        d = self._resident(2)
+        return float(d)
+
+    def accounted_consumer(self):
+        out = np.asarray(self._d_dev)
+        self.d2h_bytes = out.nbytes
+        return out
+
+    def host_attr_is_untainted(self):
+        # a host copy breaks the taint: storing it makes a HOST attr
+        self._d_host = np.array([1, 2])
+        return float(self._d_host[0])
+'''
+
+
+def test_device_transfer_tracks_attribute_and_method_producers(tmp_path):
+    """The ROADMAP analysis carry-over: `self._d_dev`-style producers
+    are covered by dataflow — an attribute stored from a device value
+    (through a method-return, through tuple unpacking) taints its loads
+    in EVERY method of the class; consumers that account `*d2h*` bytes
+    stay sanctioned; host-copied attributes stay untainted."""
+    path = _write(tmp_path, "attr_dev.py", _DEVICE_ATTR_PRODUCERS)
+    found, _ = _findings([path], rule="device-transfer")
+    by_line = {f.line: f for f in found}
+    assert sorted(f.check for f in found) == ["host-sync", "host-sync"], (
+        found
+    )
+    messages = " | ".join(f.message for f in found)
+    assert "bad_attr_consumer" in messages
+    assert "self._d_dev" in messages
+    assert "bad_method_consumer" in messages
+    assert "accounted_consumer" not in messages
+    assert "host_attr_is_untainted" not in messages
+    assert by_line  # anchored to real lines
+
+
+def test_device_transfer_attr_producer_cli_exits_nonzero(tmp_path):
+    path = _write(tmp_path, "attr_dev.py", _DEVICE_ATTR_PRODUCERS)
+    assert analysis_main([str(path), "--no-baseline", "--strict"]) == 1
 
 
 # ---------------------------------------------------------------------------

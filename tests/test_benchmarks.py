@@ -119,8 +119,9 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 5, (
-        "bench.py must print SPF+convergence+TE+scale+exporter JSON lines"
+    assert len(out) >= 6, (
+        "bench.py must print SPF+convergence+TE+scale+exporter+stream "
+        "JSON lines"
     )
     results = [json.loads(line) for line in out]
     for result in results:
@@ -152,6 +153,18 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert exporter["metric"] == "exporter_scrape_render_ms"
     assert exporter["rollup_record_us"] > 0
     assert exporter["metrics_series"] > 0
+    # the streaming fan-out line (ISSUE 11 'sixth metric line'): sustained
+    # delta-delivery rate across concurrent subscribeKvStore subscribers
+    # on the flap batch, with the convergence p95 of the subscriber run
+    # reported next to the zero-subscriber baseline (bench.py asserts the
+    # held-flat envelope itself; the contract here pins the line's shape)
+    stream = results[5]
+    assert stream["metric"] == "stream_fanout_events_s"
+    assert stream["subscribers"] > 0
+    assert stream["deliveries"] > 0
+    assert stream["value"] > 0
+    assert stream["e2e_p95_ms"] > 0
+    assert stream["baseline_e2e_p95_ms"] > 0
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):
