@@ -307,6 +307,9 @@ def _apply_smoke_env() -> None:
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "200"),
             ("BENCH_STREAM_SUBS", "8"),
+            ("BENCH_APSP_N", "96"),
+            ("BENCH_APSP_SWEEP", "48,96"),
+            ("BENCH_APSP_REPEATS", "1"),
         )
     )
 
@@ -332,6 +335,9 @@ def _apply_reduced_env() -> None:
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "500"),
             ("BENCH_STREAM_SUBS", "16"),
+            ("BENCH_APSP_N", "256"),
+            ("BENCH_APSP_SWEEP", "64,128,256"),
+            ("BENCH_APSP_REPEATS", "1"),
         )
     )
 
@@ -674,6 +680,118 @@ def _bench_stream() -> dict:
     }
 
 
+def _bench_apsp() -> dict:
+    """Seventh metric line: the blocked min-plus Floyd–Warshall APSP close
+    (openr_tpu/apsp, docs/Apsp.md) on a synthetic WAN — cold close wall
+    time (compile excluded), the warm re-close of a single-link weight
+    event (rounds + ms, the O(dirty-blocks) path), and the
+    FW-vs-batched-Dijkstra crossover sweep: at each node count the dense
+    blocked close races the batched min-plus column solve for ALL sources
+    (what serving the same all-pairs demand through the one-source batch
+    machinery would cost), bracketing where the solver should hand off.
+    Degraded-aware like every line: cpu-fallback rounds shrink the sizes
+    and are marked by main()."""
+    from openr_tpu.apsp import ApspState, np_floyd_warshall, build_weight_matrix
+    from openr_tpu.ops.graph import compile_edges
+    from openr_tpu.ops.spf import batched_spf
+    from openr_tpu.topology import wan_edges
+
+    n = int(os.environ.get("BENCH_APSP_N", "2048"))
+    sweep = [
+        int(x)
+        for x in os.environ.get("BENCH_APSP_SWEEP", "256,512,1024").split(",")
+        if x.strip()
+    ]
+    repeats = int(os.environ.get("BENCH_APSP_REPEATS", "3"))
+
+    def graph_for(nodes):
+        return compile_edges(wan_edges(nodes, degree=4, seed=7))
+
+    graph = graph_for(n)
+    apsp = ApspState(max_nodes=n)
+    apsp.ensure(graph)  # compile + first close, excluded
+    cold_times = []
+    for _ in range(max(repeats, 1)):
+        apsp.invalidate("bench_cold")
+        apsp.ensure(graph)
+        cold_times.append(apsp.close_ms_last)
+    cold_ms = min(cold_times)
+
+    # warm re-close of a single-link weight event: patch one real edge
+    # (the first warm event compiles the seed + re-close executables and
+    # is dropped, same compile-excluded convention as the cold loop)
+    w_mut = graph.w.copy()
+    pos = graph.e // 2
+    warm_times = []
+    rounds = 0
+    for i in range(max(repeats, 1) + 1):
+        w_mut = w_mut.copy()
+        w_mut[pos] = int(w_mut[pos]) % 13 + 1 + i
+        graph.w = w_mut
+        graph.version += 1
+        apsp.ensure(graph)
+        if i:
+            warm_times.append(apsp.close_ms_last)
+        rounds = apsp.reclose_rounds_last or 0
+    warm_ms = min(warm_times)
+
+    crossover = []
+    handoff = None
+    for nodes in sweep:
+        g = compile_edges(wan_edges(nodes, degree=4, seed=7))
+        sub = ApspState(max_nodes=nodes)
+        sub.ensure(g)  # compile excluded
+        sub.invalidate("bench_cold")
+        t0 = time.perf_counter()
+        sub.ensure(g)
+        fw_ms = (time.perf_counter() - t0) * 1e3
+        sources = np.arange(g.n_pad, dtype=np.int32)
+        np.asarray(batched_spf(g, sources))  # compile excluded
+        t0 = time.perf_counter()
+        np.asarray(batched_spf(g, sources))
+        dj_ms = (time.perf_counter() - t0) * 1e3
+        crossover.append(
+            {
+                "nodes": nodes,
+                "fw_close_ms": round(fw_ms, 3),
+                "batched_dijkstra_ms": round(dj_ms, 3),
+            }
+        )
+        if handoff is None and fw_ms < dj_ms:
+            handoff = nodes
+    # parity spot-check: the bench must not report a number for a wrong
+    # matrix (cheap at the smallest sweep size)
+    g_chk = compile_edges(wan_edges(sweep[0], degree=4, seed=7))
+    chk = ApspState(max_nodes=sweep[0])
+    chk.ensure(g_chk)
+    ref = np_floyd_warshall(build_weight_matrix(g_chk), g_chk.overloaded)
+    assert np.array_equal(chk.d, ref), "APSP bench parity check failed"
+
+    _note(
+        f"apsp: {n}-node WAN blocked-FW close {cold_ms:.1f}ms cold / "
+        f"{warm_ms:.1f}ms warm re-close ({rounds} round(s)); crossover "
+        + ", ".join(
+            f"{c['nodes']}n fw {c['fw_close_ms']:.0f}ms vs dj "
+            f"{c['batched_dijkstra_ms']:.0f}ms"
+            for c in crossover
+        )
+    )
+    return {
+        "metric": "fw_apsp_close_ms",
+        "value": round(cold_ms, 3),
+        "unit": (
+            f"ms per cold blocked-FW all-pairs close ({n}-node WAN, "
+            f"compile excluded, best of {len(cold_times)})"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "warm_reclose_ms": round(warm_ms, 3),
+        "reclose_rounds": rounds,
+        "crossover": crossover,
+        "crossover_nodes": handoff,
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -729,6 +847,8 @@ def main(argv=None) -> None:
             # defined against the convergence flap batch: without the
             # baseline run there is no held-flat comparison to make
             results.append(_bench_stream())
+        if os.environ.get("BENCH_APSP", "1") == "1":
+            results.append(_bench_apsp())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

@@ -1,0 +1,27 @@
+"""Blocked min-plus Floyd–Warshall APSP subsystem (docs/Apsp.md).
+
+Dense all-pairs shortest paths for small/medium areas as MXU-tile-sized
+(min,+) block updates (arXiv:2310.03983), with a warm re-close path that
+re-runs only the block rows/columns reachable from changed edges, a
+device-resident `ApspState` following the `_AreaSolve` host-mirror/
+d2h-accounting discipline, and a numpy Floyd–Warshall fallback inside the
+solver fault domain.
+"""
+
+from openr_tpu.apsp.kernels import (
+    apsp_compile_cache_stats,
+    build_allow_matrix,
+    build_weight_matrix,
+    fw_block_shape,
+    np_floyd_warshall,
+)
+from openr_tpu.apsp.state import ApspState
+
+__all__ = [
+    "ApspState",
+    "apsp_compile_cache_stats",
+    "build_allow_matrix",
+    "build_weight_matrix",
+    "fw_block_shape",
+    "np_floyd_warshall",
+]

@@ -163,6 +163,11 @@ class DecisionConfigSection:
     # partial-mesh degradation: device-loss streaks shrink the solver
     # mesh over surviving chips before the breaker trips to the oracle
     solver_mesh_degrade: bool = True
+    # resident blocked-FW all-pairs matrix (docs/Apsp.md) for areas up to
+    # solver_apsp_max_nodes real nodes; keeps DeltaPath enabled under
+    # compute_lfa_paths and serves KSP layer seeding + TE hard-scoring
+    solver_apsp: bool = True
+    solver_apsp_max_nodes: int = 4096
 
 
 @dataclass

@@ -145,6 +145,13 @@ class DecisionConfig:
     # partial-mesh degradation ladder: a device-loss streak re-resolves
     # the solver mesh over surviving chips before the breaker may open
     solver_mesh_degrade: bool = True
+    # resident blocked-FW all-pairs matrix (docs/Apsp.md): areas up to
+    # solver_apsp_max_nodes real nodes keep a device-resident APSP matrix
+    # serving LFA qualification, KSP layer seeding and TE hard-scoring —
+    # and keeping DeltaPath enabled under compute_lfa_paths; solver_apsp
+    # off disables it wholesale (big areas fall back per-area regardless)
+    solver_apsp: bool = True
+    solver_apsp_max_nodes: int = 4096
 
 
 # wall-clock PerfEvent descriptors mapped onto convergence-span stages:
@@ -300,6 +307,11 @@ class Decision(CountersMixin, HistogramsMixin):
             primary = TpuSpfSolver(
                 config.my_node_name,
                 mesh=config.solver_mesh,
+                apsp_max_nodes=(
+                    config.solver_apsp_max_nodes if config.solver_apsp else 0
+                ),
+                # the APSP shadow audit shares the warm-state audit cadence
+                apsp_audit_interval=config.solver_audit_interval,
                 **solver_kwargs,
             )
             if config.solver_supervised:

@@ -289,6 +289,8 @@ class OpenrDaemon:
                 solver_probe_successes=dc.solver_probe_successes,
                 solver_audit_interval=dc.solver_audit_interval,
                 solver_mesh_degrade=dc.solver_mesh_degrade,
+                solver_apsp=dc.solver_apsp,
+                solver_apsp_max_nodes=dc.solver_apsp_max_nodes,
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,

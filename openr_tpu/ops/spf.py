@@ -351,9 +351,10 @@ def _sell_invalidate(dp, nbrs, wgs, inc_idx, zero_end, starts, shapes):
     DAGs, so it is surfaced as decision.spf.invalidation_rounds_last).
     Seed marks where an increased edge sits on the old shortest-path DAG
     (triangle condition against the old weights), then propagate marks down
-    the old DAG with a boolean fixpoint. Over-marking is safe (marked
-    entries are recomputed from INF); under-marking is impossible because
-    every true DAG edge passes the unmasked triangle test."""
+    the old DAG with a boolean fixpoint (`_sell_mark_fixpoint`, shared with
+    the per-row KSP warm seed). Over-marking is safe (marked entries are
+    recomputed from INF); under-marking is impossible because every true
+    DAG edge passes the unmasked triangle test."""
     n, s = dp.shape
     marks = jnp.zeros((n, s), dtype=jnp.bool_)
     for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
@@ -373,6 +374,16 @@ def _sell_invalidate(dp, nbrs, wgs, inc_idx, zero_end, starts, shapes):
             & (jnp.minimum(dp[u] + w_old[:, None], INF) == dv)
         )
         marks = marks.at[v].max(cond)
+    return _sell_mark_fixpoint(dp, marks, nbrs, wgs, zero_end, starts, shapes)
+
+
+def _sell_mark_fixpoint(dp, marks, nbrs, wgs, zero_end, starts, shapes):
+    """Propagate invalidation marks down the old shortest-path DAG (a
+    boolean fixpoint over the sliced layout): an entry marks when any of
+    its old-DAG in-edges carries a marked tail. Shared by the shared-
+    weights warm path (_sell_invalidate seeds) and the per-row KSP warm
+    seed (_sell_solver_vw_warm seeds). Returns (marks, rounds)."""
+    n, _ = dp.shape
 
     def body(state):
         m, _, it = state
@@ -544,6 +555,67 @@ def _bf_warm_core(
 
 
 _bf_solver_warm = jax.jit(_bf_warm_core, donate_argnums=(6,))
+
+
+def _bf_warm_vw_core(
+    sources: jnp.ndarray,  # int32 [S]
+    src_e: jnp.ndarray,  # int32 [E]
+    dst_e: jnp.ndarray,  # int32 [E] (sorted ascending)
+    w_rows: jnp.ndarray,  # int32 [S, E] per-row weights after the event
+    w_base: jnp.ndarray,  # int32 [E] shared weights that produced d_prev
+    overloaded: jnp.ndarray,  # bool [N]
+    d_prev: jnp.ndarray,  # int32 [S, N] base fixpoint (NOT donated)
+):
+    """Per-row-weights warm solve on the edge-list layout: the KSP
+    layer-seeding form of _bf_warm_core. Every per-row weight change is an
+    INCREASE (link-ignore masks pin base weights to INF), so each batch
+    row warm-starts from the shared unpenalized base fixpoint: seed marks
+    where a row's masked edge sits on the base DAG, propagate down the
+    base DAG, reset, and relax with the per-row weights. d_prev is a
+    broadcast view of the resident base row, so it is not donated."""
+    n = overloaded.shape[0]
+    s = sources.shape[0]
+    dp = d_prev
+    du = dp[:, src_e]  # [S, E]
+    dv = dp[:, dst_e]
+    on_old = (jnp.minimum(du + w_base[None, :], INF) == dv) & (dv < INF)
+    seeds = on_old & (w_rows > w_base[None, :])
+
+    def seg_any(rows):  # bool [S, E] -> bool [S, N] (OR per destination)
+        return (
+            jax.vmap(
+                lambda row: jax.ops.segment_max(
+                    row.astype(jnp.int32),
+                    dst_e,
+                    num_segments=n,
+                    indices_are_sorted=True,
+                )
+            )(rows)
+            > 0
+        )
+
+    marks0 = seg_any(seeds)
+
+    def body(state):
+        m, _, it = state
+        new_m = m | seg_any(m[:, src_e] & on_old)
+        return new_m, jnp.any(new_m != m), it + 1
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n)
+
+    marks, _, inv_rounds = jax.lax.while_loop(
+        cond, body, (marks0, jnp.any(marks0), 0)
+    )
+    d0 = jnp.where(marks, INF, dp)
+    d0 = d0.at[jnp.arange(s), sources].set(0)  # re-pin marked sources
+    allow = _bf_allow(sources, overloaded)
+    d, rounds = _bf_relax(d0, allow, src_e, dst_e, w_rows)
+    return d, rounds, inv_rounds
+
+
+_bf_solver_warm_vw = jax.jit(_bf_warm_vw_core)
 
 
 # -- destination-tiled 2-D P('batch', 'graph') kernels ----------------------
@@ -868,6 +940,69 @@ def _sell_solver_vw(key: Tuple, mesh=None):
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _sell_solver_vw_warm(key: Tuple, mesh=None):
+    """Warm per-row-weights sliced-ELL solve: the KSP layer-seeding form.
+
+    (sources, nbrs, wgs, masks, overloaded, d_prev [S, N]) -> D [S, N].
+    The mask positions ARE the increased edges (base weight -> INF), so
+    the penalized layer-k solve warm-starts from the unpenalized base
+    fixpoint d_prev instead of cold-starting from INF: seed invalidation
+    marks where a masked edge sits on the base shortest-path DAG (per
+    batch column, since each row ignores its own link set), propagate
+    them down the base DAG (`_sell_mark_fixpoint`), reset marked entries
+    to INF, and relax with the masked per-row weights. Rounds scale with
+    the penalized detour radius, not the graph diameter — the KSP
+    warm-start carry-over (ROADMAP FatPaths item)."""
+    zero_end, starts, shapes = key
+
+    def solve(sources, nbrs, wgs, masks, overloaded, d_prev):
+        s = sources.shape[0]
+        dp = d_prev.T  # dest-major [N, S]
+        marks = jnp.zeros(dp.shape, dtype=jnp.bool_)
+        wgv = []
+        for k, (nbr_k, wg_k) in enumerate(zip(nbrs, wgs)):
+            nk, dk = shapes[k]
+            m = masks[k]
+            valid = m[:, 0] < (1 << 29)  # padding rows are 1 << 30
+            r = jnp.clip(m[:, 0], 0, nk - 1)
+            j = jnp.clip(m[:, 1], 0, dk - 1)
+            c = jnp.clip(m[:, 2], 0, s - 1)
+            u = nbr_k[r, j]  # [M] in-neighbor of each masked edge
+            w_old = wg_k[r, j]  # [M] base weight (pre-mask)
+            v = starts[k] + r  # [M] global node row of each edge head
+            dv = dp[v, c]  # [M]
+            cond = (
+                valid
+                & (dv < INF)
+                & (jnp.minimum(dp[u, c] + w_old, INF) == dv)
+            )
+            marks = marks.at[v, c].max(cond)
+            # the masked per-row weights, as in _sell_solver_vw
+            full = jnp.broadcast_to(wg_k[:, :, None], (nk, dk, s))
+            full = full.at[m[:, 0], m[:, 1], m[:, 2]].set(INF, mode="drop")
+            wgv.append(full)
+        marks, _ = _sell_mark_fixpoint(
+            dp, marks, nbrs, wgs, zero_end, starts, shapes
+        )
+        d0 = jnp.where(marks, INF, dp)
+        d0 = d0.at[sources, jnp.arange(s)].set(0)  # re-pin marked sources
+        _, allow = _sell_d0_allow(sources, overloaded)
+        d, _ = _sell_relax(
+            d0, allow, nbrs, tuple(wgv), zero_end, starts, shapes
+        )
+        return d.T
+
+    if mesh is None:
+        return jax.jit(solve)
+    row, repl, out = _mesh_shardings(mesh)
+    return jax.jit(
+        solve,
+        in_shardings=(row, repl, repl, repl, repl, out),
+        out_shardings=out,
+    )
+
+
 def sell_fixpoint_masked(
     sell,  # ops.graph.SlicedEll
     sources,  # int32 [S]
@@ -875,6 +1010,7 @@ def sell_fixpoint_masked(
     mask_positions,  # per batch row: list of edge positions to pin to INF
     device_arrays=None,  # optional (nbrs, wgs, ov) already on device
     mesh=None,  # optional solver mesh: sources sharded over 'batch'
+    d_prev=None,  # optional [S, N] base fixpoint: warm-start the solve
 ) -> jnp.ndarray:
     """Per-row link-ignore solve on the sliced layout.
 
@@ -883,7 +1019,11 @@ def sell_fixpoint_masked(
     INF for batch row i only. Mask arrays are bucket-padded so repeated
     calls with similar mask counts share jitted executables. Pass
     device_arrays (e.g. an _AreaSolve's persistent buffers) to avoid
-    re-uploading the layout per call.
+    re-uploading the layout per call. With d_prev — the UNPENALIZED base
+    distance rows for the same sources and weights — the penalized solve
+    warm-starts via increase-invalidation instead of relaxing from INF
+    (`_sell_solver_vw_warm`): sound because masking only raises weights,
+    so the base fixpoint plus mark-reset is a valid upper-bound seed.
     """
     nb = len(sell.nbr)
     per_bucket: list = [[] for _ in range(nb)]
@@ -906,6 +1046,16 @@ def sell_fixpoint_masked(
         nbrs = tuple(jnp.asarray(a) for a in sell.nbr)
         wgs = tuple(jnp.asarray(a) for a in sell.wg)
         ov = jnp.asarray(overloaded)
+    if d_prev is not None:
+        fn = _sell_solver_vw_warm(sell.shape_key(), mesh)
+        return fn(
+            jnp.asarray(sources, dtype=jnp.int32),
+            nbrs,
+            wgs,
+            tuple(masks),
+            ov,
+            d_prev,
+        )
     fn = _sell_solver_vw(sell.shape_key(), mesh)
     return fn(
         jnp.asarray(sources, dtype=jnp.int32), nbrs, wgs, tuple(masks), ov
@@ -1021,6 +1171,7 @@ def compile_cache_stats() -> dict:
         _sell_solver_patched,
         _sell_solver_warm,
         _sell_solver_vw,
+        _sell_solver_vw_warm,
         _bf_vw_solver,
         _tile_solver,
         _tile_solver_warm,

@@ -21,10 +21,16 @@ solver refuses to produce a delta for events touching (b) or (c)
 for (d) and batches that structurally change the LSDB, and Decision feeds
 (e) in as explicit dirty prefixes. SR_MPLS-forwarding prefixes (KSP2 path
 traces can move on edges no distance column reflects) are always dirty via
-`PrefixState.mpls_forwarding_prefixes`, and RFC 5286 LFA (reads
-distance-to-me columns for every destination) disables the delta path
-altogether. Everything else is provably unchanged and is neither recomputed
-nor diffed.
+`PrefixState.mpls_forwarding_prefixes`. RFC 5286 LFA adds exactly one
+input beyond the announcer columns — the ME column, read by every
+alt-neighbor row's inequality threshold — so with an APSP-capable solver
+(`lfa_delta_ready`, docs/Apsp.md) the delta path stays enabled under
+`compute_lfa_paths`: the solver's poll answers None whenever the changed
+set contains me (poisoning exactly the events whose LFA thresholds moved),
+and every other LFA input is a changed-announcer column the dirty set
+already covers. Solvers without a resident APSP state keep the historical
+force-full behavior. Everything else is provably unchanged and is neither
+recomputed nor diffed.
 
 The correctness backstop is the SolverSupervisor's route-delta shadow audit
 (`verify_route_delta`): every Nth delta-built db is compared against a full
@@ -90,11 +96,13 @@ class DeltaRouteBuilder:
         except Exception as exc:  # solve fault: the full path's supervised
             self.last_error = exc  # build_route_db owns retry/fallback
             log.warning("device delta poll failed: %s", exc)
+        lfa_on = getattr(self.solver, "compute_lfa_paths", False)
+        lfa_ready = getattr(self.solver, "lfa_delta_ready", None)
         if (
             changed_nodes is not None
             and not force_full
             and prev_db is not None
-            and not getattr(self.solver, "compute_lfa_paths", False)
+            and (not lfa_on or (lfa_ready is not None and lfa_ready()))
         ):
             try:
                 out = self._build_delta(

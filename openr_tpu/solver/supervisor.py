@@ -193,6 +193,13 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         self.histograms: Dict = {}
         self.counters["decision.spf.fallback_active"] = 0
 
+        # non-solve device workloads owned by the primary (the APSP
+        # closes) dispatch through this fault domain too: classified
+        # faults feed the shared breaker, numpy FW is their degraded path
+        attach = getattr(primary, "attach_supervisor", None)
+        if attach is not None:
+            attach(self)
+
     # ------------------------------------------------------------------
     # lifecycle (background probe loop; optional — probes also run
     # opportunistically from the solve path when no loop is attached)
@@ -757,5 +764,9 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             ),
             "delta_audit_mismatches": self.counters.get(
                 "decision.spf.delta_audit_mismatches", 0
+            ),
+            "apsp_closes": self.counters.get("decision.spf.apsp_closes", 0),
+            "apsp_audit_mismatches": self.counters.get(
+                "decision.spf.apsp_audit_mismatches", 0
             ),
         }

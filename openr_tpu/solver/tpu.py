@@ -108,12 +108,23 @@ class _TpuSpfResult:
     def next_hops_of(self, dest: str) -> Set[str]:
         """ECMP nexthop node set for source -> dest via triangle condition.
 
-        Only valid when source is the solve's primary node: neighbor rows for
-        other sources are not in the batch, so a silent partial answer here
-        would corrupt routes — fail fast instead (the pipeline only reads
-        nexthop sets from my_node_name's perspective).
+        The batch only solves nexthop sets from the primary node's
+        perspective (neighbor rows for other sources are not in it). For
+        any other source the resident all-pairs matrix answers instead —
+        the same triangle against APSP rows (docs/Apsp.md); without one,
+        fail fast rather than serve a silent partial answer (the route
+        pipeline only reads nexthop sets from my_node_name's perspective).
         """
         if self._source != self._area.sources[0]:
+            cached = self._nh_cache.get(dest)
+            if cached is not None:
+                return cached
+            if self._area.ensure_apsp():
+                nhs = _ApspSpfResult(
+                    self._area, self._source
+                ).next_hops_of(dest)
+                self._nh_cache[dest] = nhs
+                return nhs
             raise RuntimeError(
                 f"nexthop sets are only solved for {self._area.sources[0]}, "
                 f"requested for {self._source}"
@@ -128,6 +139,77 @@ class _TpuSpfResult:
             if col is not None:
                 names, mask = area.nh_mask()
                 nhs = {n for n, hit in zip(names, mask[:, col]) if hit}
+        self._nh_cache[dest] = nhs
+        return nhs
+
+
+class _ApspSpfResult:
+    """SpfResult-compatible view for a source OUTSIDE the solved batch,
+    backed by the area's resident all-pairs matrix (docs/Apsp.md).
+
+    Metrics read the source's APSP row; nexthop sets fall out of the same
+    triangle condition the batch path uses — w(s, n) + D[n, t] == D[s, t]
+    over s's ordered up-links, with overloaded neighbors valid only as
+    final destinations — but against ALT-NEIGHBOR ROWS of the one resident
+    matrix instead of a per-source Dijkstra column solve (the CPU-oracle
+    fallback this replaces)."""
+
+    def __init__(self, area: "_AreaSolve", source: str):
+        self._area = area
+        self._source = source
+        self._src_row = area.graph.node_index[source]
+        self._nh_cache: Dict[str, Set[str]] = {}
+
+    def __contains__(self, dest: str) -> bool:
+        col = self._area.graph.node_index.get(dest)
+        if col is None:
+            return False
+        return self._area.apsp.d[self._src_row, col] < INF
+
+    def get(self, dest: str) -> Optional[_NodeView]:
+        col = self._area.graph.node_index.get(dest)
+        if col is None:
+            return None
+        metric = int(self._area.apsp.d[self._src_row, col])
+        if metric >= INF:
+            return None
+        return _NodeView(metric, self, dest)
+
+    def __getitem__(self, dest: str) -> _NodeView:
+        view = self.get(dest)
+        if view is None:
+            raise KeyError(dest)
+        return view
+
+    def next_hops_of(self, dest: str) -> Set[str]:
+        cached = self._nh_cache.get(dest)
+        if cached is not None:
+            return cached
+        nhs: Set[str] = set()
+        area = self._area
+        idx = area.graph.node_index
+        col = idx.get(dest)
+        d = area.apsp.d
+        if (
+            dest != self._source
+            and col is not None
+            and d[self._src_row, col] < INF
+        ):
+            ls = area.link_state
+            for link in ls.ordered_links_from_node(self._source):
+                if not link.is_up():
+                    continue
+                n = link.other_node_name(self._source)
+                ni = idx.get(n)
+                if ni is None:
+                    continue
+                # an overloaded neighbor relays nothing: valid only when
+                # it is itself the destination (nh_mask semantics)
+                if ls.is_node_overloaded(n) and n != dest:
+                    continue
+                w = link.metric_from_node(self._source)
+                if w + int(d[ni, col]) == int(d[self._src_row, col]):
+                    nhs.add(n)
         self._nh_cache[dest] = nhs
         return nhs
 
@@ -151,7 +233,14 @@ class _AreaSolve:
     _PATCH_SLOTS overflow."""
 
     def __init__(
-        self, link_state: LinkState, me: str, mesh=None, warm_start: bool = True
+        self,
+        link_state: LinkState,
+        me: str,
+        mesh=None,
+        warm_start: bool = True,
+        apsp_max_nodes: int = 0,
+        apsp_audit_interval: int = 0,
+        apsp_dispatch=None,
     ) -> None:
         self.link_state = link_state
         self.me = me
@@ -161,8 +250,22 @@ class _AreaSolve:
         self.mesh = mesh
         self.warm_start = warm_start
         self.graph: CompiledGraph = compile_graph(link_state)
+        # resident all-pairs matrix (docs/Apsp.md): lazily closed on first
+        # consumer read, warm-re-closed per weight event, poisoned with the
+        # batch warm state; None when the apsp knob is off
+        self.apsp = None
+        if apsp_max_nodes > 0:
+            from openr_tpu.apsp import ApspState
+
+            self.apsp = ApspState(
+                apsp_max_nodes,
+                dispatch=apsp_dispatch,
+                audit_interval=apsp_audit_interval,
+                warm=warm_start,
+            )
         self.device_solves = 0
         self.ksp_device_batches = 0
+        self.ksp_warm_batches = 0  # penalized batches seeded from the base
         # convergence observability (decision.spf.* counters)
         self.incremental_solves = 0  # warm-started weight-patch solves
         self.full_solves = 0  # cold solves (from D0 = INF)
@@ -206,6 +309,7 @@ class _AreaSolve:
         self._delta_cols_synced = 0
         self._delta_bytes_synced = 0
         self._delta_extracts_synced = 0
+        self._ksp_warm_synced = 0
         # persistent device buffers (SURVEY.md §7: the <100ms convergence
         # budget leaves no room to re-upload the LSDB per event): sell
         # nbr/wg/overloaded live on device across events; weight patches
@@ -315,9 +419,25 @@ class _AreaSolve:
         # KSP: (dest, k) -> traced edge-disjoint path set for src == me;
         # reset with the snapshot, so topology changes invalidate it for free
         self._ksp: Dict[Tuple[str, int], List[Path]] = {}
+        # APSP staleness guard (docs/Apsp.md): any event that poisons the
+        # batch warm solve — cold start, patch overflow, structural
+        # rebuild, overload change — also invalidates the resident
+        # all-pairs matrix, so a consumer can never read distances the
+        # event classes above moved out from under it. Warm events leave
+        # it resident; its own ensure() re-closes the touched blocks.
+        if self.apsp is not None and not self.last_solve_warm:
+            self.apsp.invalidate("batch_warm_poisoned")
         # corruption seam (ctx = this solve): the warm-state audit tests
         # perturb the resident D here to prove divergence detection works
         fault_point("solver.tpu.warm_d", self)
+
+    def ensure_apsp(self) -> bool:
+        """Bring the resident all-pairs matrix current with this solve's
+        graph snapshot; False when APSP is off or the area exceeds the
+        node cap (consumers fall back to their column-solve paths)."""
+        if self.apsp is None:
+            return False
+        return self.apsp.ensure(self.graph)
 
     def _use_tiled(self) -> bool:
         """The destination-tiled P('batch', 'graph') layout serves whenever
@@ -984,6 +1104,27 @@ class _AreaSolve:
         s_pad = self._batch_pad(len(todo), minimum=1)
         me_row = idx[self.me]
         sources = np.full(s_pad, me_row, dtype=np.int32)
+        # warm layer seeding (docs/Apsp.md): the penalized layer-k problem
+        # is the base problem plus weight INCREASES (ignored links -> INF),
+        # so every batch row warm-starts from the resident base row of me —
+        # the same row the all-pairs matrix serves — via the standard
+        # increase-invalidation instead of cold-starting from INF. The
+        # tiled 2-D layout keeps a different buffer set and the mesh vw
+        # solvers shard d0 differently, so both keep the cold path.
+        warm_prev = None
+        if (
+            self.warm_start
+            and self.mesh is None
+            and self._d_dev is not None
+            and self._dev is not None
+            and self._dev.get("kind") in ("sell", "bf")
+        ):
+            import jax.numpy as jnp
+
+            base_row = self._d_dev[0]  # rows[0] is me's unpenalized row
+            warm_prev = jnp.broadcast_to(
+                base_row[None, :], (s_pad, base_row.shape[0])
+            )
         if self.graph.sell is not None:
             # sliced layout: per-row ignores become device-side INF masks —
             # no [S, E] host tile, no bulk upload
@@ -1009,12 +1150,48 @@ class _AreaSolve:
                     mask_positions,
                     device_arrays=(
                         (dev["nbrs"], dev["wgs"], dev["ov"])
-                        if dev is not None
+                        if dev is not None and dev.get("kind") == "sell"
                         else None
                     ),
                     mesh=self.mesh,
+                    d_prev=(
+                        warm_prev
+                        if dev is not None and dev.get("kind") == "sell"
+                        else None
+                    ),
                 )
             )
+            if (
+                warm_prev is not None
+                and dev is not None
+                and dev.get("kind") == "sell"
+            ):
+                self.ksp_warm_batches += 1
+        elif warm_prev is not None and self._dev.get("kind") == "bf":
+            from openr_tpu.ops.spf import _bf_solver_warm_vw
+
+            import jax.numpy as jnp
+
+            w_rows = np.tile(self.graph.w, (s_pad, 1))
+            for row, ig in enumerate(ignores):
+                for link in ig:
+                    fwd, rev = self.graph.link_edges[link]
+                    w_rows[row, fwd] = INF
+                    w_rows[row, rev] = INF
+            st = self._dev
+            fault_point("ops.spf.batched_spf_vw", self.graph)
+            d_dev, _rounds, _inv = _bf_solver_warm_vw(
+                jnp.asarray(sources, dtype=jnp.int32),
+                st["src"],
+                st["dst"],
+                jnp.asarray(w_rows, dtype=jnp.int32),
+                st["w"],
+                st["ov"],
+                warm_prev,
+            )
+            d_rows = np.asarray(d_dev)
+            self.h2d_bytes += w_rows.nbytes
+            self.ksp_warm_batches += 1
         else:
             w_rows = np.tile(self.graph.w, (s_pad, 1))
             for row, ig in enumerate(ignores):
@@ -1113,7 +1290,15 @@ class TpuSpfSolver(SpfSolver):
     meshed solver passes the same parity suite as the single-device one.
     """
 
-    def __init__(self, *args, mesh=None, warm_start: bool = True, **kwargs) -> None:
+    def __init__(
+        self,
+        *args,
+        mesh=None,
+        warm_start: bool = True,
+        apsp_max_nodes: int = 0,
+        apsp_audit_interval: int = 0,
+        **kwargs,
+    ) -> None:
         super().__init__(*args, **kwargs)
         # (area name, node) -> (LinkState identity, solve); keyed by the
         # stable area name so a replaced LinkState object for the same area
@@ -1122,6 +1307,14 @@ class TpuSpfSolver(SpfSolver):
         self._solves: Dict[Tuple[str, str], Tuple[int, _AreaSolve]] = {}
         self.device_solves = 0  # counter: batched device calls
         self.warm_start = warm_start
+        # resident APSP matrix knobs (docs/Apsp.md): areas up to this many
+        # real nodes keep a blocked-FW all-pairs matrix on device; 0 = off
+        self.apsp_max_nodes = apsp_max_nodes
+        self.apsp_audit_interval = apsp_audit_interval
+        # set by SolverSupervisor.attach_supervisor: APSP closes dispatch
+        # through its fault domain (classified errors feed the shared
+        # breaker, numpy FW serves as the degraded path)
+        self._supervisor = None
         # resolved EAGERLY: a solver_mesh that doesn't fit the device set
         # must fail at daemon startup with a clear error, not inside the
         # first debounced rebuild callback mid-convergence
@@ -1130,6 +1323,23 @@ class TpuSpfSolver(SpfSolver):
 
             mesh = resolve_mesh(mesh)
         self.mesh = mesh
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Wire the solver fault domain into non-solve device workloads
+        owned by this backend (the APSP closes). Called by
+        SolverSupervisor.__init__."""
+        self._supervisor = supervisor
+
+    def _apsp_dispatch(self, op: str, primary_fn, fallback_fn):
+        """ApspState dispatch hook: supervised when a supervisor is
+        attached (classified faults feed the shared breaker), bare
+        try/except with the numpy fallback otherwise."""
+        if self._supervisor is not None:
+            return self._supervisor.supervised_call(op, primary_fn, fallback_fn)
+        try:
+            return primary_fn(), False
+        except Exception:
+            return fallback_fn(), True
 
     def _area_solve(
         self, link_state: LinkState, node: str
@@ -1151,7 +1361,13 @@ class TpuSpfSolver(SpfSolver):
             self._sync_spf_counters(solve, inc0, full0)
             return solve
         solve = _AreaSolve(
-            link_state, node, mesh=self.mesh, warm_start=self.warm_start
+            link_state,
+            node,
+            mesh=self.mesh,
+            warm_start=self.warm_start,
+            apsp_max_nodes=self.apsp_max_nodes,
+            apsp_audit_interval=self.apsp_audit_interval,
+            apsp_dispatch=self._apsp_dispatch,
         )
         self.device_solves += solve.device_solves
         self._sync_spf_counters(solve, 0, 0)
@@ -1227,11 +1443,60 @@ class TpuSpfSolver(SpfSolver):
             self._observe(
                 "decision.spf.delta_extract_ms", solve.delta_extract_ms_last
             )
+        self._sync_apsp_counters(solve)
+        from openr_tpu.apsp import apsp_compile_cache_stats
         from openr_tpu.ops.spf import compile_cache_stats
 
         stats = compile_cache_stats()
-        counters["decision.spf.compile_cache_hits"] = stats["hits"]
-        counters["decision.spf.compile_cache_misses"] = stats["misses"]
+        fw_stats = apsp_compile_cache_stats()
+        counters["decision.spf.compile_cache_hits"] = (
+            stats["hits"] + fw_stats["hits"]
+        )
+        counters["decision.spf.compile_cache_misses"] = (
+            stats["misses"] + fw_stats["misses"]
+        )
+
+    def _sync_apsp_counters(self, solve: _AreaSolve) -> None:
+        """Fold the solve's APSP + KSP-warm stats into the decision.spf.*
+        registry (docs/Apsp.md counter rows): close counts split
+        warm/cold/fallback, staleness invalidations, shadow-audit runs,
+        the re-close round gauge, transfer bytes, and the close-latency
+        histogram — same monotonic-delta discipline as the batch stats."""
+        counters = self._ensure_counters()
+        d_ksp = solve.ksp_warm_batches - solve._ksp_warm_synced
+        if d_ksp:
+            solve._ksp_warm_synced = solve.ksp_warm_batches
+            self._bump("decision.spf.ksp_warm_batches", d_ksp)
+        apsp = solve.apsp
+        if apsp is None:
+            return
+        d_closes = apsp.closes - apsp._closes_synced
+        if d_closes:
+            apsp._closes_synced = apsp.closes
+            self._bump("decision.spf.apsp_closes", d_closes)
+            if apsp.close_ms_last is not None:
+                self._observe(
+                    "decision.spf.apsp_close_ms", apsp.close_ms_last
+                )
+        for attr, name in (
+            ("warm_closes", "decision.spf.apsp_warm_closes"),
+            ("cold_closes", "decision.spf.apsp_cold_closes"),
+            ("fallback_closes", "decision.spf.apsp_fallback_closes"),
+            ("invalidations", "decision.spf.apsp_invalidations"),
+            ("audit_runs", "decision.spf.apsp_audit_runs"),
+            ("audit_mismatches", "decision.spf.apsp_audit_mismatches"),
+            ("h2d_bytes", "decision.spf.apsp_h2d_bytes"),
+            ("d2h_bytes", "decision.spf.apsp_d2h_bytes"),
+        ):
+            value = getattr(apsp, attr)
+            synced = apsp._sync_marks.get(attr, 0)
+            if value > synced:
+                apsp._sync_marks[attr] = value
+                self._bump(name, value - synced)
+        if apsp.reclose_rounds_last is not None:
+            counters["decision.spf.apsp_reclose_rounds_last"] = (
+                apsp.reclose_rounds_last
+            )
 
     # -- DeltaPath (device-side route-delta extraction) ------------------
 
@@ -1246,7 +1511,15 @@ class TpuSpfSolver(SpfSolver):
         rebuild the full route db, which re-arms delta accumulation.
 
         Areas where this node is absent contribute no routes (the pipeline
-        sees an empty SPF there) and are skipped."""
+        sees an empty SPF there) and are skipped.
+
+        Under `compute_lfa_paths` one extra column is load-bearing: the
+        RFC 5286 inequality dist(neighbor, dst) < shortest + dist(neighbor,
+        me) reads the ME column from every alt-neighbor row, so a delta
+        whose changed set contains me would leave every OTHER prefix's LFA
+        threshold stale — that event class is answered with None (full
+        rebuild). Every other LFA input is a changed-announcer column the
+        delta already names (docs/Apsp.md "DeltaPath under LFA")."""
         me = self.my_node_name
         changed: Set[str] = set()
         ok = True
@@ -1260,7 +1533,41 @@ class TpuSpfSolver(SpfSolver):
                 continue
             names = solve.graph.names
             changed.update(names[c] for c in cols if c < len(names))
+        if ok and self.compute_lfa_paths and me in changed:
+            return None
         return changed if ok else None
+
+    def lfa_delta_ready(self) -> bool:
+        """DeltaPath-under-LFA capability gate (solver/delta.py): True when
+        every resident area solve carries an APSP-capable state — the
+        LFA-era delta build leans on the me-column poison test in
+        poll_device_delta plus alt-neighbor rows served from the resident
+        matrices; areas past the node cap fall back to the pre-APSP
+        force-full behavior."""
+        if self.apsp_max_nodes <= 0 or not self._solves:
+            return False
+        return all(
+            solve.apsp is not None and solve.apsp.enabled_for(solve.graph)
+            for _, solve in self._solves.values()
+        )
+
+    def borrow_apsp(self, area: str, version: int) -> Optional[np.ndarray]:
+        """TE hard-scoring borrow (te/service.py): the exact [n, n]
+        distance matrix for this area's CURRENT weights, or None when no
+        fresh matrix can serve — wrong snapshot version, APSP off or the
+        area over the node cap, or drained nodes present (TE excludes
+        drained transit by pinning out-edges, which diverges from the
+        per-source transit masks a drained topology closes under)."""
+        cached = self._solves.get((area, self.my_node_name))
+        if cached is None:
+            return None
+        solve = cached[1]
+        g = solve.graph
+        if g.version != version or np.any(g.overloaded[: g.n]):
+            return None
+        if not solve.ensure_apsp():
+            return None
+        return solve.apsp.d[: g.n, : g.n]
 
     # -- fault domain (SolverSupervisor seams) ---------------------------
 
@@ -1336,8 +1643,18 @@ class TpuSpfSolver(SpfSolver):
         solve = self._area_solve(link_state, self.my_node_name)
         if solve is not None and node in solve.row_map:
             return _TpuSpfResult(solve, node)
-        # node outside the solved batch (not me / my neighbor), or an area
-        # this node does not participate in: CPU oracle fallback
+        # source outside the solved batch (not me / my neighbor): the
+        # resident all-pairs matrix serves its whole row — LFA-style
+        # qualification from an arbitrary perspective reads alt-neighbor
+        # rows from ApspState instead of a per-source Dijkstra column
+        # solve (docs/Apsp.md)
+        if (
+            solve is not None
+            and node in solve.graph.node_index
+            and solve.ensure_apsp()
+        ):
+            return _ApspSpfResult(solve, node)
+        # area this node does not participate in: CPU oracle fallback
         return link_state.get_spf_result(node)
 
     def _dist(self, link_state: LinkState, a: str, b: str) -> Optional[Metric]:
@@ -1349,6 +1666,15 @@ class TpuSpfSolver(SpfSolver):
             col = solve.graph.node_index.get(b)
             if row is not None and col is not None:
                 metric = int(solve.d[row, col])
+                return metric if metric < INF else None
+            if (
+                col is not None
+                and a in solve.graph.node_index
+                and solve.ensure_apsp()
+            ):
+                metric = int(
+                    solve.apsp.d[solve.graph.node_index[a], col]
+                )
                 return metric if metric < INF else None
         return link_state.get_metric_from_a_to_b(a, b)
 

@@ -206,13 +206,19 @@ def hard_distances(w, src_e, dst_e, up, n) -> np.ndarray:
     return d
 
 
-def hard_utilization(w, demands, caps, src_e, dst_e, up, n) -> np.ndarray:
+def hard_utilization(w, demands, caps, src_e, dst_e, up, n, d=None) -> np.ndarray:
     """Per-link utilization [E] under exact SPF + fractional ECMP.
 
     At every node, traffic toward t splits equally over the out-edges on
     the shortest-path DAG (the triangle condition of ops/spf.py:_ecmp_dag),
-    the idealized ECMP model TE optimizes for."""
-    d = hard_distances(w, src_e, dst_e, up, n)
+    the idealized ECMP model TE optimizes for. Pass `d` to skip the BF
+    re-derivation with a precomputed exact distance matrix for `w` — the
+    solver's resident APSP matrix serves the live-weight scoring
+    (docs/Apsp.md TE consumer)."""
+    if d is None:
+        d = hard_distances(w, src_e, dst_e, up, n)
+    else:
+        d = d.astype(np.int64)
     big = np.int64(INF)
     we = np.where(up, w.astype(np.int64), big)
     node_t = np.arange(n)
@@ -239,7 +245,7 @@ def hard_utilization(w, demands, caps, src_e, dst_e, up, n) -> np.ndarray:
     return flow.sum(axis=1) / np.maximum(caps, 1e-9)
 
 
-def hard_max_util(w, demands, caps, src_e, dst_e, up, n) -> float:
+def hard_max_util(w, demands, caps, src_e, dst_e, up, n, d=None) -> float:
     """Max link utilization of one demand matrix under hard SPF routing."""
-    util = hard_utilization(w, demands, caps, src_e, dst_e, up, n)
+    util = hard_utilization(w, demands, caps, src_e, dst_e, up, n, d=d)
     return float(util.max()) if len(util) else 0.0

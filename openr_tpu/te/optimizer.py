@@ -178,13 +178,17 @@ def optimize_weights(
     n: int,
     config: Optional[TeOptConfig] = None,
     mesh=None,
+    initial_d: Optional[np.ndarray] = None,
 ) -> TeOptResult:
     """Run the annealed GD loop and hard-score the rounded iterates.
 
     The winner is the rounded integer weight vector minimizing the WORST
     scenario's hard max link utilization; the initial weights are scored
     too, so a run that finds nothing better reports itself unimproved
-    instead of proposing noise."""
+    instead of proposing noise. `initial_d`, when given, is an exact
+    distance matrix for the INITIAL integer weights (the solver's resident
+    APSP matrix, docs/Apsp.md): the w0 score reuses it instead of
+    re-deriving [N, N] distances by Bellman-Ford."""
     cfg = config or TeOptConfig()
     rounds = cfg.rounds if cfg.rounds is not None else int(n)
     rounds = max(2, min(int(rounds), 128))
@@ -222,15 +226,15 @@ def optimize_weights(
     losses = np.asarray(losses)
     d2h_bytes = int(w_hist.nbytes + losses.nbytes)
 
-    def worst_hard(w_int: np.ndarray) -> float:
+    def worst_hard(w_int: np.ndarray, d=None) -> float:
         return max(
-            hard_max_util(w_int, demands[k], caps, src_e, dst_e, up, n)
+            hard_max_util(w_int, demands[k], caps, src_e, dst_e, up, n, d=d)
             for k in range(b)
         )
 
     w0_int = np.clip(np.rint(w0), cfg.w_min, cfg.w_max).astype(np.int64)
     best_w, best_step = w0_int, -1
-    best_util = initial_util = worst_hard(w0_int)
+    best_util = initial_util = worst_hard(w0_int, d=initial_d)
     seen = {w0_int.tobytes()}
     for i in range(w_hist.shape[0]):
         w_int = np.clip(np.rint(w_hist[i]), cfg.w_min, cfg.w_max).astype(

@@ -114,6 +114,9 @@ class TeService(CountersMixin, HistogramsMixin):
             w_max=float(params.get("w_max", TeOptConfig.w_max)),
             rounds=params.get("rounds"),
         )
+        initial_d = self._borrow_initial_distances(
+            area, link_state, graph, w0, up, cfg
+        )
 
         def primary():
             # named fault seam: the supervisor's TE fault-injection tests
@@ -121,13 +124,14 @@ class TeService(CountersMixin, HistogramsMixin):
             fault_point("te.optimize", self)
             return optimize_weights(
                 src_e, dst_e, up, w0, demands, caps, graph.n,
-                config=cfg, mesh=self.mesh,
+                config=cfg, mesh=self.mesh, initial_d=initial_d,
             )
 
         def fallback():
             self._bump("decision.te.fallback_runs")
             return self._cpu_optimize(
-                src_e, dst_e, up, w0, demands, caps, graph.n, cfg
+                src_e, dst_e, up, w0, demands, caps, graph.n, cfg,
+                initial_d=initial_d,
             )
 
         supervised = getattr(self.solver, "supervised_call", None)
@@ -153,7 +157,7 @@ class TeService(CountersMixin, HistogramsMixin):
         solve_ms = (time.perf_counter() - t0) * 1e3
         return self._build_report(
             area, graph, src_e, dst_e, up, demands, caps, result,
-            scenarios, degraded, improved, solve_ms,
+            scenarios, degraded, improved, solve_ms, initial_d=initial_d,
         )
 
     # ------------------------------------------------------------------
@@ -169,8 +173,31 @@ class TeService(CountersMixin, HistogramsMixin):
                 return name, link_state
         raise ValueError("no area holds any links")
 
+    def _borrow_initial_distances(
+        self, area, link_state, graph, w0, up, cfg
+    ):
+        """Borrow the solver's resident APSP matrix for the live weights
+        (docs/Apsp.md TE consumer): the exact [n, n] distances the initial
+        hard-scoring would otherwise re-derive by Bellman-Ford. Only valid
+        when the scored integer weights are EXACTLY the live graph weights
+        (the [w_min, w_max] projection can clip extreme metrics) and the
+        solver holds a fresh matrix for this snapshot — anything else
+        returns None and the optimizer derives distances itself."""
+        borrow = getattr(self.solver, "borrow_apsp", None)
+        if borrow is None:
+            return None
+        w0_int = np.clip(np.rint(w0), cfg.w_min, cfg.w_max).astype(np.int64)
+        live = graph.w[: graph.e].astype(np.int64)
+        if not np.array_equal(w0_int[up], live[up]):
+            return None
+        d = borrow(area, link_state.version)
+        if d is None or d.shape[0] < graph.n:
+            return None
+        self._bump("decision.te.apsp_borrows")
+        return np.asarray(d[: graph.n, : graph.n])
+
     def _cpu_optimize(
-        self, src_e, dst_e, up, w0, demands, caps, n, cfg
+        self, src_e, dst_e, up, w0, demands, caps, n, cfg, initial_d=None
     ):
         """The identical optimization pinned to the CPU backend (the
         degraded path). Falls back to the default device set when the
@@ -183,11 +210,13 @@ class TeService(CountersMixin, HistogramsMixin):
             cpu = None
         if cpu is None:
             return optimize_weights(
-                src_e, dst_e, up, w0, demands, caps, n, config=cfg
+                src_e, dst_e, up, w0, demands, caps, n, config=cfg,
+                initial_d=initial_d,
             )
         with jax.default_device(cpu):
             return optimize_weights(
-                src_e, dst_e, up, w0, demands, caps, n, config=cfg
+                src_e, dst_e, up, w0, demands, caps, n, config=cfg,
+                initial_d=initial_d,
             )
 
     def _build_report(
@@ -204,16 +233,18 @@ class TeService(CountersMixin, HistogramsMixin):
         degraded,
         improved,
         solve_ms,
+        initial_d=None,
     ) -> Dict:
         names = graph.names
 
-        def top_links(w_int) -> List[Dict]:
+        def top_links(w_int, d=None) -> List[Dict]:
             worst = np.zeros(len(src_e))
             for k in range(demands.shape[0]):
                 worst = np.maximum(
                     worst,
                     hard_utilization(
-                        w_int, demands[k], caps, src_e, dst_e, up, graph.n
+                        w_int, demands[k], caps, src_e, dst_e, up, graph.n,
+                        d=d,
                     ),
                 )
             order = np.argsort(-worst)[:_TOP_LINKS]
@@ -266,9 +297,10 @@ class TeService(CountersMixin, HistogramsMixin):
             ),
             "weight_changes": changes if improved else [],
             "top_links": {
-                "initial": top_links(w0_int),
+                "initial": top_links(w0_int, d=initial_d),
                 "optimized": top_links(
-                    result.w_best if improved else w0_int
+                    result.w_best if improved else w0_int,
+                    d=None if improved else initial_d,
                 ),
             },
             "loss_first": round(float(result.losses[0]), 6)

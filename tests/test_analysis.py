@@ -1576,3 +1576,32 @@ def test_analysis_cost_rides_ctrl_get_build_info():
     assert "build_analysis_wall_ms" in info
     assert "build_analysis_rule_stats" in info
     assert info["build_analysis_version"] == ANALYSIS_VERSION
+
+
+def test_trace_safety_reaches_fw_apsp_kernels():
+    """Regression (ISSUE 12): the blocked Floyd–Warshall APSP kernels —
+    diagonal block close, panel/outer sweep stages, the warm seed and the
+    dirty-block re-close round — must sit inside the rule's traced set
+    (they are `jax.jit(fn)` factory seeds inside lru_cache factories),
+    while the numpy Floyd–Warshall fallback/oracle stays OUT (its np.*
+    calls would otherwise be host-sync findings)."""
+    import ast
+
+    from openr_tpu.analysis.trace_safety import _traced_functions
+
+    tree = ast.parse((PKG / "apsp" / "kernels.py").read_text())
+    traced, direct = _traced_functions(tree)
+    direct_names = {fn.name for fn in direct}
+    traced_names = {fn.name for fn in traced}
+    # jit roots: the cold close, the warm seed, the re-close round
+    assert {"close", "seed", "reclose"} <= direct_names
+    # transitively traced helpers: the (min,+) tile product, the block
+    # reshapes, the per-stage sweep bodies
+    assert {"_mp", "_to_blocks", "_from_blocks", "stage"} <= traced_names
+    # the numpy fallback/oracle and the host-side matrix builders are
+    # never traced
+    assert not {
+        "np_floyd_warshall",
+        "build_weight_matrix",
+        "build_allow_matrix",
+    } & traced_names

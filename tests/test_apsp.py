@@ -1,0 +1,457 @@
+"""Blocked min-plus Floyd–Warshall APSP differential suite (docs/Apsp.md).
+
+The resident all-pairs matrix must match the CPU Dijkstra oracle EXACTLY —
+cold closes and warm re-closes alike — across randomized event sequences
+on grid / Clos / random-chord WAN topologies, including partition/heal
+(link flaps to INF and back), overload toggles, and INF-sentinel edge
+cases; the staleness guard, numpy-FW fault fallback, shadow audit, KSP
+warm layer seeding and the TE matrix borrow ride the same fixtures.
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.apsp import (
+    ApspState,
+    build_allow_matrix,
+    build_weight_matrix,
+    np_floyd_warshall,
+)
+from openr_tpu.apsp.kernels import _fw_solver, fw_block_shape
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.ops.graph import INF, compile_graph, refresh_graph
+from openr_tpu.solver import SpfSolver, SolverSupervisor, SupervisorConfig, TpuSpfSolver
+from openr_tpu.solver.supervisor import OPEN
+from openr_tpu.testing.faults import FaultInjected, injected
+from openr_tpu.topology import build_adj_dbs, fabric_edges, grid_edges, wan_edges
+from openr_tpu.types import (
+    IpPrefix,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def build_ls(edges, area="0"):
+    dbs = build_adj_dbs(edges, area=area)
+    ls = LinkState(area)
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    return dbs, ls
+
+
+def oracle_apsp(ls: LinkState, graph) -> np.ndarray:
+    """CPU Dijkstra oracle: per-source LinkState SPF metrics arranged in
+    the compiled graph's node numbering, INF-padded."""
+    n = graph.n_pad
+    d = np.full((n, n), INF, dtype=np.int32)
+    np.fill_diagonal(d, 0)
+    for src, i in graph.node_index.items():
+        res = ls.get_spf_result(src)
+        for dst, node in res.items():
+            j = graph.node_index.get(dst)
+            if j is not None:
+                d[i, j] = node.metric
+    return d
+
+
+def set_metric(dbs, ls, a, b, metric):
+    dbs[a] = dataclasses.replace(
+        dbs[a],
+        adjacencies=[
+            dataclasses.replace(adj, metric=metric)
+            if adj.other_node_name == b
+            else adj
+            for adj in dbs[a].adjacencies
+        ],
+    )
+    ls.update_adjacency_database(dbs[a])
+
+
+def set_adj_overload(dbs, ls, a, b, overloaded):
+    dbs[a] = dataclasses.replace(
+        dbs[a],
+        adjacencies=[
+            dataclasses.replace(adj, is_overloaded=overloaded)
+            if adj.other_node_name == b
+            else adj
+            for adj in dbs[a].adjacencies
+        ],
+    )
+    ls.update_adjacency_database(dbs[a])
+
+
+def set_node_overload(dbs, ls, node, overloaded):
+    dbs[node] = dataclasses.replace(dbs[node], is_overloaded=overloaded)
+    ls.update_adjacency_database(dbs[node])
+
+
+TOPOLOGIES = [
+    ("grid", lambda: grid_edges(4)),
+    (
+        "clos",
+        lambda: fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        ),
+    ),
+    ("wan", lambda: wan_edges(24, degree=3, seed=11)),
+]
+
+
+class TestApspDifferential:
+    """Cold + warm re-close vs the CPU Dijkstra oracle."""
+
+    @pytest.mark.parametrize("name,mk", TOPOLOGIES, ids=[t[0] for t in TOPOLOGIES])
+    def test_randomized_event_sequences(self, name, mk):
+        dbs, ls = build_ls(mk())
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=4096)
+        assert apsp.ensure(graph)
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+        assert apsp.cold_closes == 1
+
+        rng = random.Random(hash(name) & 0xFFFF)
+        links = [
+            (link.n1, link.n2) for link in sorted(ls.all_links)
+        ]
+        warm_seen = 0
+        for _ in range(12):
+            a, b = links[rng.randrange(len(links))]
+            kind = rng.choice(("metric", "flap"))
+            if kind == "metric":
+                set_metric(dbs, ls, a, b, rng.randint(1, 9))
+            else:
+                # adjacency overload = the link drops to INF (partition
+                # when it is a cut edge) and later heals
+                up = any(
+                    adj.other_node_name == b and not adj.is_overloaded
+                    for adj in dbs[a].adjacencies
+                )
+                set_adj_overload(dbs, ls, a, b, up)
+            graph = refresh_graph(graph, ls)
+            assert apsp.ensure(graph)
+            assert np.array_equal(apsp.d, oracle_apsp(ls, graph)), (
+                name,
+                kind,
+                (a, b),
+            )
+            warm_seen = max(warm_seen, apsp.warm_closes)
+        # the sequences are weight-only events: the warm path must have
+        # actually served (a suite that silently cold-closes every event
+        # would still pass the parity checks)
+        assert warm_seen > 0
+
+    def test_partition_and_heal(self):
+        # line topology: dropping a middle link partitions the graph
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+        dbs, ls = build_ls(edges)
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64)
+        apsp.ensure(graph)
+        set_adj_overload(dbs, ls, "b", "c", True)
+        graph = refresh_graph(graph, ls)
+        apsp.ensure(graph)
+        d = apsp.d
+        idx = graph.node_index
+        assert d[idx["a"], idx["d"]] >= INF  # partitioned: sentinel holds
+        assert np.array_equal(d, oracle_apsp(ls, graph))
+        set_adj_overload(dbs, ls, "b", "c", False)
+        graph = refresh_graph(graph, ls)
+        apsp.ensure(graph)
+        assert apsp.d[idx["a"], idx["d"]] == 3  # healed
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+
+    def test_node_overload_toggle_recloses_and_matches(self):
+        dbs, ls = build_ls(grid_edges(3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64)
+        apsp.ensure(graph)
+        cold0 = apsp.cold_closes
+        set_node_overload(dbs, ls, "g1_1", True)
+        graph = refresh_graph(graph, ls)
+        apsp.ensure(graph)
+        # a transit-mask change re-masks every pair: must close cold
+        assert apsp.cold_closes == cold0 + 1
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+        set_node_overload(dbs, ls, "g1_1", False)
+        graph = refresh_graph(graph, ls)
+        apsp.ensure(graph)
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+
+    def test_inf_sentinel_never_wraps(self):
+        # two components: every cross-pair must sit exactly at INF after
+        # the blocked close (a wrapped sentinel would show as negative or
+        # a huge-but-finite value)
+        edges = [("a", "b", 1), ("c", "d", 1)]
+        _, ls = build_ls(edges)
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64)
+        apsp.ensure(graph)
+        d = apsp.d
+        idx = graph.node_index
+        assert d[idx["a"], idx["c"]] == INF
+        assert d[idx["c"], idx["b"]] == INF
+        assert d.min() >= 0
+        assert d.max() == INF
+
+    def test_kernel_matches_numpy_fw_on_random_matrices(self):
+        # kernel-level differential, independent of LinkState: random
+        # direct-edge matrices with INF holes and overloaded nodes
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            n_pad = int(rng.choice([8, 16, 32]))
+            w = np.full((n_pad, n_pad), INF, dtype=np.int32)
+            mask = rng.random((n_pad, n_pad)) < 0.3
+            w[mask] = rng.integers(1, 50, size=int(mask.sum()))
+            np.fill_diagonal(w, 0)
+            ov = rng.random(n_pad) < 0.2
+            import jax.numpy as jnp
+
+            nb, bsz = fw_block_shape(n_pad)
+            d, _ = _fw_solver((nb, bsz))(
+                jnp.asarray(w), jnp.asarray(build_allow_matrix(ov))
+            )
+            assert np.array_equal(np.array(d), np_floyd_warshall(w, ov))
+
+
+class TestStalenessGuard:
+    """Any event that poisons the warm solve also invalidates the matrix."""
+
+    def _solver_and_state(self, edges, me):
+        dbs, ls = build_ls(edges)
+        solver = TpuSpfSolver(me, apsp_max_nodes=4096)
+        ps = PrefixState()
+        solver.build_route_db(me, {"0": ls}, ps)
+        solve = solver._solves[("0", me)][1]
+        solve.ensure_apsp()
+        return dbs, ls, ps, solver, solve
+
+    def test_batch_cold_solve_invalidates(self):
+        dbs, ls, ps, solver, solve = self._solver_and_state(
+            grid_edges(3), "g0_0"
+        )
+        assert solve.apsp.resident()
+        # an adjacency flap incident to me changes the source batch rows
+        # and forces the batch solve cold — the guard must drop the matrix
+        set_adj_overload(dbs, ls, "g0_0", "g0_1", True)
+        solver.build_route_db("g0_0", {"0": ls}, ps)
+        solve = solver._solves[("0", "g0_0")][1]
+        assert solve.apsp.invalidations >= 1
+        assert not solve.apsp.resident() or solve.apsp.stale_reason is None
+        # ... and the next ensure() serves a correct matrix again
+        assert solve.ensure_apsp()
+        graph = solve.graph
+        assert np.array_equal(solve.apsp.d, oracle_apsp(ls, graph))
+
+    def test_patch_overflow_forces_cold_close(self):
+        dbs, ls = build_ls(wan_edges(40, degree=4, seed=3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=4096)
+        apsp.ensure(graph)
+        # bulk event: raise more pair minima than the warm patch budget
+        # (every directed pair increases, well past _APSP_PATCH_SLOTS)
+        rng = random.Random(5)
+        for link in sorted(ls.all_links):
+            set_metric(dbs, ls, link.n1, link.n2, rng.randint(200, 260))
+            set_metric(dbs, ls, link.n2, link.n1, rng.randint(200, 260))
+        graph = refresh_graph(graph, ls)
+        cold0 = apsp.cold_closes
+        apsp.ensure(graph)
+        assert apsp.cold_closes == cold0 + 1  # overflow -> cold, not warm
+        assert apsp.invalidations >= 1
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+
+    def test_graph_too_large_disables(self):
+        _, ls = build_ls(grid_edges(3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=4)  # 9-node grid exceeds the cap
+        assert not apsp.ensure(graph)
+        assert not apsp.resident()
+
+
+class TestFaultDomain:
+    """Device-close faults degrade to numpy FW and feed the breaker."""
+
+    def test_injected_fault_falls_back_to_numpy(self):
+        _, ls = build_ls(grid_edges(3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64)
+        with injected() as inj:
+            inj.arm("solver.apsp.close", times=1)
+            assert apsp.ensure(graph)
+        assert apsp.backend == "numpy"
+        assert apsp.fallback_closes == 1
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+        # next event: device path recovers (numpy-resident closes cold)
+        apsp.invalidate("test")
+        assert apsp.ensure(graph)
+        assert apsp.backend == "device"
+
+    def test_supervised_close_faults_feed_the_breaker(self):
+        dbs, ls = build_ls(grid_edges(3))
+        primary = TpuSpfSolver("g0_0", apsp_max_nodes=64)
+        sup = SolverSupervisor(
+            primary,
+            SpfSolver("g0_0"),
+            SupervisorConfig(failure_threshold=2, max_attempts=1),
+        )
+        ps = PrefixState()
+        sup.build_route_db("g0_0", {"0": ls}, ps)
+        solve = primary._solves[("0", "g0_0")][1]
+        with injected() as inj:
+            inj.arm("solver.apsp.close", times=3, exc=FaultInjected)
+            assert solve.ensure_apsp()  # degraded to numpy, no raise
+            assert solve.apsp.backend == "numpy"
+            assert sup.consecutive_failures >= 1
+            # a second faulted close reaches the threshold: breaker opens
+            solve.apsp.invalidate("test")
+            solve.ensure_apsp()
+        assert sup.state == OPEN
+        assert sup.counters["decision.spf.solver_failures"] >= 2
+
+    def test_shadow_audit_detects_and_heals_corruption(self):
+        dbs, ls = build_ls(grid_edges(3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64, audit_interval=1)
+        apsp.ensure(graph)
+        assert apsp.audit_runs == 1 and apsp.audit_mismatches == 0
+        # corrupt the resident matrix behind the state's back, then push a
+        # real weight event through: the warm re-close seeds from the
+        # corrupted matrix, and the every-Nth audit must catch the
+        # divergence and self-heal with a cold close in the same ensure
+        import jax.numpy as jnp
+
+        apsp._d_dev = jnp.asarray(apsp.d + 1)
+        apsp._d_host = None
+        set_metric(dbs, ls, "g2_2", "g2_1", 7)
+        graph = refresh_graph(graph, ls)
+        apsp.ensure(graph)
+        assert apsp.audit_mismatches >= 1
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+
+    def test_audit_mismatch_counter_and_selfheal(self):
+        _, ls = build_ls(grid_edges(3))
+        graph = compile_graph(ls)
+        apsp = ApspState(max_nodes=64, audit_interval=1)
+        apsp.ensure(graph)
+        import jax.numpy as jnp
+
+        corrupted = apsp.d.copy()
+        corrupted[0, -1] = 5  # fabricate a distance
+        apsp._d_dev = jnp.asarray(corrupted)
+        apsp._d_host = None
+        apsp._maybe_audit(graph)
+        assert apsp.audit_mismatches == 1
+        assert np.array_equal(apsp.d, oracle_apsp(ls, graph))
+
+
+class TestConsumers:
+    """LFA/_spf views, KSP warm seeding, TE borrow."""
+
+    def test_arbitrary_source_spf_view_matches_oracle(self):
+        dbs, ls = build_ls(wan_edges(18, degree=3, seed=9))
+        me = sorted(dbs)[0]
+        solver = TpuSpfSolver(me, apsp_max_nodes=4096)
+        solver.build_route_db(me, {"0": ls}, PrefixState())
+        for src in sorted(dbs)[1:]:
+            view = solver._spf(ls, src)
+            ref = ls.get_spf_result(src)
+            for dest in sorted(dbs):
+                assert (dest in view) == (dest in ref), (src, dest)
+                if dest in ref:
+                    assert view[dest].metric == ref[dest].metric
+                    assert view[dest].next_hops == ref[dest].next_hops
+
+    def test_arbitrary_pair_dist_matches_oracle(self):
+        dbs, ls = build_ls(grid_edges(4))
+        solver = TpuSpfSolver("g0_0", apsp_max_nodes=4096)
+        solver.build_route_db("g0_0", {"0": ls}, PrefixState())
+        rng = random.Random(2)
+        nodes = sorted(dbs)
+        for _ in range(20):
+            a, b = rng.choice(nodes), rng.choice(nodes)
+            assert solver._dist(ls, a, b) == ls.get_metric_from_a_to_b(a, b)
+
+    def _ksp_route_db(self, warm_start):
+        dbs, ls = build_ls(grid_edges(4))
+        ps = PrefixState()
+        ps.update_prefix_database(
+            PrefixDatabase(
+                "g3_3",
+                [
+                    PrefixEntry(
+                        IpPrefix("10.9.0.0/16"),
+                        forwarding_type=PrefixForwardingType.SR_MPLS,
+                        forwarding_algorithm=(
+                            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                        ),
+                    )
+                ],
+                area="0",
+            )
+        )
+        solver = TpuSpfSolver("g0_0", warm_start=warm_start)
+        db = solver.build_route_db("g0_0", {"0": ls}, ps)
+        solve = solver._solves[("0", "g0_0")][1]
+        return db, solve, ls, ps
+
+    def test_ksp_warm_seeding_matches_cold_and_oracle(self):
+        warm_db, warm_solve, ls, ps = self._ksp_route_db(True)
+        cold_db, cold_solve, _, _ = self._ksp_route_db(False)
+        assert warm_solve.ksp_warm_batches > 0
+        assert cold_solve.ksp_warm_batches == 0
+        oracle = SpfSolver("g0_0").build_route_db("g0_0", {"0": ls}, ps)
+        for db in (cold_db, oracle):
+            assert set(warm_db.unicast_entries) == set(db.unicast_entries)
+            for prefix, entry in warm_db.unicast_entries.items():
+                assert db.unicast_entries[prefix] == entry, prefix
+
+    def test_te_borrow_serves_exact_matrix(self):
+        from openr_tpu.te import TeService
+
+        dbs, ls = build_ls(grid_edges(3))
+        me = "g0_0"
+        solver = TpuSpfSolver(me, apsp_max_nodes=4096)
+        solver.build_route_db(me, {"0": ls}, PrefixState())
+        svc = TeService(me, {"0": ls}, solver=solver)
+        report = svc.optimize({"steps": 4, "scenarios": 1})
+        assert svc.counters.get("decision.te.apsp_borrows", 0) == 1
+        # identical run without a borrowing solver: same hard scores
+        svc_plain = TeService(me, {"0": ls})
+        ref = svc_plain.optimize({"steps": 4, "scenarios": 1})
+        assert report["initial_max_util"] == ref["initial_max_util"]
+        assert report["top_links"]["initial"] == ref["top_links"]["initial"]
+
+    def test_borrow_refuses_stale_or_drained(self):
+        dbs, ls = build_ls(grid_edges(3))
+        solver = TpuSpfSolver("g0_0", apsp_max_nodes=4096)
+        solver.build_route_db("g0_0", {"0": ls}, PrefixState())
+        assert solver.borrow_apsp("0", ls.version) is not None
+        assert solver.borrow_apsp("0", ls.version + 1) is None  # stale
+        assert solver.borrow_apsp("missing", ls.version) is None
+        set_node_overload(dbs, ls, "g1_1", True)
+        solver.build_route_db("g0_0", {"0": ls}, PrefixState())
+        assert solver.borrow_apsp("0", ls.version) is None  # drained
+
+    def test_apsp_counters_flow_through_sync(self):
+        dbs, ls = build_ls(grid_edges(3))
+        solver = TpuSpfSolver("g0_0", apsp_max_nodes=4096)
+        ps = PrefixState()
+        solver.build_route_db("g0_0", {"0": ls}, ps)
+        solve = solver._solves[("0", "g0_0")][1]
+        solve.ensure_apsp()
+        set_metric(dbs, ls, "g2_2", "g2_1", 5)
+        solver.build_route_db("g0_0", {"0": ls}, ps)
+        solve.ensure_apsp()
+        # a cross-pair read outside the batch fetches the mirror (d2h)
+        assert solver._dist(ls, "g2_2", "g0_1") is not None
+        # one more rebuild so the post-ensure deltas fold into counters
+        solver.build_route_db("g0_0", {"0": ls}, ps)
+        assert solver.counters.get("decision.spf.apsp_closes", 0) >= 2
+        assert solver.counters.get("decision.spf.apsp_cold_closes", 0) >= 1
+        assert "decision.spf.apsp_close_ms" in solver._ensure_histograms()
+        assert solver.counters.get("decision.spf.apsp_d2h_bytes", 0) > 0

@@ -119,8 +119,8 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 6, (
-        "bench.py must print SPF+convergence+TE+scale+exporter+stream "
+    assert len(out) >= 7, (
+        "bench.py must print SPF+convergence+TE+scale+exporter+stream+apsp "
         "JSON lines"
     )
     results = [json.loads(line) for line in out]
@@ -165,6 +165,18 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert stream["value"] > 0
     assert stream["e2e_p95_ms"] > 0
     assert stream["baseline_e2e_p95_ms"] > 0
+    # the blocked-FW APSP line (ISSUE 12 'seventh metric line'): cold
+    # close plus the warm re-close of a single-link event and the
+    # FW-vs-batched-Dijkstra crossover sweep; the warm path must report
+    # its restricted re-close rounds (the O(dirty-blocks) machinery ran)
+    apsp = results[6]
+    assert apsp["metric"] == "fw_apsp_close_ms"
+    assert apsp["warm_reclose_ms"] > 0
+    assert apsp["reclose_rounds"] >= 1
+    assert len(apsp["crossover"]) >= 2
+    for point in apsp["crossover"]:
+        assert point["fw_close_ms"] > 0
+        assert point["batched_dijkstra_ms"] > 0
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):

@@ -121,14 +121,15 @@ class DeltaHarness:
     """TpuSpfSolver + DeltaRouteBuilder over a mutable LSDB, checked
     against a cold full rebuild after every step."""
 
-    def __init__(self, edges, me, announcers, **entry_kw):
+    def __init__(self, edges, me, announcers, solver_kwargs=None, **entry_kw):
         self.me = me
+        self.solver_kwargs = dict(solver_kwargs or {})
         self.dbs = build_adj_dbs(edges)
         self.ls = LinkState("0")
         for db in self.dbs.values():
             self.ls.update_adjacency_database(db)
         self.ps = make_prefix_state(announcers, **entry_kw)
-        self.solver = TpuSpfSolver(me)
+        self.solver = TpuSpfSolver(me, **self.solver_kwargs)
         self.builder = DeltaRouteBuilder(self.solver)
         self.als = {"0": self.ls}
         self.db, _, used = self.builder.build(
@@ -150,9 +151,18 @@ class DeltaHarness:
             dirty_prefixes=dirty_prefixes,
             force_full=force_full,
         )
-        ref = TpuSpfSolver(self.me).build_route_db(self.me, self.als, self.ps)
+        ref = TpuSpfSolver(self.me, **self.solver_kwargs).build_route_db(
+            self.me, self.als, self.ps
+        )
         assert_route_db_equal(ref, new_db)
-        oracle = SpfSolver(self.me).build_route_db(self.me, self.als, self.ps)
+        cpu_kwargs = {
+            k: v
+            for k, v in self.solver_kwargs.items()
+            if not k.startswith("apsp")
+        }
+        oracle = SpfSolver(self.me, **cpu_kwargs).build_route_db(
+            self.me, self.als, self.ps
+        )
         assert_route_db_equal(oracle, new_db)
         folded = apply_route_delta(prev, update)
         assert_route_db_equal(new_db, folded)
@@ -713,3 +723,116 @@ class TestDecisionDeltaPath:
             loop.run_until_complete(asyncio.wait_for(body(), 30))
         finally:
             loop.close()
+
+
+class TestDeltaUnderLfa:
+    """DeltaPath with `compute_lfa_paths` on (the ISSUE 12 carry-over):
+    with an APSP-capable solver the builder no longer force-disables — the
+    RFC 5286 inequality's only input beyond the announcer columns is the
+    ME column, which the solver poisons via poll_device_delta; randomized
+    sequences must stay byte-identical to the full rebuild and the CPU
+    oracle on both paths."""
+
+    LFA_KW = {"compute_lfa_paths": True, "apsp_max_nodes": 4096}
+
+    def test_grid_random_sequences_with_lfa(self):
+        for seed in (5, 23, 41):
+            h = DeltaHarness(
+                grid_edges(4),
+                "g0_0",
+                {
+                    "g3_3": [PFXS[0]],
+                    "g0_3": [PFXS[1]],
+                    "g2_1": [PFXS[2]],
+                    "g1_2": [PFXS[3]],
+                },
+                solver_kwargs=self.LFA_KW,
+            )
+            rng = random.Random(seed)
+            links = list(grid_edges(4))
+            for _ in range(14):
+                before = h.ls.version
+                apply_weight_event(rng, h.dbs, h.ls, links)
+                if h.ls.version == before:
+                    continue
+                h.step()
+            # the delta path must have actually served under LFA — the
+            # historical behavior was an unconditional force-full
+            assert h.builder.delta_builds > 0, seed
+            assert h.builder.full_builds > 1, seed
+
+    def test_clos_random_sequence_with_lfa(self):
+        edges = fabric_edges(
+            pods=2, planes=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        h = DeltaHarness(
+            edges,
+            "rsw0_0",
+            {"rsw1_2": [PFXS[0]], "rsw0_2": [PFXS[1]]},
+            solver_kwargs=self.LFA_KW,
+        )
+        rng = random.Random(17)
+        links = list(edges)
+        for _ in range(10):
+            before = h.ls.version
+            apply_weight_event(rng, h.dbs, h.ls, links)
+            if h.ls.version == before:
+                continue
+            h.step()
+        assert h.builder.delta_builds > 0
+
+    def test_me_column_change_forces_full_under_lfa(self):
+        # dist(neighbor, me) feeds EVERY destination's LFA threshold: an
+        # event that moves the me column must refuse the delta even though
+        # it qualifies under the plain rules (not sourced at me)
+        h = DeltaHarness(
+            grid_edges(4),
+            "g0_0",
+            {"g3_3": [PFXS[0]]},
+            solver_kwargs=self.LFA_KW,
+        )
+        set_metric(h.dbs, h.ls, "g0_1", "g0_0", 9)  # far-side edge INTO me
+        assert h.step() is False  # full path, still byte-identical
+        # a remote event that leaves the me column alone rides the delta
+        set_metric(h.dbs, h.ls, "g3_2", "g3_3", 7)
+        assert h.step() is True
+
+    def test_lfa_without_apsp_keeps_force_full(self):
+        h = DeltaHarness(
+            grid_edges(4),
+            "g0_0",
+            {"g3_3": [PFXS[0]]},
+            solver_kwargs={"compute_lfa_paths": True},  # apsp off
+        )
+        set_metric(h.dbs, h.ls, "g3_2", "g3_3", 7)
+        assert h.step() is False
+        assert h.builder.delta_builds == 0
+
+    def test_delta_vs_full_parity_includes_lfa_nexthops(self):
+        # LFA widens nexthop sets beyond the shortest-path DAG; a stale
+        # threshold would show as a missing/excess alternate. Drive a
+        # sequence that flips an alternate in and out of qualification.
+        h = DeltaHarness(
+            [
+                ("a", "b", 1),
+                ("b", "d", 1),
+                ("a", "c", 2),
+                ("c", "d", 2),
+            ],
+            "a",
+            {"d": [PFXS[0]]},
+            solver_kwargs=self.LFA_KW,
+        )
+        entry = h.db.unicast_entries[IpPrefix(PFXS[0])]
+        assert len(entry.nexthops) == 2  # b on the SP, c as the LFA
+        set_metric(h.dbs, h.ls, "c", "d", 9)  # c no longer loop-free
+        h.step()
+        entry = h.db.unicast_entries[IpPrefix(PFXS[0])]
+        nh_nodes = {nh.neighbor_node for nh in entry.nexthops}
+        oracle = SpfSolver("a", compute_lfa_paths=True).build_route_db(
+            "a", h.als, h.ps
+        )
+        assert nh_nodes == {
+            nh.neighbor_node
+            for nh in oracle.unicast_entries[IpPrefix(PFXS[0])].nexthops
+        }
