@@ -57,6 +57,33 @@ def _native_rate(graph, samples: int) -> float:
     return rate
 
 
+def _spf_phase_split(solve, sources, nbrs, wg_event, ov) -> dict:
+    """One representative event measured with explicit barriers at the
+    h2d / relax / d2h seams — the bench-side mirror of the flight
+    recorder's sampled PhaseClock (docs/Monitoring.md "Flight recorder &
+    profiling"), so the first hardware round lands with per-phase
+    attribution on the SPF lines, not just one wall-clock number.
+    Degraded-aware by construction: the same code path serves
+    cpu-fallback rounds."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    wgs_dev = tuple(jnp.asarray(a) for a in wg_event)
+    for a in wgs_dev:
+        a.block_until_ready()
+    t1 = time.perf_counter()
+    d = solve(sources, nbrs, wgs_dev, ov)
+    d.block_until_ready()
+    t2 = time.perf_counter()
+    np.asarray(d[0])  # one distance row host-side (the O(changes) shape)
+    t3 = time.perf_counter()
+    return {
+        "h2d_ms": round((t1 - t0) * 1e3, 3),
+        "relax_ms": round((t2 - t1) * 1e3, 3),
+        "d2h_ms": round((t3 - t2) * 1e3, 3),
+    }
+
+
 def bench_wan() -> dict:
     import jax
     import jax.numpy as jnp
@@ -166,6 +193,9 @@ def bench_wan() -> dict:
         "unit": f"SPF/s ({graph.n}-node WAN LSDB, {n_sources}-source batches)",
         "vs_baseline": round(tpu_rate / cpu_rate, 1) if cpu_rate else 0.0,
         "baseline": baseline,
+        "phases": _spf_phase_split(
+            solve, sources, nbrs, wg_stacks[0], ov
+        ),
     }
 
 
@@ -279,6 +309,9 @@ def bench_grid() -> dict:
         "unit": f"SPF/s ({graph.n}-node grid, ECMP DAG fused)",
         "vs_baseline": round(tpu_rate / cpu_rate, 1),
         "baseline": baseline,
+        "phases": _spf_phase_split(
+            solve, sources, nbrs, wg_stacks[0], ov
+        ),
     }
 
 
@@ -557,6 +590,30 @@ def _bench_scale() -> dict:
         w2_old = w2_new
     warm_best = min(warm_ms)
 
+    # phase-split attribution of one more warm flap, with explicit
+    # barriers at the h2d / relax / d2h seams (the tiled layout's halo
+    # traffic rides inside relax — the rounds split it, like the flight
+    # recorder's sampled traces; docs/Monitoring.md)
+    w_new = graph.w.copy()
+    pos = up[rng.integers(len(up))]
+    w_new[pos] = (w_new[pos] + 7) % 100 + 1
+    t0 = time.perf_counter()
+    w2_new = jax.device_put(jnp.asarray(tiling.tile_weights(w_new)), gs)
+    w2_new.block_until_ready()
+    t1 = time.perf_counter()
+    d, r, ir, _, num = warm(
+        args[0], args[1], args[2], w2_new, w2_old, args[4], ov, ov, d
+    )
+    d.block_until_ready()
+    t2 = time.perf_counter()
+    np.asarray(d[0])  # one distance row host-side
+    t3 = time.perf_counter()
+    phases = {
+        "h2d_ms": round((t1 - t0) * 1e3, 3),
+        "relax_ms": round((t2 - t1) * 1e3, 3),
+        "d2h_ms": round((t3 - t2) * 1e3, 3),
+    }
+
     tile_bytes = (s_pad // b_ax) * (graph.n_pad // g_ax) * 4
     replica_bytes = s_pad * graph.n_pad * 4
     _note(
@@ -578,6 +635,7 @@ def _bench_scale() -> dict:
         "tile_bytes_per_device": tile_bytes,
         "replica_bytes_per_device": replica_bytes,
         "mesh": [mesh.shape["batch"], mesh.shape["graph"]],
+        "phases": phases,
     }
 
 

@@ -69,6 +69,15 @@ _FW_BLOCK = 128
 _APSP_PATCH_SLOTS = 64
 
 
+def _profile_span(name: str):
+    """Named `jax.profiler.TraceAnnotation` around an APSP dispatch seam
+    (same convention as ops/spf.py:profile_span): on-demand profiling
+    windows label the blocked-FW dispatches; no-op-cheap otherwise."""
+    from jax.profiler import TraceAnnotation
+
+    return TraceAnnotation(name)
+
+
 def fw_block_shape(n_pad: int) -> Tuple[int, int]:
     """(nb, bsz): block count and block edge for a padded node count."""
     bsz = min(_FW_BLOCK, n_pad)
@@ -149,7 +158,15 @@ def _fw_solver(key: Tuple):
         d = _from_blocks(d4, nb, bsz)
         return d, jnp.min(d)
 
-    return jax.jit(close)
+    fit = jax.jit(close)
+
+    def dispatch(w, allow):
+        # named profiling seam: on-demand jax.profiler windows
+        # (monitor/profiling.py) show the cold close under this label
+        with _profile_span(f"apsp.fw_close.{nb}x{bsz}"):
+            return fit(w, allow)
+
+    return dispatch
 
 
 @functools.lru_cache(maxsize=16)
@@ -196,7 +213,13 @@ def _fw_seed_solver(key: Tuple):
         dirty = jnp.any(dirty_rows.reshape(nb, bsz), axis=1)
         return d0, dirty, jnp.sum(dirty.astype(jnp.int32))
 
-    return jax.jit(seed)
+    fit = jax.jit(seed)
+
+    def dispatch(d_prev, w_new, inc_u, inc_v, inc_w):
+        with _profile_span(f"apsp.fw_seed.{nb}x{bsz}"):
+            return fit(d_prev, w_new, inc_u, inc_v, inc_w)
+
+    return dispatch
 
 
 @functools.lru_cache(maxsize=32)
@@ -270,7 +293,13 @@ def _fw_reclose_solver(key: Tuple):
             jnp.sum(changed_blocks.astype(jnp.int32)),
         )
 
-    return jax.jit(reclose)
+    fit = jax.jit(reclose)
+
+    def dispatch(d, allow, dirty):
+        with _profile_span(f"apsp.fw_reclose.{nb}x{bsz}"):
+            return fit(d, allow, dirty)
+
+    return dispatch
 
 
 def build_weight_matrix(graph: CompiledGraph) -> np.ndarray:

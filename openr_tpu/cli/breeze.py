@@ -8,6 +8,8 @@ same command vocabulary:
 
   breeze kvstore keys|keyvals|peers|areas
   breeze decision adj|prefixes|routes|rib-policy|solver-health|
+                  solve-traces [--json]|profile [--seconds N] [--out DIR]|
+                  profile-status|
                   te-optimize [--demands file.json] [--steps N] [--json]
   breeze fib routes|unicast-routes|mpls-routes|counters
   breeze lm links|set-node-overload|unset-node-overload|
@@ -197,6 +199,81 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
         state = "DEGRADED" if health.get("degraded") else "HEALTHY"
         print(f"solver: {state} (breaker: {health.get('breaker_state')})")
         _print_json(health)
+    elif args.cmd == "solve-traces":
+        report = client.call(
+            "getSolveTraces", area=args.area, last_n=args.last
+        )
+        if args.json:
+            _print_json(report)
+            return
+        if not report.get("enabled"):
+            print("flight recorder not enabled (solver unsupervised)")
+            return
+        stats = report.get("stats", {})
+        print(
+            f"flight recorder: {stats.get('recorded', 0)} recorded = "
+            f"{stats.get('retained', 0)} retained + "
+            f"{stats.get('evicted', 0)} evicted; "
+            f"{stats.get('sampled_solves', 0)} sampled "
+            f"(every {stats.get('sample_every', 0)}th), "
+            f"ring {stats.get('ring_size', 0)}/area"
+        )
+        rows = []
+        for t in report.get("traces", []):
+            phases = t.get("phases") or {}
+            rows.append(
+                [
+                    t["seq"],
+                    t["area"],
+                    t["event"],
+                    t["layout"],
+                    "warm" if t["warm"] else "cold",
+                    (
+                        f"{t['solve_ms']:.2f}"
+                        if t.get("solve_ms") is not None
+                        else "-"
+                    ),
+                    t.get("rounds") if t.get("rounds") is not None else "-",
+                    (
+                        " ".join(
+                            f"{k}={v:.2f}" for k, v in sorted(phases.items())
+                        )
+                        if phases
+                        else ("-" if not t.get("fault_kind")
+                              else t["fault_kind"])
+                    ),
+                ]
+            )
+        _print_table(
+            ["Seq", "Area", "Event", "Layout", "Disp", "ms", "Rounds",
+             "Phases(ms) / fault"],
+            rows,
+        )
+        dumps = report.get("forensics", [])
+        if dumps:
+            print("forensics dumps:")
+            _print_table(
+                ["Id", "Reason", "Traces", "Path"],
+                [
+                    [d["id"], d["reason"], d["traces"], d.get("path") or "-"]
+                    for d in dumps
+                ],
+            )
+    elif args.cmd == "profile":
+        status = client.call(
+            "startProfile", seconds=args.seconds, out=args.out
+        )
+        if status.get("started"):
+            print(
+                f"profiling window open: {status['seconds']}s -> "
+                f"{status['out_dir']} (TensorBoard-compatible)"
+            )
+        else:
+            print(f"profiling not started: {status.get('error')}")
+        if args.json:
+            _print_json(status)
+    elif args.cmd == "profile-status":
+        _print_json(client.call("getProfileStatus"))
     elif args.cmd == "te-optimize":
         params = {}
         if args.demands:
@@ -762,6 +839,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default=None)
     dec.add_parser("rib-policy")
     dec.add_parser("solver-health")
+    p = dec.add_parser("solve-traces")
+    p.add_argument("--area", default=None)
+    p.add_argument(
+        "--last", type=int, default=None, help="most recent N traces"
+    )
+    p.add_argument(
+        "--json", action="store_true", help="dump raw trace records"
+    )
+    p = dec.add_parser("profile")
+    p.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="profiling window duration (clamped to [0.1, 600])",
+    )
+    p.add_argument(
+        "--out", default=None,
+        help="TensorBoard trace directory (temp dir when omitted)",
+    )
+    p.add_argument("--json", action="store_true")
+    dec.add_parser("profile-status")
     p = dec.add_parser("te-optimize")
     p.add_argument(
         "--demands",

@@ -34,6 +34,7 @@ import asyncio
 import base64
 import json
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from openr_tpu.kvstore import wire
@@ -142,6 +143,9 @@ class CtrlServer:
         self.admission = admission
         self._route_updates = route_updates
         self._own_stream_manager = False
+        # on-demand jax profiling window (monitor/profiling.py), built
+        # lazily by the first startProfile/getProfileStatus
+        self._profile_controller = None
         self._loop = loop
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
@@ -185,6 +189,9 @@ class CtrlServer:
         return self.port
 
     async def stop(self) -> None:
+        if self._profile_controller is not None:
+            # a profiling window must not outlive the daemon it profiles
+            self._profile_controller.stop()
         if self.stream_manager is not None and self._own_stream_manager:
             self.stream_manager.stop()
         if self._server is not None:
@@ -361,9 +368,59 @@ class CtrlServer:
 
     def m_getSolverHealth(self, params) -> Dict[str, Any]:
         """Solver fault-domain state: degraded flag, breaker state,
-        probe/audit stats (docs/Robustness.md)."""
+        probe/audit stats, last-solve timing gauges, flight-recorder ring
+        + forensics state (docs/Robustness.md)."""
         assert self.decision is not None, "decision module not attached"
         return self.decision.get_solver_health()
+
+    def m_getSolveTraces(self, params) -> Dict[str, Any]:
+        """Flight-recorder read surface (docs/Monitoring.md "Flight
+        recorder & profiling"): per-area SolveTrace rings (event class,
+        layout, warm/cold, per-phase ms on sampled solves), ring/eviction
+        accounting, and the forensics-dump index. params: area (filter),
+        last_n (most recent N)."""
+        assert self.decision is not None, "decision module not attached"
+        last_n = params.get("last_n")
+        return self.decision.get_solve_traces(
+            area=params.get("area") or None,
+            last_n=int(last_n) if last_n is not None else None,
+        )
+
+    def _profiler(self):
+        if getattr(self, "_profile_controller", None) is None:
+            from openr_tpu.monitor.profiling import ProfileController
+
+            self._profile_controller = ProfileController()
+        return self._profile_controller
+
+    def m_startProfile(self, params) -> Dict[str, Any]:
+        """Open a bounded on-demand jax.profiler window writing a
+        TensorBoard-compatible trace dir (`breeze decision profile`).
+        Admission-controlled like the other expensive RPCs; degrade-safe:
+        an unavailable profiler reports in-band, never raises. params:
+        seconds (clamped to [0.1, 600]), out (directory; temp dir when
+        omitted)."""
+        controller = self._profiler()
+        result = controller.start(
+            out_dir=params.get("out") or params.get("out_dir"),
+            seconds=float(params.get("seconds", 5.0)),
+        )
+        if result.get("started"):
+            # arm the expiry on the daemon loop so the bound holds even
+            # if no client ever polls getProfileStatus
+            try:
+                loop = self._loop or asyncio.get_event_loop()
+                loop.call_later(
+                    controller.seconds + 0.05, controller.maybe_expire
+                )
+            except RuntimeError:
+                pass  # loop-less embedding: status()/start() still expire
+        return result
+
+    def m_getProfileStatus(self, params) -> Dict[str, Any]:
+        """Live profiling-window state (active, out_dir, remaining_s,
+        last_error)."""
+        return self._profiler().status()
 
     def m_getConvergenceReport(self, params) -> Dict[str, Any]:
         """This node's convergence evidence — finished CONVERGENCE_TRACE
@@ -799,9 +856,17 @@ class CtrlServer:
         return self.kvstore.dump_all(area=area, filters=filters)
 
     async def _send_frame(self, writer, req_id, payload) -> None:
-        writer.write(
-            json.dumps({"id": req_id, "stream": payload}).encode() + b"\n"
-        )
+        # per-subscriber JSON re-encoding is the ROADMAP's candidate next
+        # serving wall: time and size every frame encode here so the
+        # shared-encoding hypothesis is measurable before anyone builds
+        # the fast path (ctrl.stream.encode_* — docs/Streaming.md)
+        t0 = time.perf_counter()
+        data = json.dumps({"id": req_id, "stream": payload}).encode() + b"\n"
+        if self.stream_manager is not None:
+            self.stream_manager.note_encode(
+                (time.perf_counter() - t0) * 1e3, len(data)
+            )
+        writer.write(data)
         await writer.drain()
 
     async def _deliver_gate(self, sub) -> None:

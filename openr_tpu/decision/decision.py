@@ -152,6 +152,14 @@ class DecisionConfig:
     # off disables it wholesale (big areas fall back per-area regardless)
     solver_apsp: bool = True
     solver_apsp_max_nodes: int = 4096
+    # flight recorder (solver/flight_recorder.py, docs/Monitoring.md):
+    # per-area SolveTrace ring bound, the sampled phase-timing cadence
+    # (every Nth solve takes block_until_ready barriers at phase seams;
+    # 0 disables sampling), and an optional directory forensics dumps
+    # are written to as JSON artifacts
+    solver_trace_ring: int = 64
+    solver_trace_sample_every: int = 16
+    solver_forensics_dir: Optional[str] = None
 
 
 # wall-clock PerfEvent descriptors mapped onto convergence-span stages:
@@ -331,6 +339,11 @@ class Decision(CountersMixin, HistogramsMixin):
                         ),
                         audit_interval=config.solver_audit_interval,
                         mesh_degrade=config.solver_mesh_degrade,
+                        trace_ring_size=config.solver_trace_ring,
+                        trace_sample_every=(
+                            config.solver_trace_sample_every
+                        ),
+                        forensics_dir=config.solver_forensics_dir,
                     ),
                     watchdog=watchdog,
                     log_sample_fn=log_sample_fn,
@@ -905,6 +918,37 @@ class Decision(CountersMixin, HistogramsMixin):
             "breaker_state": "unsupervised",
             "fallback_active": 0,
             "backend": self.config.solver_backend,
+            "solve_ms_last": getattr(self.solver, "solve_ms_last", None),
+            "delta_extract_ms_last": getattr(
+                self.solver, "delta_extract_ms_last", None
+            ),
+            "apsp_close_ms_last": getattr(
+                self.solver, "apsp_close_ms_last", None
+            ),
+        }
+
+    def get_solve_traces(
+        self, area: Optional[str] = None, last_n: Optional[int] = None
+    ) -> Dict:
+        """Flight-recorder surface (ctrl `getSolveTraces` / `breeze
+        decision solve-traces`): the per-area SolveTrace rings with
+        eviction accounting plus the forensics-dump index
+        (docs/Monitoring.md "Flight recorder & profiling"). Recording
+        rides the SolverSupervisor; an unsupervised backend reports
+        enabled=False with empty surfaces."""
+        recorder = getattr(self.solver, "recorder", None)
+        if not isinstance(self.solver, SolverSupervisor) or recorder is None:
+            return {
+                "enabled": False,
+                "traces": [],
+                "stats": {},
+                "forensics": [],
+            }
+        return {
+            "enabled": True,
+            "traces": recorder.snapshot(area=area, last_n=last_n),
+            "stats": recorder.stats(),
+            "forensics": recorder.dump_summaries(),
         }
 
     def get_adjacency_databases(self) -> Dict[str, AdjacencyDatabase]:

@@ -23,6 +23,7 @@ from openr_tpu.monitor.report import (
     node_convergence_report,
     percentile_summary,
 )
+from openr_tpu.monitor.profiling import ProfileController
 from openr_tpu.monitor.spans import SPAN_EVENT, Span
 from openr_tpu.monitor.watchdog import Watchdog, WatchdogConfig
 
@@ -31,6 +32,7 @@ __all__ = [
     "LogSample",
     "MetricsExporter",
     "Monitor",
+    "ProfileController",
     "Span",
     "SPAN_EVENT",
     "Watchdog",

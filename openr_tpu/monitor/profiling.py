@@ -1,0 +1,136 @@
+"""On-demand JAX profiling windows (ctrl `startProfile` / breeze
+`decision profile`).
+
+The flight recorder (solver/flight_recorder.py) answers *which phase* of
+a solve was slow; this module answers *why*, on demand: a bounded
+profiling window wraps everything the daemon dispatches — the solver
+kernels carry `jax.profiler.TraceAnnotation` names at their dispatch
+seams (ops/spf.py, apsp/kernels.py), so the captured trace shows named
+solve regions — into a TensorBoard-compatible trace directory via
+`jax.profiler.start_trace` / `stop_trace`.
+
+Design constraints, in order:
+
+  - **Bounded.** A window has an explicit duration (clamped to
+    [0.1s, 600s]) and is closed by whichever comes first: the scheduled
+    expiry callback (the ctrl server arms one on the daemon loop), the
+    next `status()` poll past the deadline, or an explicit `stop()`.
+    There is no way to leave the profiler running unbounded.
+  - **Degrade-safe.** `start`/`stop` failures (CPU-only builds, missing
+    profiler support, unwritable directories) are captured into
+    `last_error` and reported in the status record — a profiling request
+    must never take down the daemon or a breaker-degraded solve path.
+  - **Single-flight.** One window at a time; a second `start` while one
+    is active is refused with the live status (the ctrl server
+    additionally admission-controls the RPC like other expensive calls).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+# window duration clamp (seconds): long enough for a solve burst, short
+# enough that a forgotten window cannot fill a disk
+MIN_WINDOW_S = 0.1
+MAX_WINDOW_S = 600.0
+
+
+class ProfileController:
+    """One daemon's bounded jax.profiler window state machine."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self.active = False
+        self.out_dir: Optional[str] = None
+        self.seconds = 0.0
+        self.started_at: Optional[float] = None
+        self.windows = 0  # windows ever started
+        self.last_error: Optional[str] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(
+        self, out_dir: Optional[str] = None, seconds: float = 5.0
+    ) -> Dict[str, Any]:
+        """Open a bounded profiling window writing a TensorBoard trace
+        under `out_dir` (a fresh temp dir when omitted). Returns the
+        status record with `started` set; refusal (window already
+        active, profiler unavailable) reports instead of raising."""
+        self.maybe_expire()
+        if self.active:
+            return {
+                "started": False,
+                "error": "profiling window already active",
+                **self.status(),
+            }
+        seconds = min(max(float(seconds), MIN_WINDOW_S), MAX_WINDOW_S)
+        if not out_dir:
+            out_dir = tempfile.mkdtemp(prefix="openr-profile-")
+        try:
+            import os
+
+            import jax
+
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+        except Exception as exc:
+            # degrade-safe: CPU-only or profiler-less builds report the
+            # failure in-band; the daemon keeps serving
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            return {
+                "started": False,
+                "error": self.last_error,
+                **self.status(),
+            }
+        self.active = True
+        self.out_dir = out_dir
+        self.seconds = seconds
+        self.started_at = self._clock()
+        self.windows += 1
+        return {"started": True, **self.status()}
+
+    def stop(self) -> Dict[str, Any]:
+        """Close the window now (idempotent)."""
+        if self.active:
+            self._stop_trace()
+        return self.status()
+
+    def maybe_expire(self) -> None:
+        """Close the window if its deadline passed — called by the
+        scheduled expiry, by `status()` polls and by `start()`, so the
+        bound holds even when no timer fired."""
+        if (
+            self.active
+            and self.started_at is not None
+            and self._clock() - self.started_at >= self.seconds
+        ):
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            self.last_error = f"{type(exc).__name__}: {exc}"
+        self.active = False
+
+    # -- read surface ----------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        self.maybe_expire()
+        remaining = 0.0
+        if self.active and self.started_at is not None:
+            remaining = max(
+                0.0, self.seconds - (self._clock() - self.started_at)
+            )
+        return {
+            "active": self.active,
+            "out_dir": self.out_dir,
+            "seconds": self.seconds,
+            "remaining_s": round(remaining, 3),
+            "windows": self.windows,
+            "last_error": self.last_error,
+        }

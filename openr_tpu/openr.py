@@ -291,6 +291,9 @@ class OpenrDaemon:
                 solver_mesh_degrade=dc.solver_mesh_degrade,
                 solver_apsp=dc.solver_apsp,
                 solver_apsp_max_nodes=dc.solver_apsp_max_nodes,
+                solver_trace_ring=dc.solver_trace_ring,
+                solver_trace_sample_every=dc.solver_trace_sample_every,
+                solver_forensics_dir=dc.solver_forensics_dir,
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,

@@ -1048,19 +1048,32 @@ def sell_fixpoint_masked(
         ov = jnp.asarray(overloaded)
     if d_prev is not None:
         fn = _sell_solver_vw_warm(sell.shape_key(), mesh)
-        return fn(
-            jnp.asarray(sources, dtype=jnp.int32),
-            nbrs,
-            wgs,
-            tuple(masks),
-            ov,
-            d_prev,
-        )
+        with profile_span("spf.ksp_masked_warm"):
+            return fn(
+                jnp.asarray(sources, dtype=jnp.int32),
+                nbrs,
+                wgs,
+                tuple(masks),
+                ov,
+                d_prev,
+            )
     fn = _sell_solver_vw(sell.shape_key(), mesh)
-    return fn(
-        jnp.asarray(sources, dtype=jnp.int32), nbrs, wgs, tuple(masks), ov
-    )
+    with profile_span("spf.ksp_masked"):
+        return fn(
+            jnp.asarray(sources, dtype=jnp.int32), nbrs, wgs, tuple(masks), ov
+        )
 
+
+
+def profile_span(name: str):
+    """Named `jax.profiler.TraceAnnotation` around a kernel dispatch seam:
+    inside an on-demand profiling window (monitor/profiling.py) the
+    captured TensorBoard trace shows the dispatch under this label; with
+    no profiler active the annotation is a C++-side no-op, cheap enough
+    for the serving path."""
+    from jax.profiler import TraceAnnotation
+
+    return TraceAnnotation(name)
 
 
 def sell_fixpoint(
@@ -1071,12 +1084,13 @@ def sell_fixpoint(
 ) -> jnp.ndarray:
     """Distance matrix D [S, N] via the sliced-ELL pull relaxation."""
     fn = _sell_solver(sell.shape_key(), None)
-    return fn(
-        jnp.asarray(sources, dtype=jnp.int32),
-        tuple(jnp.asarray(a) for a in sell.nbr),
-        tuple(jnp.asarray(a) for a in wgs),
-        jnp.asarray(overloaded),
-    )
+    with profile_span("spf.sell_fixpoint"):
+        return fn(
+            jnp.asarray(sources, dtype=jnp.int32),
+            tuple(jnp.asarray(a) for a in sell.nbr),
+            tuple(jnp.asarray(a) for a in wgs),
+            jnp.asarray(overloaded),
+        )
 
 
 def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
@@ -1092,13 +1106,14 @@ def batched_spf(graph: CompiledGraph, source_rows: np.ndarray) -> jnp.ndarray:
         return sell_fixpoint(
             graph.sell, source_rows, graph.sell.wg, graph.overloaded
         )
-    return _bf_fixpoint(
-        jnp.asarray(source_rows, dtype=jnp.int32),
-        jnp.asarray(graph.src),
-        jnp.asarray(graph.dst),
-        jnp.asarray(graph.w),
-        jnp.asarray(graph.overloaded),
-    )
+    with profile_span("spf.batched_cold"):
+        return _bf_fixpoint(
+            jnp.asarray(source_rows, dtype=jnp.int32),
+            jnp.asarray(graph.src),
+            jnp.asarray(graph.dst),
+            jnp.asarray(graph.w),
+            jnp.asarray(graph.overloaded),
+        )
 
 
 def batched_spf_vw(
@@ -1110,13 +1125,14 @@ def batched_spf_vw(
     With a mesh, sources and weight rows shard over 'batch' (S must be a
     multiple of the batch-axis size)."""
     fault_point("ops.spf.batched_spf_vw", graph)
-    return _bf_vw_solver(mesh)(
-        jnp.asarray(source_rows, dtype=jnp.int32),
-        jnp.asarray(graph.src),
-        jnp.asarray(graph.dst),
-        jnp.asarray(w_rows, dtype=jnp.int32),
-        jnp.asarray(graph.overloaded),
-    )
+    with profile_span("spf.batched_vw"):
+        return _bf_vw_solver(mesh)(
+            jnp.asarray(source_rows, dtype=jnp.int32),
+            jnp.asarray(graph.src),
+            jnp.asarray(graph.dst),
+            jnp.asarray(w_rows, dtype=jnp.int32),
+            jnp.asarray(graph.overloaded),
+        )
 
 
 @jax.jit

@@ -7,7 +7,9 @@ openr/decision/Decision.cpp SpfSolver. Two interchangeable backends:
   - tpu.TpuSpfSolver: batched min-plus solver on TPU via JAX
 plus supervisor.SolverSupervisor, the fault-domain facade that serves the
 TPU backend under a circuit breaker with the CPU oracle as the degraded
-path (docs/Robustness.md).
+path (docs/Robustness.md), and flight_recorder.FlightRecorder, the
+per-solve trace ring + forensics layer the supervisor records into
+(docs/Monitoring.md "Flight recorder & profiling").
 """
 
 from openr_tpu.solver.routes import (
@@ -20,10 +22,13 @@ from openr_tpu.solver.routes import (
 )
 from openr_tpu.solver.cpu import SpfSolver
 from openr_tpu.solver.delta import DeltaRouteBuilder
+from openr_tpu.solver.flight_recorder import FlightRecorder, SolveTrace
 from openr_tpu.solver.supervisor import SolverSupervisor, SupervisorConfig
 from openr_tpu.solver.tpu import TpuSpfSolver
 
 __all__ = [
+    "FlightRecorder",
+    "SolveTrace",
     "SolverSupervisor",
     "SupervisorConfig",
     "TpuSpfSolver",

@@ -145,6 +145,18 @@ class SupervisorConfig:
     mesh_degrade: bool = True
     # watchdog heartbeat name stamped around solves
     watchdog_module: str = "decision"
+    # flight recorder (solver/flight_recorder.py, docs/Monitoring.md
+    # "Flight recorder & profiling"): per-area SolveTrace ring bound and
+    # the phase-timing sampling cadence — every trace_sample_every-th
+    # solve takes block_until_ready barriers at phase seams; 0 disables
+    # sampling entirely (traces still record, without phase splits)
+    trace_ring_size: int = 64
+    trace_sample_every: int = 16
+    # forensics dumps: traces per area snapshotted into each dump, and an
+    # optional directory the JSON artifacts are also written to (None =
+    # in-memory only, read via ctrl getSolveTraces)
+    forensics_last_n: int = 16
+    forensics_dir: Optional[str] = None
 
 
 class SolverSupervisor(CountersMixin, HistogramsMixin):
@@ -192,6 +204,22 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         self.counters: Dict[str, int] = {}
         self.histograms: Dict = {}
         self.counters["decision.spf.fallback_active"] = 0
+
+        # flight recorder: every supervised solve leaves a SolveTrace in
+        # the bounded per-area ring, and the fault paths below snapshot
+        # the ring into forensics dumps (docs/Monitoring.md)
+        from openr_tpu.solver.flight_recorder import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            ring_size=self.config.trace_ring_size,
+            sample_every=self.config.trace_sample_every,
+            forensics_dir=self.config.forensics_dir,
+            forensics_last_n=self.config.forensics_last_n,
+            node=self.my_node_name,
+        )
+        attach_rec = getattr(primary, "attach_recorder", None)
+        if attach_rec is not None:
+            attach_rec(self.recorder)
 
         # non-solve device workloads owned by the primary (the APSP
         # closes) dispatch through this fault domain too: classified
@@ -358,6 +386,15 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
                 )
             else:
                 self._record_success()
+            # the non-SPF device workloads leave ring evidence too: an
+            # APSP close or TE dispatch sits in the same solve history a
+            # forensics dump reconstructs
+            self._record_event_trace(
+                "device_call",
+                layout="apsp" if "apsp" in op else "device",
+                solve_ms=elapsed * 1e3,
+                detail=op,
+            )
             return result, False
 
         if fallback_fn is None:
@@ -422,9 +459,10 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             len(diff.unicast_routes_to_update) + len(diff.mpls_routes_to_update),
             len(diff.unicast_routes_to_delete) + len(diff.mpls_routes_to_delete),
         )
+        forensics_id = self._forensics_dump("delta_audit_mismatch")
         self._emit_sample(
             "ROUTE_DELTA_AUDIT_MISMATCH",
-            {},
+            {"forensics_id": forensics_id or ""},
             {
                 "unicast_diverged": len(diff.unicast_routes_to_update)
                 + len(diff.unicast_routes_to_delete),
@@ -469,11 +507,87 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
 
     def _fallback_solve(self, my_node_name, area_link_states, prefix_state):
         self._bump("decision.spf.fallback_solves")
+        t0 = self._clock()
         db = self.fallback.build_route_db(
             my_node_name, area_link_states, prefix_state
         )
+        self._record_event_trace(
+            "fallback_solve",
+            layout="cpu",
+            solve_ms=(self._clock() - t0) * 1e3,
+        )
         self._sync_backend_stats(self.fallback)
         return db
+
+    def _record_event_trace(
+        self,
+        event: str,
+        *,
+        layout: str = "none",
+        solve_ms: Optional[float] = None,
+        fault_kind: Optional[str] = None,
+        detail: Optional[str] = None,
+    ) -> None:
+        """Supervisor-level SolveTrace (fallback solves, classified
+        faults): no per-phase detail — the device never ran — but the
+        event lands in the same ring as the device traces, so a forensics
+        dump shows the degraded serving next to the solves that led to
+        it."""
+        from openr_tpu.solver.flight_recorder import SolveTrace
+
+        rec = self.recorder
+        rec.record(
+            SolveTrace(
+                seq=rec.next_seq(),
+                ts=time.time(),
+                area="*",
+                node=self.my_node_name,
+                event=event,
+                layout=layout,
+                warm=False,
+                solve_ms=solve_ms,
+                rounds=None,
+                invalidation_rounds=None,
+                halo_exchanges=None,
+                h2d_bytes=0,
+                d2h_bytes=0,
+                halo_bytes=0,
+                delta_columns=None,
+                compile_cache_misses=0,
+                breaker_state=self.state,
+                sampled=False,
+                fault_kind=fault_kind,
+                detail=detail,
+            )
+        )
+
+    def _forensics_dump(self, reason: str) -> Optional[str]:
+        """Snapshot the flight-recorder rings + solver context into one
+        forensics artifact; returns the dump id referenced from the
+        breaker/audit LogSamples. Every fault-domain transition calls
+        this BEFORE invalidating warm state, so the dump still holds the
+        solve history that led to the fault."""
+        import dataclasses
+
+        from openr_tpu.solver.flight_recorder import device_digest
+
+        dump = self.recorder.dump(
+            reason,
+            solver_config=dataclasses.asdict(self.config),
+            counters={
+                k: v
+                for k, v in self.counters.items()
+                if k.startswith("decision.spf.")
+            },
+            mesh_digest=device_digest(getattr(self.primary, "mesh", None)),
+        )
+        self._bump("decision.spf.forensics_dumps")
+        self._emit_sample(
+            "SOLVER_FORENSICS_DUMPED",
+            {"forensics_id": dump["id"], "reason": reason},
+            {"traces": sum(len(t) for t in dump["traces"].values())},
+        )
+        return dump["id"]
 
     def _record_failure(
         self, kind: str, exc: BaseException, elapsed_s: Optional[float] = None
@@ -482,12 +596,22 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         self.consecutive_failures += 1
         self._bump("decision.spf.solver_failures")
         self._bump(f"decision.spf.solver_failures.{kind}")
+        self._record_event_trace(
+            "fault",
+            fault_kind=kind,
+            detail=f"{type(exc).__name__}: {exc}"[:200],
+        )
         log.warning(
             "supervised solve failure #%d (%s): %s",
             self.consecutive_failures,
             kind,
             exc,
         )
+        if kind == FAULT_DEADLINE:
+            # a deadline overrun serves its (valid) result but is device
+            # evidence worth keeping: snapshot the solve history now,
+            # while the slow solve's trace is still in the ring
+            self._forensics_dump("deadline")
         if elapsed_s is not None and self.watchdog is not None:
             note = getattr(self.watchdog, "note_slow", None)
             if note is not None:
@@ -515,17 +639,24 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             self.last_fault_kind,
         )
         self.state = OPEN
+        self.recorder.breaker_state = OPEN
         self._bump("decision.spf.breaker_trips")
         self.counters["decision.spf.fallback_active"] = 1
         self.probe_streak = 0
         self._probe_backoff.report_success()  # fresh probe schedule
         self._next_probe_at = self._clock() + self.config.probe_interval_s
+        # forensics BEFORE the warm-state drop: the dump must hold the
+        # solve history that led here, referenced by id from the sample
+        forensics_id = self._forensics_dump("breaker_trip")
         # the device-resident warm state is untrustworthy after a fault:
         # dropping it forces the recovery path to rebuild from cold
         self._invalidate_primary_warm_state()
         self._emit_sample(
             "SOLVER_BREAKER_TRIPPED",
-            {"fault_kind": self.last_fault_kind or ""},
+            {
+                "fault_kind": self.last_fault_kind or "",
+                "forensics_id": forensics_id or "",
+            },
             {"consecutive_failures": self.consecutive_failures},
         )
 
@@ -556,9 +687,13 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         failures = self.consecutive_failures
         self.consecutive_failures = 0
         self._sync_backend_stats(self.primary)
+        forensics_id = self._forensics_dump("mesh_degraded")
         self._emit_sample(
             "SOLVER_MESH_DEGRADED",
-            {"mesh_shape": str(shape or {})},
+            {
+                "mesh_shape": str(shape or {}),
+                "forensics_id": forensics_id or "",
+            },
             {
                 "consecutive_failures": failures,
                 "mesh_devices": int(mesh.devices.size) if mesh else 0,
@@ -573,6 +708,7 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             self.probe_streak,
         )
         self.state = CLOSED
+        self.recorder.breaker_state = CLOSED
         self.counters["decision.spf.fallback_active"] = 0
         self.consecutive_failures = 0
         self.probe_streak = 0
@@ -617,6 +753,7 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             self.last_fault_kind = classify_solver_error(exc)
             self.probe_streak = 0
             self.state = OPEN
+            self.recorder.breaker_state = OPEN
             self._probe_backoff.report_error()
             self._next_probe_at = (
                 self._clock()
@@ -638,6 +775,7 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             self._close()
         else:
             self.state = HALF_OPEN
+            self.recorder.breaker_state = HALF_OPEN
 
     # -- warm-state audit ------------------------------------------------
 
@@ -664,9 +802,13 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
                 "%d diverged entries, max |delta|=%d",
                 m["area"], m["node"], m["entries"], m["max_abs_delta"],
             )
+        forensics_id = self._forensics_dump("audit_mismatch")
         self._emit_sample(
             "WARM_STATE_AUDIT_MISMATCH",
-            {"areas": ",".join(m["area"] for m in mismatches)},
+            {
+                "areas": ",".join(m["area"] for m in mismatches),
+                "forensics_id": forensics_id or "",
+            },
             {
                 "mismatched_areas": len(mismatches),
                 "mismatched_entries": sum(
@@ -769,4 +911,18 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             "apsp_audit_mismatches": self.counters.get(
                 "decision.spf.apsp_audit_mismatches", 0
             ),
+            # last-solve timing picture (docs/Monitoring.md): the gauges
+            # next to solve_ms_last so `breeze decision solver-health`
+            # shows the full per-event latency split without waiting for
+            # the phase histograms to fill
+            "solve_ms_last": getattr(self.primary, "solve_ms_last", None),
+            "delta_extract_ms_last": getattr(
+                self.primary, "delta_extract_ms_last", None
+            ),
+            "apsp_close_ms_last": getattr(
+                self.primary, "apsp_close_ms_last", None
+            ),
+            # flight-recorder ring + forensics state
+            "traces": self.recorder.stats(),
+            "forensics": self.recorder.forensics_stats(),
         }

@@ -44,9 +44,11 @@ from openr_tpu.ops.graph import (
 from openr_tpu.ops.spf import (
     batched_spf,
     batched_spf_vw,
+    compile_cache_stats,
     sell_fixpoint_masked,
 )
 from openr_tpu.solver.cpu import Metric, SpfSolver
+from openr_tpu.solver.flight_recorder import NULL_CLOCK, SolveTrace
 from openr_tpu.testing.faults import fault_point
 
 
@@ -241,9 +243,17 @@ class _AreaSolve:
         apsp_max_nodes: int = 0,
         apsp_audit_interval: int = 0,
         apsp_dispatch=None,
+        recorder=None,
     ) -> None:
         self.link_state = link_state
         self.me = me
+        # flight recorder (solver/flight_recorder.py): every solve emits a
+        # SolveTrace into the bounded per-area ring; every Nth solve gets
+        # a live PhaseClock whose seams barrier at phase boundaries. The
+        # unsampled path sees only NULL_CLOCK attribute checks.
+        self._recorder = recorder
+        self._pclock = NULL_CLOCK
+        self._last_trace: Optional[SolveTrace] = None
         # jax.sharding.Mesh or None: when set, the source batch is sharded
         # over the mesh 'batch' axis and the persistent layout buffers are
         # replicated across devices — same executables, multi-chip spread
@@ -330,8 +340,20 @@ class _AreaSolve:
         zero-copy view of the device buffer, and the warm solver donates
         that buffer to the next event — a view would alias reused memory."""
         if self._d_host is None:
+            t0 = time.perf_counter()
             self._d_host = np.array(self._d_dev)
             self.d2h_bytes += self._d_host.nbytes
+            trace = self._last_trace
+            if trace is not None and trace.sampled:
+                # the lazy mirror fetch is this solve's d2h phase; it
+                # lands after the trace was recorded, so attribute it
+                # post-hoc (the ring holds the live object) and queue the
+                # histogram sample for the next counter sync
+                ms = (time.perf_counter() - t0) * 1e3
+                trace.phases["d2h"] = trace.phases.get("d2h", 0.0) + ms
+                trace.d2h_bytes += self._d_host.nbytes
+                if self._recorder is not None:
+                    self._recorder.observe_phase("d2h", ms)
         return self._d_host
 
     def _batch_pad(self, n: int, minimum: int = 8) -> int:
@@ -387,8 +409,13 @@ class _AreaSolve:
         # so the measured wall time includes device execution there.
         inc_before = self.incremental_solves
         self._last_solve_delta = None  # set by a qualifying resident solve
+        rec = self._recorder
+        pc = self._pclock = rec.begin() if rec is not None else NULL_CLOCK
+        h2d0, d2h0, halo0 = self.h2d_bytes, self.d2h_bytes, self.halo_bytes
+        misses0 = compile_cache_stats()["misses"] if rec is not None else 0
         t0 = time.perf_counter()
         self.h2d_bytes += rows.nbytes
+        pc.seam("prepare")
         if self._use_tiled():
             self._d_dev, self.rounds_last = self._tile_solve_resident(rows)
         elif self.graph.sell is not None:
@@ -399,6 +426,7 @@ class _AreaSolve:
             self._d_dev = sharded_batched_spf(self.graph, rows, self.mesh)
             self.rounds_last = None  # edge-list form: rounds untracked
             self.full_solves += 1
+            pc.seam("relax", self._d_dev)
         else:
             self._d_dev, self.rounds_last = self._bf_solve_resident(rows)
         self.solve_ms_last = (time.perf_counter() - t0) * 1e3
@@ -427,6 +455,44 @@ class _AreaSolve:
         # it resident; its own ensure() re-closes the touched blocks.
         if self.apsp is not None and not self.last_solve_warm:
             self.apsp.invalidate("batch_warm_poisoned")
+        if rec is not None:
+            kind = (self._dev or {}).get("kind") or (
+                "replicated" if self.mesh is not None else "none"
+            )
+            self._last_trace = SolveTrace(
+                seq=rec.next_seq(),
+                ts=time.time(),
+                area=self.link_state.area,
+                node=self.me,
+                event="solve",
+                layout=kind,
+                warm=self.last_solve_warm,
+                solve_ms=self.solve_ms_last,
+                rounds=self.rounds_last,
+                invalidation_rounds=(
+                    self.invalidation_rounds_last
+                    if self.last_solve_warm
+                    else None
+                ),
+                halo_exchanges=(
+                    self.halo_exchanges_last if kind == "tile2d" else None
+                ),
+                h2d_bytes=self.h2d_bytes - h2d0,
+                d2h_bytes=self.d2h_bytes - d2h0,
+                halo_bytes=self.halo_bytes - halo0,
+                delta_columns=(
+                    len(self._last_solve_delta)
+                    if self._last_solve_delta is not None
+                    else None
+                ),
+                compile_cache_misses=(
+                    compile_cache_stats()["misses"] - misses0
+                ),
+                breaker_state=rec.breaker_state,
+                sampled=pc.sampled,
+                phases=dict(pc.phases),
+            )
+            rec.record(self._last_trace, pc)
         # corruption seam (ctx = this solve): the warm-state audit tests
         # perturb the resident D here to prove divergence detection works
         fault_point("solver.tpu.warm_d", self)
@@ -559,6 +625,7 @@ class _AreaSolve:
                 fn = _tile_solver_warm(
                     tiling.shape_key() + (g.n_pad,), self.mesh
                 )
+                self._pclock.seam("h2d", w2_new, ov_new)
                 d, rounds, inv_rounds, col_changed, num_changed = fn(
                     jnp.asarray(rows, dtype=jnp.int32),
                     st["src_l"],
@@ -577,6 +644,7 @@ class _AreaSolve:
                 self.incremental_solves += 1
                 self.invalidation_rounds_last = int(inv_rounds)
                 rounds = int(rounds)
+                self._pclock.seam("relax", d)
                 # seed exchange + one ring per invalidation and relax round
                 self._account_halo(
                     (g_ax - 1) * (1 + int(inv_rounds) + rounds)
@@ -593,6 +661,7 @@ class _AreaSolve:
                 self.h2d_bytes += g.overloaded.nbytes
 
         fn = _tile_solver(st["tiling"].shape_key() + (g.n_pad,), self.mesh)
+        self._pclock.seam("h2d", st["w2"], st["ov"])
         d, rounds = fn(
             jnp.asarray(rows, dtype=jnp.int32),
             st["src_l"],
@@ -603,6 +672,7 @@ class _AreaSolve:
         )
         self.full_solves += 1
         rounds = int(rounds)
+        self._pclock.seam("relax", d)
         self._account_halo((g_ax - 1) * rounds)
         return d, rounds
 
@@ -764,6 +834,7 @@ class _AreaSolve:
                         delta_ok = not ov_changed and not np.any(
                             g.src[changed] == rows[0]
                         )
+                        self._pclock.seam("h2d", args[4], args[5])
                         (
                             d,
                             new_wgs,
@@ -775,15 +846,18 @@ class _AreaSolve:
                         st["wgs"] = new_wgs
                         self.incremental_solves += 1
                         self.invalidation_rounds_last = int(inv_rounds)
+                        self._pclock.seam("relax", d)
                         self._finish_delta(
                             col_changed, num_changed, d, delta_ok
                         )
                         return d, int(rounds)
                     if len(changed):
                         fn = _sell_solver_patched(sell.shape_key(), self.mesh)
+                        self._pclock.seam("h2d", args[4], args[5])
                         d, new_wgs, rounds = fn(*args)
                         st["wgs"] = new_wgs
                         self.full_solves += 1
+                        self._pclock.seam("relax", d)
                         return d, int(rounds)
                     # overload-only event with warm start unavailable:
                     # nothing to patch — plain cold solve below
@@ -801,6 +875,7 @@ class _AreaSolve:
                     st["wgs"] = tuple(wgs)
 
         fn = _sell_solver_counted(sell.shape_key(), self.mesh)
+        self._pclock.seam("h2d", st["ov"], *st["wgs"])
         d, rounds = fn(
             jnp.asarray(rows, dtype=jnp.int32),
             st["nbrs"],
@@ -808,6 +883,7 @@ class _AreaSolve:
             st["ov"],
         )
         self.full_solves += 1
+        self._pclock.seam("relax", d)
         return d, int(rounds)
 
     def _bf_solve_resident(self, rows: np.ndarray):
@@ -872,6 +948,7 @@ class _AreaSolve:
                 w_new = jnp.asarray(g.w)
                 self.h2d_bytes += g.w.nbytes
                 delta_ok = not np.any(g.src[changed] == rows[0])
+                self._pclock.seam("h2d", w_new)
                 d, rounds, inv_rounds, col_changed, num_changed = (
                     _bf_solver_warm(
                         jnp.asarray(rows, dtype=jnp.int32),
@@ -887,6 +964,7 @@ class _AreaSolve:
                 st["w_host"] = g.w.copy()
                 self.incremental_solves += 1
                 self.invalidation_rounds_last = int(inv_rounds)
+                self._pclock.seam("relax", d)
                 self._finish_delta(col_changed, num_changed, d, delta_ok)
                 return d, int(rounds)
             if len(changed):
@@ -894,6 +972,7 @@ class _AreaSolve:
                 st["w_host"] = g.w.copy()
                 self.h2d_bytes += g.w.nbytes
 
+        self._pclock.seam("h2d", st["w"], st["ov"])
         d = _bf_fixpoint(
             jnp.asarray(rows, dtype=jnp.int32),
             st["src"],
@@ -902,6 +981,7 @@ class _AreaSolve:
             st["ov"],
         )
         self.full_solves += 1
+        self._pclock.seam("relax", d)
         return d, None
 
     def _nh_link_arrays(self):
@@ -963,6 +1043,7 @@ class _AreaSolve:
         dcols = np.array(dcols_d)
         nh = np.array(nh_d)
         self.delta_extract_ms_last = (time.perf_counter() - t0) * 1e3
+        self._pclock.seam("delta_extract")  # host copies above are synced
         xfer = cols.nbytes + dcols.nbytes + nh.nbytes + 4  # + count scalar
         self.d2h_bytes += xfer
         self.delta_bytes += xfer
@@ -1315,6 +1396,16 @@ class TpuSpfSolver(SpfSolver):
         # through its fault domain (classified errors feed the shared
         # breaker, numpy FW serves as the degraded path)
         self._supervisor = None
+        # flight recorder (solver/flight_recorder.py), attached by the
+        # supervisor before the first solve; every _AreaSolve records its
+        # SolveTraces into it and the phase histograms drain through
+        # _sync_spf_counters
+        self._recorder = None
+        # last-solve timing gauges surfaced by getSolverHealth next to
+        # solve_ms_last (docs/Robustness.md observability surface)
+        self.solve_ms_last: Optional[float] = None
+        self.delta_extract_ms_last: Optional[float] = None
+        self.apsp_close_ms_last: Optional[float] = None
         # resolved EAGERLY: a solver_mesh that doesn't fit the device set
         # must fail at daemon startup with a clear error, not inside the
         # first debounced rebuild callback mid-convergence
@@ -1329,6 +1420,13 @@ class TpuSpfSolver(SpfSolver):
         owned by this backend (the APSP closes). Called by
         SolverSupervisor.__init__."""
         self._supervisor = supervisor
+
+    def attach_recorder(self, recorder) -> None:
+        """Wire the solver flight recorder (solver/flight_recorder.py)
+        into every area solve. Called by SolverSupervisor.__init__ before
+        the first solve; cached solves created earlier (none in the
+        supervised construction order) keep recording disabled."""
+        self._recorder = recorder
 
     def _apsp_dispatch(self, op: str, primary_fn, fallback_fn):
         """ApspState dispatch hook: supervised when a supervisor is
@@ -1368,6 +1466,7 @@ class TpuSpfSolver(SpfSolver):
             apsp_max_nodes=self.apsp_max_nodes,
             apsp_audit_interval=self.apsp_audit_interval,
             apsp_dispatch=self._apsp_dispatch,
+            recorder=self._recorder,
         )
         self.device_solves += solve.device_solves
         self._sync_spf_counters(solve, 0, 0)
@@ -1397,6 +1496,7 @@ class TpuSpfSolver(SpfSolver):
                 solve.invalidation_rounds_last
             )
         if (d_inc or d_full) and solve.solve_ms_last is not None:
+            self.solve_ms_last = solve.solve_ms_last
             self._observe("decision.spf.solve_ms", solve.solve_ms_last)
             self._observe(
                 "decision.spf.solve_warm_ms"
@@ -1440,9 +1540,22 @@ class TpuSpfSolver(SpfSolver):
             and solve.delta_extract_ms_last is not None
         ):
             solve._delta_extracts_synced = solve.delta_extracts
+            self.delta_extract_ms_last = solve.delta_extract_ms_last
             self._observe(
                 "decision.spf.delta_extract_ms", solve.delta_extract_ms_last
             )
+        # flight-recorder drain: sampled phase observations land in the
+        # decision.spf.phase.*_ms histograms (the names are literals in
+        # flight_recorder.PHASE_HISTOGRAMS, pinned to the docs table by
+        # registry-drift), and the ring/eviction accounting rides the
+        # counter registry as absolute totals
+        rec = self._recorder
+        if rec is not None:
+            for hist_name, value in rec.drain_observations():
+                self._observe(hist_name, value)
+            counters["decision.spf.traces_recorded"] = rec.recorded
+            counters["decision.spf.traces_evicted"] = rec.evicted
+            counters["decision.spf.traces_sampled"] = rec.sampled_solves
         self._sync_apsp_counters(solve)
         from openr_tpu.apsp import apsp_compile_cache_stats
         from openr_tpu.ops.spf import compile_cache_stats
@@ -1470,6 +1583,8 @@ class TpuSpfSolver(SpfSolver):
         apsp = solve.apsp
         if apsp is None:
             return
+        if apsp.close_ms_last is not None:
+            self.apsp_close_ms_last = apsp.close_ms_last
         d_closes = apsp.closes - apsp._closes_synced
         if d_closes:
             apsp._closes_synced = apsp.closes

@@ -52,6 +52,9 @@ DEFAULT_COSTS: Dict[str, int] = {
     "runTeOptimize": 2,
     "getRouteDbComputed": 1,
     "getConvergenceReport": 1,
+    # an on-demand profiling window perturbs every dispatch it covers:
+    # admission-bounded like the other expensive calls
+    "startProfile": 1,
 }
 
 

@@ -414,6 +414,16 @@ class StreamManager(CountersMixin, HistogramsMixin):
             self._route_subs
         )
 
+    def note_encode(self, ms: float, nbytes: int) -> None:
+        """Per-frame JSON encode attribution, recorded by the ctrl
+        server's stream handlers: every subscriber frame is re-encoded
+        per connection today, so `ctrl.stream.encode_ms` x
+        `ctrl.stream.delivered` is the fleet-wide serialization bill the
+        ROADMAP's shared-encoding fast path would amortize — measured
+        here first, built only if the numbers say so."""
+        self._observe("ctrl.stream.encode_ms", ms)
+        self._bump("ctrl.stream.encode_bytes", nbytes)
+
     def mark_delivered(self, sub: _BaseSubscription, t_enq: float) -> None:
         """Delivery accounting, called by the stream handler after the
         frame hit the socket: publish-to-deliver latency includes every

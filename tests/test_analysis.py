@@ -154,6 +154,26 @@ def test_trace_safety_quiet_on_known_good_solver_code():
     assert found == [], found
 
 
+def test_trace_safety_quiet_on_flight_recorder_barrier_seams():
+    """Regression (ISSUE 13): the flight recorder's sampled PhaseClock
+    takes `block_until_ready` barriers at phase seams in solver/tpu.py
+    (h2d/relax/delta_extract) and attributes the lazy mirror fetch in
+    the `d` property — all host-side instrumentation OUTSIDE every
+    traced function. Neither trace-safety nor device-transfer may flag
+    the seams (the solver's transfer accounting still sanctions its
+    copies), or sampling would be unshippable."""
+    targets = [
+        PKG / "solver" / "flight_recorder.py",
+        PKG / "solver" / "tpu.py",
+        PKG / "ops" / "spf.py",
+    ]
+    found, _ = _findings(targets)
+    blocking = [
+        f for f in found if f.rule in ("trace-safety", "device-transfer")
+    ]
+    assert blocking == [], blocking
+
+
 def test_trace_safety_cli_exits_nonzero(tmp_path):
     path = _write(tmp_path, "bad_trace.py", _TRACE_BAD)
     assert analysis_main([str(path), "--no-baseline"]) == 1

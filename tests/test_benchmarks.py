@@ -131,6 +131,14 @@ def test_bench_py_smoke(capsys, monkeypatch):
         assert "backend" not in result
         assert "degraded" not in result
     assert results[0]["metric"].endswith("spf_recomputes_per_sec")
+    # phase-split contract (ISSUE 13): the SPF line carries per-phase
+    # attribution columns measured with explicit barriers, so the first
+    # hardware round lands with h2d/relax/d2h split out of the headline
+    spf_phases = results[0]["phases"]
+    assert set(spf_phases) == {"h2d_ms", "relax_ms", "d2h_ms"}
+    for value in spf_phases.values():
+        assert value >= 0.0
+    assert spf_phases["relax_ms"] > 0.0
     assert results[1]["metric"] == "convergence_e2e_p95_ms"
     assert results[1]["spans"] > 0
     assert results[2]["metric"] == "te_optimize_ms"
@@ -146,6 +154,11 @@ def test_bench_py_smoke(capsys, monkeypatch):
         scale["tile_bytes_per_device"] * b_ax * g_ax
         == scale["replica_bytes_per_device"]
     )
+    # the scale line's phase split (warm flap event under barriers; halo
+    # traffic rides inside relax, split by the rounds gauges)
+    scale_phases = scale["phases"]
+    assert set(scale_phases) == {"h2d_ms", "relax_ms", "d2h_ms"}
+    assert scale_phases["relax_ms"] > 0.0
     # the exporter-overhead line (continuous-telemetry cost on the same
     # flap batch as the convergence line): a parse-validated render and a
     # measured per-span rollup fold cost must both be present and nonzero
@@ -198,6 +211,12 @@ def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):
         # the full metric shape, so dashboards can plot uptime without
         # special cases — only perf comparisons must skip it
         assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
+        # phase-split columns are degraded-aware: the SPF/scale lines
+        # keep their attribution fields on cpu-fallback rounds too
+        if result["metric"].endswith("spf_recomputes_per_sec") or (
+            result["metric"].endswith("_tiled_cold_solve_ms")
+        ):
+            assert {"h2d_ms", "relax_ms", "d2h_ms"} == set(result["phases"])
 
 
 def test_bench_py_dead_backend_degrades_never_raises():

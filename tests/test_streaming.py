@@ -425,6 +425,19 @@ class TestWire:
             assert stats["stream"]["kv_subscribers"] == 1
             assert stats["stream"]["counters"]["ctrl.stream.delivered"] >= 1
             assert stats["admission"]["capacity"] > 0
+            # encode attribution (ISSUE 13 satellite): every delivered
+            # frame's per-subscriber JSON re-encode is measured, so the
+            # ROADMAP's shared-encoding serving-wall hypothesis has
+            # numbers before anyone builds the fast path
+            delivered = stats["stream"]["counters"]["ctrl.stream.delivered"]
+            assert (
+                stats["stream"]["counters"]["ctrl.stream.encode_bytes"] > 0
+            )
+            encode_hist = server.stream_manager.histograms[
+                "ctrl.stream.encode_ms"
+            ]
+            # snapshot + delta both encode; delivered counts deltas only
+            assert encode_hist.count >= delivered + 1 >= 2
             await client.close()
             await server.stop()
             store.stop()
