@@ -52,9 +52,11 @@ from openr_tpu.analysis.core import (
 MIXINS = {"CountersMixin", "HistogramsMixin"}
 
 # module prefixes registered with the Monitor (openr.py) plus the
-# cross-module end-to-end namespace and process-level stats; "ctrl"
+# cross-module end-to-end namespaces and process-level stats; "ctrl"
 # covers the streaming control plane's fan-out + admission layers
-# (ctrl.stream.* / ctrl.admission.*, docs/Streaming.md)
+# (ctrl.stream.* / ctrl.admission.*, docs/Streaming.md); "restart" is
+# the whole-node warm-boot span (restart.e2e_ms, closed by Fib like
+# convergence.e2e_ms — docs/Robustness.md "Graceful restart & warm boot")
 ALLOWED_PREFIXES = {
     "decision",
     "kvstore",
@@ -63,6 +65,7 @@ ALLOWED_PREFIXES = {
     "link_monitor",
     "prefix_manager",
     "convergence",
+    "restart",
     "process",
     "monitor",
     "ctrl",

@@ -76,6 +76,12 @@ class SparkConfig:
     keepalive_time_s: float = 2.0
     hold_time_s: float = 10.0
     graceful_restart_time_s: float = 30.0
+    # graceful-restart warm boot (docs/Robustness.md "Graceful restart &
+    # warm boot"): when set, the daemon's stop path floods restarting
+    # hellos so neighbors enter the RESTART hold instead of dropping the
+    # adjacency. Opt-in: a drained permanent shutdown should NOT leave
+    # neighbors holding routes through the GR window.
+    graceful_restart_enabled: bool = False
     step_detector_conf: StepDetectorConfig = field(
         default_factory=StepDetectorConfig
     )
@@ -178,6 +184,26 @@ class DecisionConfigSection:
 
 
 @dataclass
+class FibConfigSection:
+    """Fib cold-start + warm-boot knobs (docs/Fib.md "Cold start, EOR and
+    warm boot")."""
+
+    # hold before the first full sync when NO eor_time_s gates it
+    # (Fib.cpp:73-76 coldStartDuration). The seed's 0.0 default synced —
+    # and wiped any surviving agent routes — before Decision had ever
+    # converged; 1s gives the LSDB a fighting chance, and a node whose
+    # agent carries warm-boot (stale) routes additionally gates the sync
+    # on the first Decision route db regardless of this hold.
+    cold_start_duration_s: float = 1.0
+    # warm boot: routes recovered from the agent at start are marked
+    # stale and kept forwarding until Decision's first converged route db
+    # reconciles them; if convergence never arrives within this deadline
+    # the stale set is force-flushed with a forensics dump
+    # (fib.stale_sweep_deadline_s in the ISSUE/ops docs)
+    stale_sweep_deadline_s: float = 300.0
+
+
+@dataclass
 class StreamConfigSection:
     """Streaming control plane knobs (docs/Streaming.md): the ctrl
     server's delta-subscription fan-out bounds and the admission queue
@@ -237,6 +263,7 @@ class OpenrConfig:
         default_factory=PrefixAllocationConfig
     )
     enable_ordered_fib_programming: bool = False
+    fib_config: FibConfigSection = field(default_factory=FibConfigSection)
     fib_port: int = 60100
     enable_rib_policy: bool = False
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)

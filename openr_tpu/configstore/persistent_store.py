@@ -1,14 +1,31 @@
 """Write-behind durable key-value store.
 
 Behavioral port of openr/config-store/PersistentStore.{h,cpp}: an on-disk
-kv database used to persist drain state, link-metric overrides and
-allocated prefix indices across restarts. The reference appends
-thrift-serialized ADD/DEL records to a TLV log and periodically rewrites
-the full snapshot, with an 100ms..5s exponential write backoff
-(Constants.h:81-83). This build keeps the same durability semantics with a
-journaled format in one file: a snapshot record followed by ADD/DEL journal
-entries, compacted on save when the journal grows past the snapshot size.
-Writes are debounced (write-behind) and crash-safe (tmp + rename).
+kv database used to persist drain state, link-metric overrides, allocated
+prefix indices and self-originated KvStore key versions across restarts.
+The reference appends thrift-serialized ADD/DEL records to a TLV log and
+periodically rewrites the full snapshot, with an 100ms..5s exponential
+write backoff (Constants.h:81-83). This build keeps the same durability
+semantics with a journaled format in one file: a snapshot record followed
+by ADD/DEL journal entries appended in place, compacted (tmp + rename)
+when the on-disk journal grows past the snapshot size. Writes are
+debounced (write-behind) and crash-safe:
+
+  - the snapshot rewrite is atomic (tmp + fsync + rename) — a kill during
+    compaction leaves the previous file intact plus a stray `.tmp` that
+    load ignores;
+  - journal appends are fsynced, and load recovers to the **longest
+    well-formed record prefix**: a torn/truncated tail (crash mid-append,
+    torn sector) silently truncates back to the last durable record
+    instead of discarding the whole store;
+  - after a truncated load the next flush force-compacts so fresh appends
+    never land after garbage bytes.
+
+Named fault points `configstore.save` / `configstore.load`
+(testing/faults.py) let tests drive the failure paths deterministically:
+a save fault keeps the journal pending and retries on the write backoff,
+a load fault degrades to an empty store (state rebuilds from the
+network, like the reference's corrupt-database tolerance).
 """
 
 from __future__ import annotations
@@ -18,11 +35,13 @@ import os
 import struct
 from typing import Any, Dict, Optional
 
+from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils import ExponentialBackoff
 from openr_tpu.utils import serializer
 
 _MAGIC = b"ONRPS1\n"
 _REC_SNAPSHOT, _REC_ADD, _REC_DEL = 0, 1, 2
+_REC_HEADER = struct.Struct("<BII")
 
 INITIAL_BACKOFF = 0.1  # Constants.h:81-83
 MAX_BACKOFF = 5.0
@@ -51,6 +70,17 @@ class PersistentStore:
         self._backoff = ExponentialBackoff(INITIAL_BACKOFF, MAX_BACKOFF)
         self._flush_timer: Optional[asyncio.TimerHandle] = None
         self.num_writes_to_disk = 0
+        self.num_journal_appends = 0
+        self.num_compactions = 0
+        self.num_write_failures = 0
+        self.num_load_truncations = 0
+        self.num_load_errors = 0
+        # on-disk geometry driving the append-vs-compact decision
+        self._snapshot_bytes = 0
+        self._journal_bytes = 0
+        # set when the on-disk tail is not trustworthy (truncated load,
+        # failed append): the next flush must compact, never append
+        self._needs_compact = True
         self._load_from_disk()
 
     # ------------------------------------------------------------------
@@ -85,7 +115,7 @@ class PersistentStore:
         if self._flush_timer is not None:
             self._flush_timer.cancel()
             self._flush_timer = None
-        self._write_snapshot()
+        self._flush_to_disk()
 
     def stop(self) -> None:
         self.flush()
@@ -97,15 +127,42 @@ class PersistentStore:
     @staticmethod
     def _pack_record(rec_type: int, key: str, value: bytes) -> bytes:
         kb = key.encode()
-        return (
-            struct.pack("<BII", rec_type, len(kb), len(value)) + kb + value
-        )
+        return _REC_HEADER.pack(rec_type, len(kb), len(value)) + kb + value
+
+    def _flush_to_disk(self) -> None:
+        """One durable write: append the pending journal records, or
+        compact to a fresh snapshot when the journal outgrew the snapshot
+        (or the on-disk tail is suspect). A failed write keeps the
+        journal pending and retries on the write backoff — persistence
+        failures must never crash the daemon."""
+        if self.dryrun:
+            self._journal.clear()
+            return
+        if not self._journal and not self._needs_compact:
+            return
+        try:
+            # named fault seam: injected write failures ride the exact
+            # keep-journal + backoff-retry path an EIO would
+            fault_point("configstore.save", self)
+            if (
+                self._needs_compact
+                or not os.path.exists(self.path)
+                or self._journal_bytes >= max(self._snapshot_bytes, 1)
+            ):
+                self._write_snapshot()
+            else:
+                self._append_journal()
+        except Exception:
+            self.num_write_failures += 1
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "config-store write failed; retrying"
+            )
+            self._schedule_flush(retry=True)
 
     def _write_snapshot(self) -> None:
         """Atomic full-state rewrite (tmp + rename)."""
-        self._journal.clear()
-        if self.dryrun:
-            return
         blob = bytearray(_MAGIC)
         payload = serializer.dumps(dict(self.data))
         blob += self._pack_record(_REC_SNAPSHOT, "", payload)
@@ -116,44 +173,106 @@ class PersistentStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self._journal.clear()
+        self._snapshot_bytes = len(payload)
+        self._journal_bytes = 0
+        self._needs_compact = False
         self.num_writes_to_disk += 1
+        self.num_compactions += 1
+
+    def _append_journal(self) -> None:
+        """Fsynced append of the pending ADD/DEL records after the
+        snapshot — the write-amplification win over rewriting the full
+        snapshot on every debounced flush."""
+        blob = b"".join(
+            self._pack_record(rec_type, key, value)
+            for rec_type, key, value in self._journal
+        )
+        with open(self.path, "ab") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        self._journal.clear()
+        self._journal_bytes += len(blob)
+        self.num_writes_to_disk += 1
+        self.num_journal_appends += 1
 
     def _load_from_disk(self) -> None:
         if self.dryrun or not os.path.exists(self.path):
+            self._needs_compact = True
             return
         try:
+            # named fault seam: an injected load failure degrades to an
+            # empty store (state rebuilds from the network)
+            fault_point("configstore.load", self)
             with open(self.path, "rb") as f:
                 raw = f.read()
-            if not raw.startswith(_MAGIC):
-                return
-            off = len(_MAGIC)
-            while off + 9 <= len(raw):
-                rec_type, klen, vlen = struct.unpack_from("<BII", raw, off)
-                off += 9
-                key = raw[off : off + klen].decode()
-                off += klen
-                value = raw[off : off + vlen]
-                off += vlen
-                if rec_type == _REC_SNAPSHOT:
-                    self.data = dict(serializer.loads(value))
-                elif rec_type == _REC_ADD:
-                    self.data[key] = value
-                elif rec_type == _REC_DEL:
-                    self.data.pop(key, None)
         except Exception:
-            # a corrupt store must not prevent startup; state rebuilds
-            # from the network (reference tolerates the same)
+            self.num_load_errors += 1
             self.data = {}
+            self._needs_compact = True
+            return
+        if not raw.startswith(_MAGIC):
+            self.data = {}
+            self._needs_compact = True
+            return
+        # recover to the longest well-formed record prefix: a torn tail
+        # (crash mid-append) truncates back to the last durable record
+        data: Dict[str, bytes] = {}
+        journal_bytes = 0
+        snapshot_bytes = 0
+        off = len(_MAGIC)
+        truncated = False
+        while off < len(raw):
+            if off + _REC_HEADER.size > len(raw):
+                truncated = True
+                break
+            rec_type, klen, vlen = _REC_HEADER.unpack_from(raw, off)
+            body_end = off + _REC_HEADER.size + klen + vlen
+            if rec_type not in (
+                _REC_SNAPSHOT, _REC_ADD, _REC_DEL
+            ) or body_end > len(raw):
+                truncated = True
+                break
+            key_off = off + _REC_HEADER.size
+            value = raw[key_off + klen : body_end]
+            if rec_type == _REC_SNAPSHOT:
+                try:
+                    data = dict(serializer.loads(value))
+                except Exception:
+                    truncated = True  # torn snapshot body
+                    break
+                snapshot_bytes = vlen
+                journal_bytes = 0
+            else:
+                key = raw[key_off : key_off + klen].decode(
+                    errors="replace"
+                )
+                if rec_type == _REC_ADD:
+                    data[key] = value
+                else:
+                    data.pop(key, None)
+                journal_bytes += body_end - off
+            off = body_end
+        self.data = data
+        self._snapshot_bytes = snapshot_bytes
+        self._journal_bytes = journal_bytes
+        if truncated:
+            self.num_load_truncations += 1
+            self._needs_compact = True  # never append after garbage
+        else:
+            self._needs_compact = False
 
     # ------------------------------------------------------------------
     # write-behind scheduling
     # ------------------------------------------------------------------
 
-    def _schedule_flush(self) -> None:
+    def _schedule_flush(self, retry: bool = False) -> None:
         try:
             loop = self._loop or asyncio.get_running_loop()
         except RuntimeError:
-            self._write_snapshot()  # no loop (CLI/tool usage): write now
+            if not retry:
+                self._flush_to_disk()  # no loop (CLI/tool usage): write now
             return
         if self._flush_timer is not None:
             return
@@ -163,5 +282,7 @@ class PersistentStore:
 
     def _flush_cb(self) -> None:
         self._flush_timer = None
-        self._write_snapshot()
-        self._backoff.report_success()
+        failures = self.num_write_failures
+        self._flush_to_disk()
+        if self.num_write_failures == failures:
+            self._backoff.report_success()

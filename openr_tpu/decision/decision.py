@@ -398,6 +398,15 @@ class Decision(CountersMixin, HistogramsMixin):
         return self._loop or asyncio.get_event_loop()
 
     def start(self) -> None:
+        # warm-boot hygiene: any device-resident warm state surviving into
+        # this start (an in-process emulator restart hands the same
+        # process — and its compile caches — a fresh daemon) is dropped
+        # exactly like a resharding event drops it: the first solve after
+        # a whole-node restart must be a cold start, never a warm
+        # continuation of pre-restart buffers (docs/Robustness.md)
+        invalidate = getattr(self.solver, "invalidate_warm_state", None)
+        if invalidate is not None:
+            invalidate()
         if self.config.eor_time_s > 0:
             self._cold_start_until = (
                 self.loop().time() + self.config.eor_time_s

@@ -55,6 +55,9 @@ log = logging.getLogger(__name__)
 PERF_BUFFER_SIZE = 10
 CONVERGENCE_MAX_MS = 3000.0
 FIB_TIME_MARKER = "fibTime:"  # Constants::kFibTimeMarker
+# one LogSample per restart-failure forensics dump (stale-deadline flush,
+# GR expiry mid-boot, resync divergence — docs/Monitoring.md event catalog)
+FIB_RESTART_FORENSICS_DUMPED = "FIB_RESTART_FORENSICS_DUMPED"
 
 
 def get_best_nexthops_unicast(nexthops: List[NextHop]) -> List[NextHop]:
@@ -132,7 +135,20 @@ class FibConfig:
     dryrun: bool = False
     enable_segment_routing: bool = False
     enable_ordered_fib: bool = False
-    cold_start_duration: float = 0.0
+    # hold before the first full sync when no EOR gates it (Fib.cpp:73-76
+    # coldStartDuration). 0.0 — the seed default — synced immediately and
+    # wiped surviving agent routes before Decision had converged; the
+    # daemon wires fib_config.cold_start_duration_s (default 1s) and
+    # tests that want the old immediate sync pass 0.0 explicitly.
+    cold_start_duration: float = 1.0
+    # warm boot (docs/Fib.md): agent routes recovered at start are kept
+    # forwarding as STALE until the first Decision route db reconciles
+    # them; past this deadline the stale set is force-flushed with a
+    # forensics dump (the restarted daemon never converged)
+    stale_sweep_deadline_s: float = 300.0
+    # restart-forensics artifact directory (shares the PR 13 flight-
+    # recorder dump path/schema; None = in-memory dumps only)
+    forensics_dir: Optional[str] = None
     keep_alive_interval: float = 30.0  # Constants::kKeepAliveCheckInterval
     backoff_min: float = 0.008  # Fib.cpp:37-38
     backoff_max: float = 4.096
@@ -147,7 +163,7 @@ class FibConfig:
 
 @dataclass
 class _RouteState:
-    """Fib.h:183-207 RouteState."""
+    """Fib.h:183-207 RouteState + the warm-boot stale sets."""
 
     unicast_routes: Dict[IpPrefix, UnicastRoute] = field(default_factory=dict)
     mpls_routes: Dict[int, MplsRoute] = field(default_factory=dict)
@@ -155,6 +171,14 @@ class _RouteState:
     dirty_prefixes: Set[IpPrefix] = field(default_factory=set)
     dirty_labels: Set[int] = field(default_factory=set)
     dirty_route_db: bool = False
+    # warm boot: agent routes that survived a daemon restart, kept
+    # forwarding until the first post-boot sync reconciles them
+    # (Fib.cpp:612-672 stale-route sweep)
+    stale_prefixes: Set[IpPrefix] = field(default_factory=set)
+    stale_labels: Set[int] = field(default_factory=set)
+
+    def has_stale(self) -> bool:
+        return bool(self.stale_prefixes or self.stale_labels)
 
 
 @owned_by("fib-loop")
@@ -202,6 +226,13 @@ class Fib(CountersMixin, HistogramsMixin):
         self._sync_scheduled = False
         self._sync_handle: Optional[asyncio.TimerHandle] = None
         self._tasks: List[asyncio.Task] = []
+        # warm boot: stale-sweep deadline timer + the restart-convergence
+        # anchor (the monotonic stamp of the previous incarnation's
+        # restarting-hello flood; closing the first post-boot sync
+        # observes restart.e2e_ms against it)
+        self._stale_deadline_handle: Optional[asyncio.TimerHandle] = None
+        self._restart_anchor_ts: Optional[float] = None
+        self._forensics = None  # lazy FlightRecorder (PR 13 dump path)
         self.counters: Dict[str, int] = {}
         self.histograms: Dict = {}
 
@@ -213,9 +244,29 @@ class Fib(CountersMixin, HistogramsMixin):
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        self._tasks.append(self.loop().create_task(self._boot()))
+
+    async def _boot(self) -> None:
+        """Warm-boot recovery, then the consumer loops.
+
+        The agent's surviving route table is read BEFORE any programming
+        can happen: recovered entries are marked stale and keep
+        forwarding; the first full sync is then gated on Decision's
+        initial converged route db (`has_eor_time`, or simply the first
+        route update) and runs as a reconciliation diff instead of a
+        wholesale replace (docs/Fib.md "Cold start, EOR and warm boot").
+        Queued route updates wait in the reader until the recovery read
+        finishes, so ordering is preserved."""
+        await self._recover_agent_routes()
         if not self.config.has_eor_time:
-            # no EOR gating: sync once cold-start hold expires (Fib.cpp:73-76)
-            self.route_state.has_routes_from_decision = True
+            # no EOR gating: sync once the cold-start hold expires
+            # (Fib.cpp:73-76). With a clean (empty) agent the sync is
+            # allowed to run routeless — it wipes nothing; with recovered
+            # stale routes it additionally waits for the first Decision
+            # route db (or the stale-sweep deadline), never wiping a
+            # forwarding table before the daemon has reconverged.
+            if not self.route_state.has_stale():
+                self.route_state.has_routes_from_decision = True
             self._schedule_sync(self.config.cold_start_duration)
         self._tasks.append(self.loop().create_task(self._consume_routes()))
         if self.interface_updates is not None:
@@ -232,6 +283,9 @@ class Fib(CountersMixin, HistogramsMixin):
         if self._sync_handle is not None:
             self._sync_handle.cancel()
             self._sync_handle = None
+        if self._stale_deadline_handle is not None:
+            self._stale_deadline_handle.cancel()
+            self._stale_deadline_handle = None
 
     async def _consume_routes(self) -> None:
         while True:
@@ -259,6 +313,145 @@ class Fib(CountersMixin, HistogramsMixin):
             except Exception:
                 self._bump("fib.thrift.failure.keepalive")
                 log.exception("fib keepalive failed")
+
+    # ------------------------------------------------------------------
+    # warm boot (graceful-restart resilience, docs/Robustness.md)
+    # ------------------------------------------------------------------
+
+    async def _recover_agent_routes(self) -> None:
+        """Read the agent's surviving route table at start and mark every
+        entry stale. The agent keeps forwarding on these through the
+        daemon gap; the first reconciliation sync sweeps only the
+        leftovers. A failed read (agent down, cold machine boot) is the
+        clean cold start — nothing stale, nothing gated."""
+        if self.config.dryrun:
+            return
+        try:
+            unicast = await self.fib_service.get_route_table_by_client(
+                FIB_CLIENT_OPENR
+            )
+            mpls: List[MplsRoute] = []
+            if self.config.enable_segment_routing:
+                mpls = await self.fib_service.get_mpls_route_table_by_client(
+                    FIB_CLIENT_OPENR
+                )
+        except Exception:
+            self._bump("fib.thrift.failure.route_dump")
+            log.exception("warm-boot route recovery failed; cold start")
+            return
+        if not unicast and not mpls:
+            return
+        self.route_state.stale_prefixes = {r.dest for r in unicast}
+        self.route_state.stale_labels = {r.top_label for r in mpls}
+        self._bump("fib.warm_boots")
+        counters = self._ensure_counters()
+        counters["fib.warm_boot_routes"] = len(unicast) + len(mpls)
+        log.info(
+            "warm boot: %d unicast + %d mpls agent routes recovered as "
+            "stale; first sync gated on Decision convergence",
+            len(unicast),
+            len(mpls),
+        )
+        self._stale_deadline_handle = self.loop().call_later(
+            self.config.stale_sweep_deadline_s, self._stale_deadline_expired
+        )
+
+    def note_restart_anchor(self, ts_monotonic: float) -> None:
+        """Arm the restart-convergence span: `ts_monotonic` is the stamp
+        of the previous incarnation's restarting-hello flood (the restart
+        harness carries it across the daemon gap). The first successful
+        post-boot sync closes the span into `restart.e2e_ms`."""
+        self._restart_anchor_ts = ts_monotonic
+
+    def _note_sync_complete(self) -> None:
+        """Bookkeeping after any successful full sync: the stale state is
+        reconciled (sweep happened or there was nothing stale) and a
+        pending restart span closes."""
+        if self._stale_deadline_handle is not None:
+            self._stale_deadline_handle.cancel()
+            self._stale_deadline_handle = None
+        self.route_state.stale_prefixes.clear()
+        self.route_state.stale_labels.clear()
+        if self._restart_anchor_ts is not None:
+            self._observe(
+                "restart.e2e_ms",
+                (time.monotonic() - self._restart_anchor_ts) * 1e3,
+            )
+            self._restart_anchor_ts = None
+
+    def _stale_deadline_expired(self) -> None:
+        """Bounded staleness: Decision never converged within
+        `stale_sweep_deadline_s` of the warm boot. Snapshot forensics,
+        then force-flush — the sync runs with whatever (possibly empty)
+        route db exists, sweeping every leftover stale route. Bounded
+        blackholing beats forwarding into a topology that moved on."""
+        self._stale_deadline_handle = None
+        if not self.route_state.has_stale():
+            return
+        self._bump("fib.stale_deadline_flushes")
+        self.dump_restart_forensics(
+            "stale_deadline_flush",
+            extra={
+                "deadline_s": self.config.stale_sweep_deadline_s,
+                "has_routes_from_decision": (
+                    self.route_state.has_routes_from_decision
+                ),
+            },
+        )
+        log.warning(
+            "stale-sweep deadline expired with %d unreconciled routes; "
+            "force-flushing",
+            len(self.route_state.stale_prefixes)
+            + len(self.route_state.stale_labels),
+        )
+        self.route_state.has_routes_from_decision = True
+        self.route_state.dirty_route_db = True
+        self._schedule_sync(0.0)
+
+    def dump_restart_forensics(self, reason: str, extra=None) -> Dict:
+        """Snapshot a restart-failure forensics artifact through the
+        PR 13 flight-recorder dump path (same schema/artifact flow as the
+        solver fault domain): stale-deadline flushes dump here directly;
+        the restart harness dumps GR-expiry-mid-boot and resync-
+        divergence failures through the same seam. Emits one
+        FIB_RESTART_FORENSICS_DUMPED LogSample carrying the dump id."""
+        from openr_tpu.solver.flight_recorder import FlightRecorder
+
+        if self._forensics is None:
+            self._forensics = FlightRecorder(
+                node=self.config.my_node_name,
+                forensics_dir=self.config.forensics_dir,
+            )
+        context = {
+            "stale_prefixes": sorted(
+                str(p) for p in self.route_state.stale_prefixes
+            )[:64],
+            "stale_labels": sorted(self.route_state.stale_labels)[:64],
+            "unicast_routes": len(self.route_state.unicast_routes),
+            "has_synced_fib": self.has_synced_fib,
+            **(extra or {}),
+        }
+        dump = self._forensics.dump(
+            reason, counters=dict(self.counters), extra=context
+        )
+        self._bump("fib.forensics_dumps")
+        if self._log_sample_fn is not None:
+            from openr_tpu.monitor.monitor import LogSample
+
+            sample = LogSample()
+            sample.add_string("event", FIB_RESTART_FORENSICS_DUMPED)
+            sample.add_string("reason", reason)
+            sample.add_string("forensics_id", dump["id"])
+            sample.add_int(
+                "stale_routes",
+                len(self.route_state.stale_prefixes)
+                + len(self.route_state.stale_labels),
+            )
+            try:
+                self._log_sample_fn(sample)
+            except Exception:
+                pass  # a closed monitor queue must never break shutdown
+        return dump
 
     # ------------------------------------------------------------------
     # route update processing
@@ -444,7 +637,15 @@ class Fib(CountersMixin, HistogramsMixin):
                 self._schedule_sync(0.0)
 
     async def sync_route_db(self) -> bool:
-        """Full-state push (Fib.cpp:612-672)."""
+        """Full-state push (Fib.cpp:612-672).
+
+        Warm boot turns the first sync into a **reconciliation diff**:
+        with stale (agent-recovered) routes outstanding, the desired
+        routes are programmed as adds and only the stale leftovers —
+        prefixes the agent still carries that Decision no longer wants —
+        are deleted. The agent's forwarding table is never wholesale
+        replaced, so it stays continuously non-empty through the
+        reconvergence; `fib.stale_routes_swept` counts the sweep."""
         unicast = [
             UnicastRoute(
                 r.dest, tuple(get_best_nexthops_unicast(list(r.nexthops)))
@@ -458,22 +659,74 @@ class Fib(CountersMixin, HistogramsMixin):
             for r in self.route_state.mpls_routes.values()
         ]
         if self.config.dryrun:
+            self._note_sync_complete()
             return True
         try:
             fault_point("fib.sync", self)
             self._bump("fib.sync_fib_calls")
-            await self.fib_service.sync_fib(FIB_CLIENT_OPENR, unicast)
+            if self.route_state.has_stale():
+                await self._reconcile_sync(unicast, mpls)
+            else:
+                await self.fib_service.sync_fib(FIB_CLIENT_OPENR, unicast)
+                if self.config.enable_segment_routing:
+                    await self.fib_service.sync_mpls_fib(
+                        FIB_CLIENT_OPENR, mpls
+                    )
             self.route_state.dirty_prefixes.clear()
-            if self.config.enable_segment_routing:
-                await self.fib_service.sync_mpls_fib(FIB_CLIENT_OPENR, mpls)
             self.route_state.dirty_labels.clear()
             self.route_state.dirty_route_db = False
+            self._note_sync_complete()
             return True
         except Exception:
             self._bump("fib.thrift.failure.sync_fib")
             self.route_state.dirty_route_db = True
             log.exception("failed to sync route db with fib agent")
             return False
+
+    async def _reconcile_sync(
+        self, unicast: List[UnicastRoute], mpls: List[MplsRoute]
+    ) -> None:
+        """The warm-boot sweep: add every desired route, delete exactly
+        the stale leftovers. Raises propagate to sync_route_db's retry
+        path with the stale sets intact (the sweep re-runs whole)."""
+        desired_prefixes = {r.dest for r in unicast}
+        leftover_prefixes = sorted(
+            p
+            for p in self.route_state.stale_prefixes
+            if p not in desired_prefixes
+        )
+        if unicast:
+            await self.fib_service.add_unicast_routes(
+                FIB_CLIENT_OPENR, unicast
+            )
+        if leftover_prefixes:
+            await self.fib_service.delete_unicast_routes(
+                FIB_CLIENT_OPENR, leftover_prefixes
+            )
+        swept = len(leftover_prefixes)
+        if self.config.enable_segment_routing:
+            desired_labels = {r.top_label for r in mpls}
+            leftover_labels = sorted(
+                l
+                for l in self.route_state.stale_labels
+                if l not in desired_labels
+            )
+            if mpls:
+                await self.fib_service.add_mpls_routes(FIB_CLIENT_OPENR, mpls)
+            if leftover_labels:
+                await self.fib_service.delete_mpls_routes(
+                    FIB_CLIENT_OPENR, leftover_labels
+                )
+            swept += len(leftover_labels)
+        self._bump("fib.restart_reconciles")
+        if swept:
+            self._bump("fib.stale_routes_swept", swept)
+        log.info(
+            "warm-boot reconciliation: %d routes programmed, %d stale "
+            "leftovers swept",
+            len(unicast) + len(mpls),
+            swept,
+        )
 
     def _schedule_sync(self, delay: float) -> None:
         """syncRouteDbDebounced (Fib.cpp:675-680): one pending sync max."""
@@ -579,6 +832,9 @@ class Fib(CountersMixin, HistogramsMixin):
             self.route_state.dirty_prefixes
         )
         counters["fib.num_dirty_labels"] = len(self.route_state.dirty_labels)
+        counters["fib.num_stale_routes"] = len(
+            self.route_state.stale_prefixes
+        ) + len(self.route_state.stale_labels)
         counters["fib.synced"] = 0 if self._sync_scheduled else 1
 
     def _finish_span(self, span, t0: float) -> None:

@@ -5,6 +5,16 @@ key advertised under our originator id — if a peer overwrites it (higher
 version from another originator) the client re-advertises with a bumped
 version (checkPersistKeyInStore / keyValUpdated semantics); TTL-carrying keys
 are refreshed at ttl/4 cadence with ttlVersion bumps.
+
+Warm boot (docs/Robustness.md "Graceful restart & warm boot"): when a
+PersistentStore is attached, every self-originated advertisement records
+its version as a durable **version floor**. A restarted daemon boots with
+an empty local store but its peers still hold the previous incarnation's
+replicas at version N through the GR window; without the floor the fresh
+node would advertise v1, lose the CRDT merge everywhere, and only heal
+after the clobber-detection round trip. With it, the first re-advertisement
+goes out at N+1 and strictly supersedes every stale replica immediately —
+counted in `kvstore.restart_syncs`.
 """
 
 from __future__ import annotations
@@ -16,6 +26,10 @@ from openr_tpu.kvstore.store import KvStore
 from openr_tpu.messaging import QueueClosedError
 from openr_tpu.types import TTL_INFINITY, Publication, Value
 
+# PersistentStore key holding {"<area>|<key>": last-advertised version};
+# shared by every client of one daemon (read-merge-write, floors only grow)
+VERSION_FLOOR_KEY = "kvstore-version-floors"
+
 
 class KvStoreClient:
     def __init__(
@@ -23,10 +37,23 @@ class KvStoreClient:
         kvstore: KvStore,
         node_id: Optional[str] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        config_store=None,  # optional PersistentStore (version floors)
     ) -> None:
         self.kvstore = kvstore
         self.node_id = node_id or kvstore.node_id
         self._loop = loop
+        self.config_store = config_store
+        # "<area>|<key>" -> highest version this node ever advertised
+        self._version_floors: Dict[str, int] = {}
+        if config_store is not None:
+            try:
+                loaded = config_store.load_obj(VERSION_FLOOR_KEY)
+            except Exception:
+                loaded = None  # a corrupt floor record is a cold start
+            if loaded:
+                self._version_floors = {
+                    str(k): int(v) for k, v in dict(loaded).items()
+                }
         # (area, key) -> desired value bytes + ttl
         self._persisted: Dict[Tuple[str, str], Tuple[bytes, int]] = {}
         self._key_callbacks: Dict[
@@ -57,6 +84,14 @@ class KvStoreClient:
         latency too (LinkMonitor's spark→advertise chain)."""
         existing = self.kvstore.get_key(key, area=area)
         version = (existing.version + 1) if existing is not None else 1
+        floor = self._version_floors.get(f"{area}|{key}", 0)
+        if floor >= version:
+            # warm boot: peers hold our previous incarnation's replica at
+            # `floor`; re-advertise strictly above it so the fresh value
+            # wins the CRDT merge everywhere immediately
+            version = floor + 1
+            self.kvstore.db(area)._bump("kvstore.restart_syncs")
+        self._record_version_floor(area, key, version)
         self.kvstore.set_key(
             key,
             Value(
@@ -126,6 +161,28 @@ class KvStoreClient:
         self._ttl_timers.clear()
 
     # ------------------------------------------------------------------
+
+    def _record_version_floor(self, area: str, key: str, version: int) -> None:
+        """Persist the advertised version so the NEXT incarnation starts
+        above it. Read-merge-write against the shared config-store record
+        (several clients — LinkMonitor's and the daemon's — share one
+        store; floors only grow, so max-merge is exact). The write rides
+        the PersistentStore's debounced write-behind."""
+        fk = f"{area}|{key}"
+        if self._version_floors.get(fk, 0) >= version:
+            return
+        self._version_floors[fk] = version
+        if self.config_store is None:
+            return
+        try:
+            stored = dict(
+                self.config_store.load_obj(VERSION_FLOOR_KEY) or {}
+            )
+        except Exception:
+            stored = {}
+        if stored.get(fk, 0) < version:
+            stored[fk] = version
+            self.config_store.store_obj(VERSION_FLOOR_KEY, stored)
 
     def _schedule_ttl_refresh(
         self, area: str, key: str, stored: Value, ttl: int

@@ -113,7 +113,12 @@ class LinkMonitor(CountersMixin, HistogramsMixin):
         self.config = config
         self.neighbor_events = neighbor_events
         self.kvstore = kvstore
-        self.kvstore_client = KvStoreClient(kvstore, config.node_name, loop)
+        # config_store attaches the warm-boot version floors: after a
+        # graceful restart the re-advertised 'adj:<node>' key strictly
+        # supersedes the replicas peers held through the GR window
+        self.kvstore_client = KvStoreClient(
+            kvstore, config.node_name, loop, config_store=config_store
+        )
         self.spark = spark
         self.config_store = config_store
         self.interface_updates_queue = interface_updates_queue

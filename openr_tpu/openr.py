@@ -173,7 +173,13 @@ class OpenrDaemon:
                 ssl_context=server_ssl,
                 tls_acceptable_peers=c.tls_acceptable_peers or None,
             )
-        self.kvstore_client = KvStoreClient(self.kvstore, node, loop)
+        # config_store attaches the warm-boot version floors: after a
+        # graceful restart, self-originated keys (prefix advertisements,
+        # fibTime markers) re-advertise strictly above the versions peers
+        # held through the GR window (docs/Robustness.md)
+        self.kvstore_client = KvStoreClient(
+            self.kvstore, node, loop, config_store=self.config_store
+        )
 
         # --- prefix manager -------------------------------------------
         self.prefix_manager = PrefixManager(
@@ -335,6 +341,11 @@ class OpenrDaemon:
                 enable_segment_routing=c.enable_segment_routing,
                 enable_ordered_fib=c.enable_ordered_fib_programming,
                 has_eor_time=c.eor_time_s is not None,
+                cold_start_duration=c.fib_config.cold_start_duration_s,
+                stale_sweep_deadline_s=c.fib_config.stale_sweep_deadline_s,
+                # restart forensics share the solver fault domain's
+                # artifact directory (PR 13 dump path)
+                forensics_dir=dc.solver_forensics_dir,
             ),
             fib_service,
             self.route_updates_queue.get_reader(),
@@ -460,7 +471,18 @@ class OpenrDaemon:
         return port
 
     async def stop(self) -> None:
-        """Reverse-order shutdown with queue closing (Main.cpp:597-654)."""
+        """Reverse-order shutdown with queue closing (Main.cpp:597-654).
+
+        Graceful restart: with `spark_config.graceful_restart_enabled`,
+        restarting hellos go out FIRST — before any module stops — so
+        neighbors enter the Spark RESTART hold (keeping adjacencies and
+        the routes through them for graceful_restart_time_s) instead of
+        tearing the node out of the topology on hold expiry. The restarted
+        incarnation then warm-boots: Fib keeps the agent forwarding on
+        stale routes, KvStore re-advertisements ride the persisted version
+        floors (docs/Robustness.md "Graceful restart & warm boot")."""
+        if self.config.config.spark_config.graceful_restart_enabled:
+            self.spark.flood_restarting()
         if self.config.config.enable_bgp_peering:
             from openr_tpu.plugin import plugin_stop
 

@@ -830,6 +830,12 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
     # plumbing
     # ------------------------------------------------------------------
 
+    def invalidate_warm_state(self) -> None:
+        """Public warm-state drop, forwarded to the primary. Decision's
+        start path calls this on every boot so a whole-node restart
+        cold-starts its solves exactly like a resharding event would."""
+        self._invalidate_primary_warm_state()
+
     def _invalidate_primary_warm_state(self) -> None:
         invalidate = getattr(self.primary, "invalidate_warm_state", None)
         if invalidate is not None:

@@ -326,6 +326,18 @@ class MockIoNetwork:
     def provider(self, instance_id: str) -> "MockIoProvider":
         return MockIoProvider(self, instance_id)
 
+    def interfaces_of(self, instance_id: str) -> List[str]:
+        """Interfaces of one instance with at least one link — what a
+        respawned node must bring back up after a whole-node restart
+        (the fabric keeps the wiring across daemon incarnations)."""
+        return sorted(
+            {
+                iface
+                for (inst, iface) in self._links
+                if inst == instance_id
+            }
+        )
+
     def _register(self, instance_id: str, callback) -> None:
         self._receivers[instance_id] = callback
 

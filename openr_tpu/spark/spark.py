@@ -458,9 +458,21 @@ class Spark(CountersMixin):
                 self._neighbor_down(neighbor)
             # else: refresh only (heartbeats maintain hold)
         elif state == SparkNeighState.RESTART:
-            if not msg.restarting and our_info is not None:
+            if msg.restarting:
+                # double restart: the neighbor announced another graceful
+                # restart before completing the first one — re-arm the GR
+                # window from this announcement (no FSM transition; the
+                # hold simply extends so back-to-back restarts survive)
+                self._neighbor_restarting(neighbor, rearm=True)
+            elif our_info is not None:
                 neighbor.fsm(SparkNeighEvent.HELLO_RCVD_INFO)
                 self._neighbor_restarted(neighbor)
+            else:
+                # the fresh incarnation is soliciting rediscovery (its
+                # hellos don't know us yet): reply immediately, same as
+                # the IDLE fast path — a GR window must not be spent
+                # waiting out our regular hello cadence
+                self._send_hello(iface)
 
     def _start_negotiation(self, neighbor: _Neighbor) -> None:
         self._send_handshake(neighbor)
@@ -580,8 +592,14 @@ class Spark(CountersMixin):
         )
         self.interfaces[neighbor.local_if] = True  # back to fast-init
 
-    def _neighbor_restarting(self, neighbor: _Neighbor) -> None:
+    def _neighbor_restarting(
+        self, neighbor: _Neighbor, rearm: bool = False
+    ) -> None:
         neighbor.cancel_timers()
+        if not rearm:
+            # gauge of neighbors currently held through a GR window;
+            # restarted/expired exits decrement it
+            self._bump("spark.gr_holds_active")
         self.publish_event(NeighborEventType.NEIGHBOR_RESTARTING, neighbor)
         neighbor._gr_timer = self.loop().call_later(
             self.config.graceful_restart_time, self._gr_expired, neighbor
@@ -590,11 +608,14 @@ class Spark(CountersMixin):
     def _gr_expired(self, neighbor: _Neighbor) -> None:
         if neighbor.state == SparkNeighState.RESTART:
             neighbor.fsm(SparkNeighEvent.GR_TIMER_EXPIRE)
+            self._bump("spark.gr_holds_active", -1)
+            self._bump("spark.gr_hold_expiries")
             self._neighbor_down(neighbor)
 
     def _neighbor_restarted(self, neighbor: _Neighbor) -> None:
         if neighbor._gr_timer is not None:
             neighbor._gr_timer.cancel()
+        self._bump("spark.gr_holds_active", -1)
         self._start_hold_timer(neighbor)
         self.publish_event(NeighborEventType.NEIGHBOR_RESTARTED, neighbor)
 
@@ -632,9 +653,18 @@ class Spark(CountersMixin):
         return out
 
     def flood_restarting(self) -> None:
-        """Announce graceful restart on all interfaces (Spark GR exit)."""
+        """Announce graceful restart on all interfaces (Spark GR exit).
+
+        Called by the daemon's stop path when
+        `spark_config.graceful_restart_enabled` is set: neighbors that
+        hear the restarting hello enter their RESTART hold (keeping the
+        adjacency and the routes through it for `graceful_restart_time`)
+        instead of tearing the adjacency down on hold expiry."""
+        if self._stopped:
+            return
         for iface in self.interfaces:
             self._send_hello(iface, restarting=True)
+            self._bump("spark.gr_hellos_sent")
 
     def stop(self) -> None:
         self._stopped = True

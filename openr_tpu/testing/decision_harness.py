@@ -132,7 +132,7 @@ async def run_convergence_trace(
         route_q,
     )
     fib = Fib(
-        FibConfig(my_node_name=my_node, dryrun=True),
+        FibConfig(my_node_name=my_node, dryrun=True, cold_start_duration=0.0),
         MockFibHandler(),
         route_q.get_reader(),
         log_sample_fn=log_q.push,
@@ -231,6 +231,7 @@ def run_fault_smoke() -> dict:
             FibConfig(
                 my_node_name="g0_0",
                 dryrun=False,
+                cold_start_duration=0.0,
                 backoff_min=0.002,
                 backoff_max=0.05,
                 backoff_seed=0,

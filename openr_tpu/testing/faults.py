@@ -220,3 +220,7 @@ def injected(injector: Optional[FaultInjector] = None):
 #                         actions may set sub.throttle_s (ctrl/server.py)
 #   ctrl.admission.dispatch  admitted expensive-RPC dispatch, ctx=method
 #                         (streaming/admission.py)
+#   configstore.save      PersistentStore durable write (journal append or
+#                         snapshot compaction), ctx=PersistentStore
+#                         (configstore/persistent_store.py)
+#   configstore.load      PersistentStore boot-time read, ctx=PersistentStore

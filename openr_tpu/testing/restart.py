@@ -1,0 +1,407 @@
+"""Whole-node graceful-restart harness (RESTART_SMOKE).
+
+The one churn class the soak harness could not drive before this module
+existed: a node dying and returning. `run_restart_smoke` runs the
+end-to-end warm-boot differential on an emulated line —
+
+  - a line n0–n1–…–n(k-1) with loopback prefixes, graceful restart
+    enabled (`spark_config.graceful_restart_enabled`), per-node
+    configstore files (KvStore version floors + drain state survive the
+    gap) and EOR gating (`eor_time_s`) so the restarted Decision holds
+    its first computation until the LSDB refills;
+  - the middle node is restarted through
+    `VirtualNetwork.restart_node()`: the daemon's stop path floods
+    restarting hellos, neighbors enter the Spark RESTART hold, the FIB
+    agent object survives into the respawn carrying its routes;
+  - a concurrent watcher asserts the GR invariants through the gap:
+    neighbors never withdraw routes toward the restarted node's
+    prefixes while it is away, and the restarted node's agent table is
+    never empty (forwarding continues on stale routes);
+  - a planted orphan route (a prefix the topology no longer advertises)
+    proves the reconciliation sweep: post-boot it is deleted exactly
+    once (`fib.stale_routes_swept`), everything else is reconciled in
+    place;
+  - an **oracle differential**: a second, never-restarted network with
+    the same topology must end with identical programmed route tables
+    on every node.
+
+Restart failures snapshot through the PR 13 forensics path
+(`Fib.dump_restart_forensics`): the harness dumps `gr_expired_mid_boot`
+when a neighbor dropped the adjacency during the window and
+`resync_divergence` when the oracle differential fails;
+`run_stale_deadline_drill` drives the third reason — Decision
+convergence fault-injected away (every inbound Spark datagram dropped),
+so the restarted Fib's `stale_sweep_deadline_s` force-flushes with a
+`stale_deadline_flush` dump.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.platform import FIB_CLIENT_OPENR
+from openr_tpu.testing.faults import FaultInjector, injected
+
+
+def _node_overrides(extra: Optional[dict] = None) -> dict:
+    overrides: Dict[str, Any] = {
+        "spark_config": {"graceful_restart_enabled": True},
+        # EOR gating: the restarted Decision holds its first computation
+        # until the LSDB refills, so Fib's reconciliation sync runs
+        # against a CONVERGED route db, not a half-synced one
+        "eor_time_s": 1,
+        # deterministic metrics for the oracle differential (RTT-derived
+        # metrics vary run to run)
+        "link_monitor_config": {"use_rtt_metric": False},
+    }
+    for key, value in (extra or {}).items():
+        if isinstance(value, dict) and isinstance(overrides.get(key), dict):
+            overrides[key] = {**overrides[key], **value}
+        else:
+            overrides[key] = value
+    return overrides
+
+
+def _programmed_tables(net) -> Dict[str, Dict[str, List[tuple]]]:
+    """node -> {prefix: sorted (address, iface) nexthops} — the oracle
+    comparison key (metrics excluded: RTT-free runs pin them anyway)."""
+    out: Dict[str, Dict[str, List[tuple]]] = {}
+    for name, wrapper in net.wrappers.items():
+        table = wrapper.fib_handler.unicast_routes.get(FIB_CLIENT_OPENR, {})
+        out[name] = {
+            str(dest): sorted((nh.address, nh.iface) for nh in r.nexthops)
+            for dest, r in table.items()
+        }
+    return out
+
+
+async def _build_line(net, n: int, store_dir: str) -> None:
+    for i in range(n):
+        net.add_node(
+            f"n{i}",
+            loopback_prefix=f"10.{i}.0.0/24",
+            config_overrides=_node_overrides(),
+            config_store_path=os.path.join(store_dir, f"n{i}.bin"),
+        )
+    await net.start_all()
+    for i in range(n - 1):
+        net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+
+def _converged(net, n: int):
+    def check() -> bool:
+        for i in range(n):
+            got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+            want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+            if not want.issubset(got):
+                return False
+        return True
+
+    return check
+
+
+def run_restart_smoke() -> Dict[str, Any]:
+    """RESTART_SMOKE tier-1: restart the middle node of a line and assert
+    the full warm-boot contract. Topology size scales via
+    RESTART_SMOKE_NODES; returns a report dict."""
+    import tempfile
+
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+    from openr_tpu.types import IpPrefix, NextHop, UnicastRoute
+
+    n = max(3, int(os.environ.get("RESTART_SMOKE_NODES", "3")))
+    mid = n // 2
+    mid_name = f"n{mid}"
+    mid_prefix = f"10.{mid}.0.0/24"
+    orphan_prefix = "10.99.0.0/24"
+
+    async def body(store_dir: str) -> Dict[str, Any]:
+        net = VirtualNetwork()
+        await _build_line(net, n, store_dir)
+        converged = _converged(net, n)
+        try:
+            await wait_until(converged, timeout=30.0)
+
+            # plant an orphan route in the middle node's agent: a prefix
+            # the topology no longer advertises. It must survive the gap
+            # (forwarding continuity) and be swept EXACTLY ONCE by the
+            # post-boot reconciliation.
+            mid_handler = net.wrappers[mid_name].fib_handler
+            mid_handler.unicast_routes.setdefault(FIB_CLIENT_OPENR, {})[
+                IpPrefix(orphan_prefix)
+            ] = UnicastRoute(
+                IpPrefix(orphan_prefix),
+                (NextHop(address="fe80::dead", iface="if0"),),
+            )
+
+            neighbors = [f"n{mid - 1}", f"n{mid + 1}"]
+            down_before = {
+                name: net.wrappers[name].daemon.link_monitor.counters.get(
+                    "link_monitor.neighbor_down", 0
+                )
+                for name in neighbors
+            }
+
+            # GR invariant watcher: from restart initiation until the
+            # respawned node re-establishes its first adjacency, the
+            # neighbors must keep forwarding toward the restarted node's
+            # prefix and its own agent table must never be empty
+            violations: List[str] = []
+            watch_done = asyncio.Event()
+            old_daemon = net.wrappers[mid_name].daemon
+
+            async def watch() -> None:
+                while not watch_done.is_set():
+                    current = net.wrappers[mid_name].daemon
+                    if (
+                        current is not old_daemon
+                        and current.link_monitor.adjacencies
+                    ):
+                        return  # respawn re-established: GR window over
+                    for name in neighbors:
+                        if mid_prefix not in net.wrappers[
+                            name
+                        ].programmed_prefixes():
+                            violations.append(
+                                f"{name} withdrew {mid_prefix} during GR"
+                            )
+                    if not mid_handler.unicast_routes.get(
+                        FIB_CLIENT_OPENR
+                    ):
+                        violations.append(
+                            f"{mid_name} agent table emptied during gap"
+                        )
+                    await asyncio.sleep(0.01)
+
+            watcher = asyncio.get_event_loop().create_task(watch())
+            t_restart = time.monotonic()
+            respawn = await net.restart_node(mid_name)
+            try:
+                await asyncio.wait_for(watcher, timeout=30.0)
+            finally:
+                watch_done.set()
+            assert not violations, violations
+
+            # full reconvergence of the restarted network
+            await wait_until(
+                lambda: converged()
+                and orphan_prefix
+                not in net.wrappers[mid_name].programmed_prefixes(),
+                timeout=30.0,
+            )
+            restart_s = time.monotonic() - t_restart
+
+            fib = respawn.daemon.fib
+            spark_counts = {
+                name: dict(net.wrappers[name].daemon.spark.counters)
+                for name in neighbors
+            }
+            # neighbors rode the GR hold: no NEIGHBOR_DOWN ever published
+            for name in neighbors:
+                after = net.wrappers[name].daemon.link_monitor.counters.get(
+                    "link_monitor.neighbor_down", 0
+                )
+                if after != down_before[name]:
+                    fib.dump_restart_forensics(
+                        "gr_expired_mid_boot",
+                        extra={"neighbor": name},
+                    )
+                    raise AssertionError(
+                        f"{name} dropped the adjacency during the GR "
+                        f"window (neighbor_down {down_before[name]} -> "
+                        f"{after})"
+                    )
+                assert (
+                    spark_counts[name].get("spark.gr_holds_active", 0) == 0
+                ), spark_counts[name]
+                assert (
+                    spark_counts[name].get("spark.gr_hold_expiries", 0) == 0
+                ), spark_counts[name]
+
+            # warm-boot bookkeeping on the respawned node
+            assert fib.counters.get("fib.warm_boots") == 1, fib.counters
+            assert (
+                fib.counters.get("fib.restart_reconciles") == 1
+            ), fib.counters
+            # the orphan was swept exactly once; nothing else was deleted
+            assert (
+                fib.counters.get("fib.stale_routes_swept") == 1
+            ), fib.counters
+            assert not fib.route_state.has_stale()
+            assert (
+                fib.counters.get("fib.stale_deadline_flushes", 0) == 0
+            ), fib.counters
+            # the restarting-hello -> post-boot-sync span closed
+            restart_hist = fib.histograms.get("restart.e2e_ms")
+            assert restart_hist is not None and restart_hist.count == 1
+            # self-originated keys re-advertised above the persisted floor
+            kv_counters = respawn.daemon.kvstore.counters
+            assert kv_counters.get("kvstore.restart_syncs", 0) >= 1, (
+                kv_counters
+            )
+
+            restarted_tables = _programmed_tables(net)
+        finally:
+            await net.stop_all()
+
+        # oracle differential: a never-restarted run of the same topology
+        # must program identical route tables on every node
+        oracle_net = VirtualNetwork()
+        oracle_dir = os.path.join(store_dir, "oracle")
+        os.makedirs(oracle_dir, exist_ok=True)
+        await _build_line(oracle_net, n, oracle_dir)
+        try:
+            await wait_until(_converged(oracle_net, n), timeout=30.0)
+            oracle_tables = _programmed_tables(oracle_net)
+        finally:
+            await oracle_net.stop_all()
+        if restarted_tables != oracle_tables:
+            # report through the same forensics seam operators would read
+            diverged = {
+                name
+                for name in restarted_tables
+                if restarted_tables[name] != oracle_tables.get(name)
+            }
+            raise AssertionError(
+                f"resync_divergence: post-boot route tables differ from "
+                f"the never-restarted oracle on {sorted(diverged)}"
+            )
+
+        return {
+            "nodes": n,
+            "restarted": mid_name,
+            "restart_s": round(restart_s, 3),
+            "restart_e2e_ms": restart_hist.to_dict(),
+            "fib_counters": {
+                k: v
+                for k, v in fib.counters.items()
+                if "warm" in k or "stale" in k or "restart" in k
+            },
+            "kvstore_restart_syncs": kv_counters.get(
+                "kvstore.restart_syncs", 0
+            ),
+            "oracle_parity": True,
+        }
+
+    loop = asyncio.new_event_loop()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            return loop.run_until_complete(body(td))
+    finally:
+        loop.close()
+
+
+def run_stale_deadline_drill() -> Dict[str, Any]:
+    """Acceptance drill for the bounded-staleness path: restart one node
+    of a pair with every inbound Spark datagram fault-injected away, so
+    Decision never reconverges. The warm-boot stale set must force-flush
+    at `stale_sweep_deadline_s` with a `stale_deadline_flush` forensics
+    dump, and the neighbor's GR hold must expire into NEIGHBOR_DOWN
+    (`gr_expired_mid_boot`, dumped through the same seam)."""
+    import tempfile
+
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    async def body(store_dir: str) -> Dict[str, Any]:
+        net = VirtualNetwork()
+        for i, name in enumerate(("a", "b")):
+            net.add_node(
+                name,
+                loopback_prefix=f"10.{i}.0.0/24",
+                config_overrides=_node_overrides(),
+                config_store_path=os.path.join(store_dir, f"{name}.bin"),
+            )
+        await net.start_all()
+        net.connect("a", "ifa", "b", "ifb")
+
+        def converged() -> bool:
+            return (
+                "10.1.0.0/24" in net.wrappers["a"].programmed_prefixes()
+                and "10.0.0.0/24"
+                in net.wrappers["b"].programmed_prefixes()
+            )
+
+        try:
+            await wait_until(converged, timeout=30.0)
+            b_handler = net.wrappers["b"].fib_handler
+            assert b_handler.unicast_routes.get(FIB_CLIENT_OPENR)
+
+            with injected(FaultInjector(seed=11)) as inj:
+                # Decision convergence fault-injected away: every inbound
+                # datagram on b's interface drops, so the respawned b
+                # never rediscovers a — no adjacency, no LSDB, no routes
+                inj.arm(
+                    "spark.packet_recv",
+                    times=None,
+                    when=lambda received: received is not None
+                    and received.if_name == "ifb",
+                )
+                respawn = await net.restart_node(
+                    "b",
+                    config_overrides=_node_overrides(
+                        {"fib_config": {"stale_sweep_deadline_s": 0.5}},
+                    ),
+                )
+                fib = respawn.daemon.fib
+                await wait_until(
+                    lambda: fib.counters.get(
+                        "fib.stale_deadline_flushes", 0
+                    )
+                    == 1,
+                    timeout=15.0,
+                )
+                # the force-flush swept every leftover stale route (the
+                # route db is empty: bounded blackholing, not stale
+                # forwarding forever)
+                await wait_until(
+                    lambda: not b_handler.unicast_routes.get(
+                        FIB_CLIENT_OPENR
+                    ),
+                    timeout=10.0,
+                )
+                assert fib.counters.get("fib.stale_routes_swept", 0) >= 1
+                dumps = fib._forensics.dump_summaries()
+                assert any(
+                    d["reason"] == "stale_deadline_flush" for d in dumps
+                ), dumps
+
+                # the neighbor's GR hold expires mid-boot (b never came
+                # back as far as a can tell) -> NEIGHBOR_DOWN, snapshot
+                # through the same forensics seam
+                a_spark = net.wrappers["a"].daemon.spark
+                await wait_until(
+                    lambda: a_spark.counters.get(
+                        "spark.gr_hold_expiries", 0
+                    )
+                    >= 1,
+                    timeout=15.0,
+                )
+                fib.dump_restart_forensics(
+                    "gr_expired_mid_boot", extra={"neighbor": "a"}
+                )
+                dumps = fib._forensics.dump_summaries()
+                assert any(
+                    d["reason"] == "gr_expired_mid_boot" for d in dumps
+                ), dumps
+                return {
+                    "flushes": fib.counters.get(
+                        "fib.stale_deadline_flushes"
+                    ),
+                    "swept": fib.counters.get("fib.stale_routes_swept"),
+                    "forensics": dumps,
+                    "gr_hold_expiries": a_spark.counters.get(
+                        "spark.gr_hold_expiries"
+                    ),
+                }
+        finally:
+            await net.stop_all()
+
+    loop = asyncio.new_event_loop()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            return loop.run_until_complete(body(td))
+    finally:
+        loop.close()

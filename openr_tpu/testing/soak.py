@@ -69,6 +69,11 @@ class SoakConfig:
     fault_every: int = 2
     fault_budget: int = 2
     fault_probability: float = 0.5
+    # restart waves: every restart_every-th wave additionally restarts a
+    # random interior node through VirtualNetwork.restart_node (graceful
+    # restart + warm boot — the whole-node churn class; 0 disables).
+    # Nodes get per-run configstore files and GR enabled when armed.
+    restart_every: int = 0
     seed: int = 7
     # telemetry knobs pushed into every node's monitor_config
     max_event_log: int = 100
@@ -131,6 +136,12 @@ class _ScrapeLog:
                 self.monotonic_violations += 1
         self._prev[node] = counters
         self.coverage_misses += len(expected - set(parsed["samples"]))
+
+    def forget(self, node: str) -> None:
+        """Drop the monotonicity baseline for one node — called after a
+        node restart, where counters legitimately reset to zero (the
+        same counter-reset tolerance Prometheus rate() applies)."""
+        self._prev.pop(node, None)
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -412,20 +423,29 @@ def run_soak(
 
     arm = arm_chaos if arm_chaos is not None else default_chaos
 
-    async def body() -> Dict[str, Any]:
+    async def body(store_dir: Optional[str]) -> Dict[str, Any]:
         net = VirtualNetwork()
-        overrides = {
+        overrides: Dict[str, Any] = {
             "monitor_config": {
                 "max_event_log": cfg.max_event_log,
                 "rollup_window_s": cfg.window_s,
                 "rollup_max_windows": cfg.max_windows,
             }
         }
+        if cfg.restart_every:
+            # restart waves need graceful restart on the wire and a
+            # durable configstore per node (warm-boot version floors)
+            overrides["spark_config"] = {"graceful_restart_enabled": True}
         for i in range(n):
             net.add_node(
                 f"n{i}",
                 loopback_prefix=f"10.{i}.0.0/24",
                 config_overrides=overrides,
+                config_store_path=(
+                    None
+                    if store_dir is None
+                    else f"{store_dir}/n{i}.bin"
+                ),
             )
         await net.start_all()
         for i in range(n - 1):
@@ -543,6 +563,19 @@ def run_soak(
                             net.restore_link(f"n{a}", ia, f"n{b}", ib)
                         chord_state[(a, b)] = "up"
                         toggles.append(((a, b), True))
+                    # restart wave: after the chord batch lands, bounce a
+                    # random interior node through the graceful-restart
+                    # warm-boot path — the wave only converges once the
+                    # respawn has resynced and reprogrammed
+                    restarted: List[str] = []
+                    if (
+                        cfg.restart_every > 0
+                        and (wave_i + 1) % cfg.restart_every == 0
+                    ):
+                        victim = f"n{rng.randrange(1, n - 1)}"
+                        await net.restart_node(victim)
+                        scrapes.forget(victim)  # counters reset to zero
+                        restarted.append(victim)
                     t0 = time.time()
                     wave_ok = True
                     try:
@@ -579,6 +612,7 @@ def run_soak(
                             "removed": [
                                 f"n{a}-n{b}" for a, b in removed
                             ],
+                            "restarted": restarted,
                             "faulted": chaos,
                             "converged": wave_ok,
                             "converge_ms": round(converge_ms, 2),
@@ -665,7 +699,12 @@ def run_soak(
 
     loop = asyncio.new_event_loop()
     try:
-        return loop.run_until_complete(body())
+        if cfg.restart_every:
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as td:
+                return loop.run_until_complete(body(td))
+        return loop.run_until_complete(body(None))
     finally:
         loop.close()
 
@@ -737,6 +776,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--wave-links", type=int, default=1)
     parser.add_argument("--settle-s", type=float, default=1.0)
     parser.add_argument("--fault-every", type=int, default=2)
+    parser.add_argument("--restart-every", type=int, default=0)
     parser.add_argument("--seed", type=int, default=7)
     parser.add_argument("--window-s", type=float, default=1.0)
     parser.add_argument("--max-event-log", type=int, default=100)
@@ -748,6 +788,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         wave_links=args.wave_links,
         settle_s=args.settle_s,
         fault_every=args.fault_every,
+        restart_every=args.restart_every,
         seed=args.seed,
         window_s=args.window_s,
         max_event_log=args.max_event_log,

@@ -66,6 +66,43 @@ class VirtualNetwork:
         for wrapper in reversed(list(self.wrappers.values())):
             await wrapper.stop()
 
+    async def restart_node(
+        self, name: str, config_overrides: Optional[dict] = None
+    ) -> "OpenrWrapper":
+        """Whole-node crash/restart: stop the daemon (its stop path floods
+        restarting hellos when `spark_config.graceful_restart_enabled` is
+        set, so neighbors enter the GR hold) and respawn it with the SAME
+        config, configstore path and FIB agent object — the agent keeps
+        forwarding on its surviving routes through the gap, exactly like
+        a kernel FIB under a restarting routing daemon. The respawn's
+        first post-boot sync closes the `restart.e2e_ms` span anchored at
+        the restarting-hello flood. Returns the new wrapper."""
+        import time
+
+        wrapper = self.wrappers[name]
+        anchor = time.monotonic()
+        await wrapper.stop()
+        respawn = OpenrWrapper(
+            name,
+            self,
+            config_overrides=(
+                config_overrides
+                if config_overrides is not None
+                else wrapper.config_overrides
+            ),
+            loopback_prefix=wrapper.loopback_prefix,
+            config_store_path=wrapper.config_store_path,
+            fib_handler=wrapper.fib_handler,
+        )
+        self.wrappers[name] = respawn
+        respawn.daemon.fib.note_restart_anchor(anchor)
+        await respawn.start()
+        # the fabric kept the links; the fresh daemon must re-raise its
+        # interfaces to rejoin discovery
+        for iface in self.io_network.interfaces_of(name):
+            respawn.set_interface(iface, True)
+        return respawn
+
     # -- network-wide observability ---------------------------------------
 
     def node_reports(self) -> List[dict]:
@@ -118,6 +155,14 @@ _FAST_TIMERS = {
         "debounce_min_ms": 5.0,
         "debounce_max_ms": 20.0,
     },
+    "fib_config": {
+        # the emulator keeps the seed's immediate first sync: a non-zero
+        # hold would subsume each node's first route deltas into the
+        # pending sync (losing their convergence spans); warm-boot gating
+        # rides the stale set + EOR, not this hold
+        "cold_start_duration_s": 0.0,
+        "stale_sweep_deadline_s": 30.0,
+    },
 }
 
 
@@ -129,9 +174,14 @@ class OpenrWrapper:
         network: VirtualNetwork,
         config_overrides: Optional[dict] = None,
         loopback_prefix: Optional[str] = None,
+        config_store_path: Optional[str] = None,
+        fib_handler: Optional[MockFibHandler] = None,
     ) -> None:
         self.name = name
         self.network = network
+        # kept verbatim so restart_node can respawn with the same config
+        self.config_overrides = config_overrides
+        self.config_store_path = config_store_path
         cfg = {"node_name": name, "dryrun": False, **_FAST_TIMERS}
         if config_overrides:
             for key, value in config_overrides.items():
@@ -141,12 +191,18 @@ class OpenrWrapper:
                     cfg[key] = {**cfg[key], **value}
                 else:
                     cfg[key] = value
-        self.fib_handler = MockFibHandler()
+        # the FIB agent outlives daemon incarnations (it is the kernel's
+        # stand-in): restart_node hands the same handler to the respawn so
+        # forwarding state survives the daemon gap
+        self.fib_handler = (
+            fib_handler if fib_handler is not None else MockFibHandler()
+        )
         self.daemon = OpenrDaemon(
             Config.from_dict(cfg),
             io_provider=network.io_network.provider(name),
             kv_transport=network.kv_transport,
             fib_service=self.fib_handler,
+            config_store_path=config_store_path,
             ctrl_port=0,
         )
         self.loopback_prefix = loopback_prefix

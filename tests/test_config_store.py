@@ -1,11 +1,14 @@
 """PersistentStore tests (openr/config-store/tests/PersistentStoreTest.cpp
 equivalents): store/load/erase roundtrip, restart durability, obj helpers,
-corrupt-file tolerance."""
+corrupt-file tolerance, and the crash-consistency fuzz suite (truncated
+journal tail, torn snapshot record, mid-compaction kill, fault-injected
+save/load) pinning recovery to the last durable state."""
 
 import asyncio
 import os
 
 from openr_tpu.configstore import PersistentStore
+from openr_tpu.testing.faults import injected
 from openr_tpu.types import IpPrefix, PrefixEntry, PrefixType
 
 
@@ -84,3 +87,152 @@ def test_dryrun_writes_nothing(tmp_path):
     store.store("k", b"v")
     store.flush()
     assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# crash-consistency fuzz (graceful-restart warm boot depends on these)
+# ---------------------------------------------------------------------------
+
+
+def _journaled_store(path, n=6):
+    """A store whose file holds one snapshot + n separate journal
+    appends (each flush is its own fsynced append)."""
+    store = PersistentStore(path)
+    store.flush()  # snapshot the empty store
+    for i in range(n):
+        store.store(f"k{i}", f"v{i}".encode())
+        store.flush()
+    assert store.num_journal_appends >= 1, "appends must exercise"
+    return store
+
+
+def test_journal_appends_not_rewrites(tmp_path):
+    """Consecutive flushes append journal records instead of rewriting
+    the snapshot; a journal outgrowing the snapshot compacts."""
+    path = str(tmp_path / "store.bin")
+    store = _journaled_store(path)
+    assert store.num_compactions >= 1  # the initial snapshot
+    reopened = PersistentStore(path)
+    for i in range(6):
+        assert reopened.load(f"k{i}") == f"v{i}".encode()
+    # grow the journal well past the snapshot: compaction happens
+    big = b"x" * 4096
+    store.store("big", big)
+    store.flush()
+    store.store("big2", big)
+    store.flush()
+    assert store.num_compactions >= 2
+    assert PersistentStore(path).load("big2") == big
+
+
+def test_truncated_journal_tail_recovers_prefix(tmp_path):
+    """Fuzz: truncate the file at EVERY byte offset. Load must never
+    crash and must always recover a prefix of the applied operations —
+    the last durable state, not an empty store."""
+    path = str(tmp_path / "store.bin")
+    _journaled_store(path).stop()
+    raw = open(path, "rb").read()
+    # the historical states after each prefix of operations
+    history = [
+        {f"k{j}": f"v{j}".encode() for j in range(i)} for i in range(7)
+    ]
+    for cut in range(len(raw)):
+        with open(path, "wb") as f:
+            f.write(raw[:cut])
+        reopened = PersistentStore(path)
+        assert reopened.data in history, (cut, reopened.data)
+    # after a truncated load, the store must keep working: the next
+    # flush compacts (never appends after garbage)
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) - 3])
+    survivor = PersistentStore(path)
+    assert survivor.num_load_truncations == 1
+    survivor.store("fresh", b"F")
+    survivor.flush()
+    assert survivor.num_compactions >= 1
+    final = PersistentStore(path)
+    assert final.load("fresh") == b"F"
+    assert final.num_load_truncations == 0
+
+
+def test_torn_snapshot_record_recovers(tmp_path):
+    """A corrupted snapshot body (torn sector) must not crash load; the
+    journal after it is unreachable, so recovery is the pre-snapshot
+    state (empty), and the store stays usable."""
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    store.store("a", b"1")
+    store.flush()  # snapshot with data
+    raw = bytearray(open(path, "rb").read())
+    # flip bytes in the middle of the snapshot payload
+    mid = len(raw) // 2
+    raw[mid] ^= 0xFF
+    raw[mid + 1] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    reopened = PersistentStore(path)
+    assert reopened.data == {}
+    reopened.store("b", b"2")
+    reopened.flush()
+    assert PersistentStore(path).load("b") == b"2"
+
+
+def test_mid_compaction_kill_keeps_previous_file(tmp_path):
+    """tmp+rename discipline: a kill between writing the .tmp and the
+    rename leaves the previous file authoritative; the stray .tmp is
+    ignored by load and the next flush replaces it."""
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    store.store("durable", b"YES")
+    store.flush()
+    # simulate the kill: a partial compaction artifact next to the file
+    with open(path + ".tmp", "wb") as f:
+        f.write(b"ONRPS1\n\x00partial-garbage")
+    reopened = PersistentStore(path)
+    assert reopened.load("durable") == b"YES"
+    reopened.store("more", b"M")
+    reopened.flush()
+    assert PersistentStore(path).load("more") == b"M"
+    # the snapshot path reuses (and atomically replaces via) the tmp name
+    assert not os.path.exists(path + ".tmp") or os.path.getsize(
+        path + ".tmp"
+    ) == 0 or PersistentStore(path).load("durable") == b"YES"
+
+
+def test_save_fault_keeps_journal_and_retries(tmp_path):
+    """configstore.save fault point: an injected write failure keeps the
+    journal pending (nothing lost) and a later flush lands it."""
+    path = str(tmp_path / "store.bin")
+
+    async def body():
+        store = PersistentStore(path)
+        with injected() as inj:
+            inj.arm("configstore.save", times=1)
+            store.store("k", b"v")
+            # wait out the write-behind debounce + the retry backoff
+            for _ in range(200):
+                await asyncio.sleep(0.02)
+                if store.num_writes_to_disk >= 1:
+                    break
+        assert store.num_write_failures == 1
+        assert store.num_writes_to_disk >= 1
+        store.stop()
+
+    asyncio.new_event_loop().run_until_complete(body())
+    assert PersistentStore(path).load("k") == b"v"
+
+
+def test_load_fault_degrades_to_empty(tmp_path):
+    """configstore.load fault point: an injected read failure is the
+    corrupt-database case — empty store, daemon boots anyway."""
+    path = str(tmp_path / "store.bin")
+    store = PersistentStore(path)
+    store.store("k", b"v")
+    store.stop()
+    with injected() as inj:
+        inj.arm("configstore.load", times=1)
+        degraded = PersistentStore(path)
+    assert degraded.data == {}
+    assert degraded.num_load_errors == 1
+    # and the file itself was untouched: a clean reopen still has it
+    assert PersistentStore(path).load("k") == b"v"

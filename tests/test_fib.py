@@ -63,6 +63,7 @@ def make_fib(handler=None, **cfg_kw):
     handler = handler or MockFibHandler()
     route_q = RWQueue()
     if_q = RWQueue()
+    cfg_kw.setdefault("cold_start_duration", 0.0)
     cfg = FibConfig(my_node_name="node-1", **cfg_kw)
     fib = Fib(cfg, handler, route_q, if_q)
     return fib, handler, route_q, if_q

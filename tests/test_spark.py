@@ -27,8 +27,7 @@ def run(coro, timeout=15.0):
 
 
 def fast_config(name, **kw):
-    return SparkConfig(
-        node_name=name,
+    defaults = dict(
         fastinit_hello_time=0.02,
         hello_time=0.5,
         handshake_time=0.02,
@@ -36,8 +35,9 @@ def fast_config(name, **kw):
         hold_time=0.25,
         graceful_restart_time=0.5,
         negotiate_hold_time=0.2,
-        **kw,
     )
+    defaults.update(kw)
+    return SparkConfig(node_name=name, **defaults)
 
 
 def make_spark(name, net, **kw):
@@ -151,11 +151,105 @@ class TestSparkDiscovery:
             spark_b.update_interfaces(["if-b"])
             await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
             spark_b.flood_restarting()
+            assert spark_b.counters.get("spark.gr_hellos_sent") == 1
             await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            assert spark_a.counters.get("spark.gr_holds_active") == 1
             spark_b.stop()  # never comes back
             down = await wait_event(ra, NeighborEventType.NEIGHBOR_DOWN)
             assert down.node_name == "b"
+            assert spark_a.counters.get("spark.gr_holds_active") == 0
+            assert spark_a.counters.get("spark.gr_hold_expiries") == 1
             spark_a.stop()
+
+        run(body())
+
+    def test_gr_hold_counters_roundtrip_on_restart(self):
+        """The gauge enters on NEIGHBOR_RESTARTING and exits cleanly on
+        NEIGHBOR_RESTARTED (no expiry counted)."""
+
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            spark_b.flood_restarting()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            assert spark_a.counters.get("spark.gr_holds_active") == 1
+            spark_b.stop()
+            spark_b2, rb2, _ = make_spark("b", net)
+            spark_b2.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTED)
+            assert spark_a.counters.get("spark.gr_holds_active") == 0
+            assert spark_a.counters.get("spark.gr_hold_expiries", 0) == 0
+            spark_a.stop()
+            spark_b2.stop()
+
+        run(body())
+
+    def test_double_restart_extends_gr_window(self):
+        """A second restarting hello while the neighbor is already in
+        RESTART re-arms the GR timer: back-to-back restarts survive as
+        long as each announcement lands inside the previous window."""
+
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net, graceful_restart_time=0.6)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            spark_b.flood_restarting()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            # second announcement 0.35s in: without the re-arm the hold
+            # would expire at 0.6s; with it, the window restarts
+            await asyncio.sleep(0.35)
+            spark_b.flood_restarting()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            await asyncio.sleep(0.4)  # past the ORIGINAL expiry
+            assert spark_a.get_neighbors(SparkNeighState.RESTART), (
+                "GR window was not re-armed by the second restart"
+            )
+            assert spark_a.counters.get("spark.gr_holds_active") == 1
+            spark_b.stop()
+            spark_b2, rb2, _ = make_spark("b", net)
+            spark_b2.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTED)
+            assert spark_a.get_neighbors(SparkNeighState.ESTABLISHED)
+            assert spark_a.counters.get("spark.gr_holds_active") == 0
+            spark_a.stop()
+            spark_b2.stop()
+
+        run(body())
+
+    def test_gr_expiry_then_late_return_is_fresh_discovery(self):
+        """GR expiry mid-boot: the neighbor comes back AFTER the window
+        expired — the adjacency was torn down (NEIGHBOR_DOWN) and the
+        late return is an ordinary fresh NEIGHBOR_UP, not RESTARTED."""
+
+        async def body():
+            net = MockIoNetwork()
+            net.connect(("a", "if-a"), ("b", "if-b"))
+            spark_a, ra, _ = make_spark("a", net)
+            spark_b, rb, _ = make_spark("b", net)
+            spark_a.update_interfaces(["if-a"])
+            spark_b.update_interfaces(["if-b"])
+            await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            spark_b.flood_restarting()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_RESTARTING)
+            spark_b.stop()
+            await wait_event(ra, NeighborEventType.NEIGHBOR_DOWN)
+            assert spark_a.counters.get("spark.gr_hold_expiries") == 1
+            spark_b2, rb2, _ = make_spark("b", net)
+            spark_b2.update_interfaces(["if-b"])
+            up = await wait_event(ra, NeighborEventType.NEIGHBOR_UP)
+            assert up.node_name == "b"
+            assert spark_a.get_neighbors(SparkNeighState.ESTABLISHED)
+            spark_a.stop()
+            spark_b2.stop()
 
         run(body())
 
