@@ -850,6 +850,66 @@ def _bench_apsp() -> dict:
     }
 
 
+def _bench_fleet() -> dict:
+    """Eighth metric line: continuous fleet-observation overhead — the
+    standard convergence flap batch re-run with the fleet observer
+    (openr_tpu/fleet) attached over every node's real ctrl socket,
+    scraping + streaming + evaluating the SLO rules continuously. The
+    metric is the mean watchdog tick cost (scrape sweep fold + rule
+    evaluation over the store); the line carries the attached run's
+    convergence e2e p95 next to the detached baseline's (the convergence
+    line measured earlier on the same config) so a fleet watcher that
+    perturbs the convergence path is caught, not just a slow one.
+    Degraded-aware like every line: cpu-fallback rounds run the reduced
+    batch and are marked by main()."""
+    from openr_tpu.testing.decision_harness import run_bench_convergence
+
+    nodes = int(os.environ.get("BENCH_CONV_NODES", "5"))
+    flaps = int(os.environ.get("BENCH_CONV_FLAPS", "2"))
+    backend = os.environ.get("BENCH_CONV_BACKEND", "tpu")
+    summary = run_bench_convergence(
+        nodes=nodes,
+        flaps=flaps,
+        backend=backend,
+        measure_exporter=False,
+        fleet_observer=True,
+    )
+    baseline_p95 = _CONV_SUMMARY.get("e2e_p95_ms", 0.0)
+    p95 = summary["e2e_p95_ms"]
+    if baseline_p95 > 0:
+        # the same held-flat envelope as the fan-out line: an observer
+        # that serializes into the convergence path blows through it
+        assert p95 <= baseline_p95 * 5.0 + 250.0, (
+            f"convergence p95 {p95:.1f}ms with the fleet observer "
+            f"attached vs {baseline_p95:.1f}ms detached: the watcher is "
+            f"not isolated"
+        )
+    _note(
+        f"fleet: observer on the {summary['nodes']}-node flap batch -> "
+        f"{summary['fleet_ticks']} watchdog tick(s) at "
+        f"{summary['fleet_tick_ms']:.3f}ms/tick, "
+        f"{summary['fleet_scrapes']} scrapes at "
+        f"{summary['fleet_scrape_ms']:.3f}ms; e2e p95 {p95:.1f}ms "
+        f"attached vs {baseline_p95:.1f}ms detached"
+    )
+    return {
+        "metric": "fleet_watch_overhead_ms",
+        "value": round(max(summary["fleet_tick_ms"], 1e-4), 4),
+        "unit": (
+            f"ms mean SLO-watchdog tick (fleet observer attached to the "
+            f"{summary['nodes']}-node line emulator flap batch over real "
+            f"ctrl sockets)"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "fleet_ticks": summary["fleet_ticks"],
+        "fleet_scrapes": summary["fleet_scrapes"],
+        "fleet_scrape_ms": summary["fleet_scrape_ms"],
+        "attached_e2e_p95_ms": round(p95, 2),
+        "baseline_e2e_p95_ms": round(baseline_p95, 2),
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -907,6 +967,13 @@ def main(argv=None) -> None:
             results.append(_bench_stream())
         if os.environ.get("BENCH_APSP", "1") == "1":
             results.append(_bench_apsp())
+        if (
+            os.environ.get("BENCH_FLEET", "1") == "1"
+            and os.environ.get("BENCH_CONVERGENCE", "1") == "1"
+        ):
+            # defined against the convergence flap batch: the detached
+            # baseline p95 is the held-flat comparison
+            results.append(_bench_fleet())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker

@@ -56,7 +56,10 @@ MIXINS = {"CountersMixin", "HistogramsMixin"}
 # covers the streaming control plane's fan-out + admission layers
 # (ctrl.stream.* / ctrl.admission.*, docs/Streaming.md); "restart" is
 # the whole-node warm-boot span (restart.e2e_ms, closed by Fib like
-# convergence.e2e_ms — docs/Robustness.md "Graceful restart & warm boot")
+# convergence.e2e_ms — docs/Robustness.md "Graceful restart & warm boot");
+# "fleet" is the fleet observer's own telemetry (openr_tpu/fleet — a
+# Monitor-registrable module even though it usually runs out-of-daemon,
+# docs/Monitoring.md "Fleet observer & SLO watchdog")
 ALLOWED_PREFIXES = {
     "decision",
     "kvstore",
@@ -69,6 +72,7 @@ ALLOWED_PREFIXES = {
     "process",
     "monitor",
     "ctrl",
+    "fleet",
 }
 
 # <module>.<name>[.<name>...], lowercase snake segments

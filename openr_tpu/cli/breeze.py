@@ -19,6 +19,8 @@ same command vocabulary:
   breeze monitor counters|histograms[--reset]|logs
   breeze openr version|config
   breeze perf view                   (fib perf event database — 'breeze perf')
+  breeze fleet status|watch|report   (fleet observer + SLO watchdog,
+                                      docs/Monitoring.md "Fleet observer")
   breeze config show|dryrun          (running config / validate candidate)
   breeze tech-support                (one-shot full state dump)
 
@@ -409,6 +411,8 @@ def cmd_soak_report(args) -> None:
     JSON file, never dials a daemon."""
     with open(args.file) as fh:
         report = json.load(fh)
+    if "verdict" not in report and isinstance(report.get("soak"), dict):
+        report = report["soak"]  # a SOAK_r* artifact wraps the report
     verdict = report.get("verdict", {})
     checks = verdict.get("checks", {})
     state = "PASS" if verdict.get("pass") else "FAIL"
@@ -493,6 +497,164 @@ def cmd_soak_report(args) -> None:
         )
     if args.json:
         _print_json(report)
+
+
+def cmd_fleet(client: BlockingCtrlClient, args) -> None:
+    """Fleet observer surfaces (docs/Monitoring.md "Fleet observer & SLO
+    watchdog"): `status` one-shot-scrapes the connected node plus
+    --hosts peers and renders the health gauges the standing rules
+    watch; `watch` attaches the live observer (scrape + stream + SLO
+    watchdog) for --seconds and reports breaches."""
+    from openr_tpu.monitor.exporter import parse_metrics_text, prom_name
+
+    endpoints = [h for h in (args.hosts or "").split(",") if h]
+    if args.cmd == "status":
+        rows = []
+        unhealthy = []
+
+        def one(c: BlockingCtrlClient) -> None:
+            node = c.call("getMyNodeName")
+            parsed = parse_metrics_text(c.call("getMetricsText"))
+
+            def sample(name: str, default=0.0) -> float:
+                pname = prom_name(name)
+                for view in ("counters", "gauges"):
+                    if pname in parsed[view]:
+                        return parsed[view][pname]
+                return default
+
+            window_p95 = 0.0
+            for labels, value in parsed["samples"].get(
+                "openr_convergence_window_e2e_ms", {}
+            ).items():
+                if 'q="p95"' in labels:
+                    window_p95 = value
+            fallback = int(sample("decision.spf.fallback_active"))
+            stale = int(sample("fib.num_stale_routes"))
+            flushes = int(sample("fib.stale_deadline_flushes"))
+            resyncs = int(sample("ctrl.stream.resyncs"))
+            rejected = int(
+                sample("ctrl.admission.rejected_queue_full")
+                + sample("ctrl.admission.rejected_client_cap")
+                + sample("ctrl.admission.timeouts")
+            )
+            state = "OK"
+            if fallback or flushes:
+                state = "DEGRADED"
+                unhealthy.append(node)
+            rows.append(
+                [
+                    node,
+                    state,
+                    f"{window_p95:.1f}",
+                    fallback,
+                    stale,
+                    resyncs,
+                    rejected,
+                    int(sample("process.uptime.seconds")),
+                ]
+            )
+
+        one(client)
+        for endpoint in endpoints:
+            host, _, port = endpoint.rpartition(":")
+            with BlockingCtrlClient(
+                host or "127.0.0.1",
+                int(port),
+                ssl_context=client.ssl_context,
+            ) as peer:
+                one(peer)
+        _print_table(
+            ["Node", "State", "win p95 ms", "Fallback", "Stale",
+             "Resyncs", "Rejected", "Uptime s"],
+            rows,
+        )
+        print(
+            f"fleet: {len(rows)} node(s), "
+            f"{len(unhealthy)} degraded"
+            + (f" ({', '.join(unhealthy)})" if unhealthy else "")
+        )
+        if args.json:
+            _print_json({"nodes": rows, "degraded": unhealthy})
+    elif args.cmd == "watch":
+        from openr_tpu.fleet import FleetConfig, SloConfig, watch_hosts
+
+        hosts = [f"{args.host}:{args.port}"] + endpoints
+        report = watch_hosts(
+            hosts,
+            seconds=args.seconds,
+            config=FleetConfig(
+                scrape_interval_s=args.interval,
+                forensics_dir=args.forensics_dir,
+                slo=SloConfig(
+                    convergence_p95_budget_ms=args.budget_ms
+                ),
+            ),
+        )
+        _render_fleet_report(report, json_too=args.json)
+
+
+def _render_fleet_report(report: dict, json_too: bool = False) -> None:
+    """Shared renderer for `breeze fleet watch` and the offline
+    `breeze fleet report FILE` (which must round-trip with --json)."""
+    verdict = report.get("verdict", {})
+    checks = verdict.get("checks", {})
+    state = "PASS" if verdict.get("pass") else "BREACH"
+    print(
+        f"fleet verdict: {state} ({len(report.get('nodes', []))} node(s), "
+        f"{report.get('ticks', 0)} watchdog tick(s))"
+    )
+    for name, check in sorted(checks.items()):
+        mark = "ok " if check.get("ok") else "FAIL"
+        print(f"  [{mark}] {name}: {check.get('detail', '')}")
+    findings = report.get("findings", [])
+    if findings:
+        _print_table(
+            ["Rule", "Node", "Value", "Budget", "Stages", "Forensics"],
+            [
+                [
+                    f["kind"],
+                    f["node"],
+                    f["value"],
+                    f["budget"],
+                    ",".join(
+                        s["stage"] for s in f.get("attribution", [])
+                    )
+                    or "-",
+                    f.get("forensics_id") or "-",
+                ]
+                for f in findings
+            ],
+        )
+    store = report.get("store", {})
+    acc = store.get("accounting", {})
+    print(
+        f"store: {acc.get('recorded', 0)} points = "
+        f"{acc.get('retained', 0)} retained + "
+        f"{acc.get('evicted', 0)} evicted over {acc.get('rings', 0)} "
+        f"ring(s); {store.get('gaps_marked', 0)} gap(s) marked"
+    )
+    if json_too:
+        _print_json(report)
+
+
+def cmd_fleet_report(args) -> None:
+    """Offline: render a fleet report JSON written by the observer
+    (`python -m openr_tpu.fleet --out` / a SOAK_r* artifact's `fleet`
+    section). Never dials a daemon; --json re-emits the full report
+    (the round-trip the FLEET_SMOKE pins)."""
+    with open(args.file) as fh:
+        report = json.load(fh)
+    if "findings" not in report:
+        # also accept a soak report / SOAK_r* artifact: render the
+        # embedded fleet section
+        if isinstance(report.get("fleet"), dict):
+            report = report["fleet"]
+        elif isinstance(report.get("soak"), dict) and isinstance(
+            report["soak"].get("fleet"), dict
+        ):
+            report = report["soak"]["fleet"]
+    _render_fleet_report(report, json_too=args.json)
 
 
 def cmd_perf(client: BlockingCtrlClient, args) -> None:
@@ -936,6 +1098,40 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="dump the full report too"
     )
 
+    fleet = sub.add_parser("fleet").add_subparsers(dest="cmd", required=True)
+    p = fleet.add_parser("status")
+    p.add_argument(
+        "--hosts",
+        default="",
+        help="additional host:port ctrl endpoints (comma-separated)",
+    )
+    p.add_argument("--json", action="store_true")
+    p = fleet.add_parser("watch")
+    p.add_argument(
+        "--hosts",
+        default="",
+        help="additional host:port ctrl endpoints (comma-separated)",
+    )
+    p.add_argument("--seconds", type=float, default=15.0)
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument(
+        "--budget-ms",
+        type=float,
+        default=1000.0,
+        help="convergence e2e p95 SLO budget",
+    )
+    p.add_argument(
+        "--forensics-dir", default=None, help="write breach dumps here"
+    )
+    p.add_argument("--json", action="store_true")
+    p = fleet.add_parser("report")
+    p.add_argument(
+        "file", help="fleet report JSON (python -m openr_tpu.fleet --out)"
+    )
+    p.add_argument(
+        "--json", action="store_true", help="re-emit the full report"
+    )
+
     cfg = sub.add_parser("config").add_subparsers(dest="cmd", required=True)
     cfg.add_parser("show")
     p = cfg.add_parser("dryrun")
@@ -955,6 +1151,7 @@ _HANDLERS = {
     "monitor": cmd_monitor,
     "openr": cmd_openr,
     "perf": cmd_perf,
+    "fleet": cmd_fleet,
     "config": cmd_config,
     "tech-support": cmd_tech_support,
 }
@@ -967,6 +1164,10 @@ def main(argv=None) -> int:
     if args.module == "perf" and getattr(args, "cmd", None) == "soak-report":
         # offline renderer: reads a report file, never dials a daemon
         cmd_soak_report(args)
+        return 0
+    if args.module == "fleet" and getattr(args, "cmd", None) == "report":
+        # offline renderer: reads a fleet report file, never dials a daemon
+        cmd_fleet_report(args)
         return 0
     ssl_ctx = None
     if args.x509_ca_path:

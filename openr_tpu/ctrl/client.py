@@ -56,6 +56,14 @@ def _raise_ctrl_error(resp: Dict) -> None:
     )
 
 
+# one response/frame is one newline-JSON line: a full-fleet KvStore
+# snapshot (subscribeKvStore's initial frame on a hundreds-of-nodes
+# LSDB) far exceeds asyncio's default 64 KiB StreamReader limit, and
+# readline() would fail with "chunk is longer than limit" on every
+# fleet-scale subscription — size the reader for the protocol
+_LINE_LIMIT = 64 * 1024 * 1024
+
+
 class CtrlClient:
     """Async client: one connection, sequential request/response."""
 
@@ -64,17 +72,19 @@ class CtrlClient:
         host: str = "127.0.0.1",
         port: int = 2018,
         ssl_context=None,
+        limit: int = _LINE_LIMIT,
     ) -> None:
         self.host = host
         self.port = port
         self._ssl_context = ssl_context
+        self._limit = limit
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._next_id = 0
 
     async def connect(self) -> "CtrlClient":
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self._ssl_context
+            self.host, self.port, ssl=self._ssl_context, limit=self._limit
         )
         return self
 

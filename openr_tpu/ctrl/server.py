@@ -182,8 +182,17 @@ class CtrlServer:
             from openr_tpu.streaming import AdmissionController
 
             self.admission = AdmissionController()
+        # request lines are one JSON document each; bulk writes (e.g. a
+        # big setKvStoreKeyVals) overflow asyncio's default 64 KiB
+        # readline limit — mirror the client's fleet-scale line limit
+        from openr_tpu.ctrl.client import _LINE_LIMIT
+
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, ssl=self._ssl_context
+            self._handle_conn,
+            self.host,
+            self.port,
+            ssl=self._ssl_context,
+            limit=_LINE_LIMIT,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -278,8 +287,12 @@ class CtrlServer:
                         log.exception("ctrl method failed")
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
-        except (ConnectionResetError, asyncio.CancelledError):
-            pass
+        except (
+            ConnectionResetError,
+            BrokenPipeError,
+            asyncio.CancelledError,
+        ):
+            pass  # client hung up (possibly mid-write): normal teardown
         finally:
             writer.close()
 
@@ -931,11 +944,14 @@ class CtrlServer:
                     }
                 await self._send_frame(writer, req_id, payload)
                 self.stream_manager.mark_delivered(sub, t_enq)
+        # CancelledError must PROPAGATE: server shutdown cancels this
+        # connection task mid-stream, and swallowing the cancel here sent
+        # the task back into _handle_conn's readline — stop()'s gather
+        # then waited forever on a subscriber that never hangs up
         except (
             QueueClosedError,
             ConnectionResetError,
             BrokenPipeError,
-            asyncio.CancelledError,
         ):
             pass
         finally:
@@ -1007,11 +1023,11 @@ class CtrlServer:
                     }
                 await self._send_frame(writer, req_id, payload)
                 self.stream_manager.mark_delivered(sub, t_enq)
+        # CancelledError must propagate (see _kvstore_stream)
         except (
             QueueClosedError,
             ConnectionResetError,
             BrokenPipeError,
-            asyncio.CancelledError,
         ):
             pass
         finally:

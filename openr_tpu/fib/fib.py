@@ -208,6 +208,12 @@ class Fib(CountersMixin, HistogramsMixin):
         self.perf_db: List[PerfEvents] = []
         self._recent_perf_ts = 0
         self.has_synced_fib = False
+        # one-shot per-delta programming delay (seconds), consumed before
+        # the agent RPCs: the `fib.program` fault point's action hook sets
+        # it to emulate a slow FIB agent deterministically — the same
+        # throttle pattern as `ctrl.stream.deliver` (docs/Robustness.md);
+        # the added latency lands in the span's fib.program stage
+        self.program_throttle_s = 0.0
         import random as _random
 
         self._backoff = ExponentialBackoff(
@@ -605,6 +611,9 @@ class Fib(CountersMixin, HistogramsMixin):
                 # exact dirty-marking + debounced-resync path a thrift
                 # failure would (docs/Robustness.md)
                 fault_point("fib.program", self)
+                delay, self.program_throttle_s = self.program_throttle_s, 0.0
+                if delay:
+                    await asyncio.sleep(delay)
                 n = 0
                 if unicast_to_delete:
                     n += len(unicast_to_delete)

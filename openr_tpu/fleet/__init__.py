@@ -1,0 +1,37 @@
+"""Fleet observer: streaming telemetry collector, continuous SLO
+watchdog and forensics for whole emulated/real fleets
+(docs/Monitoring.md "Fleet observer & SLO watchdog").
+
+A client of the existing surfaces — `getMetricsText` scrapes and
+`subscribeKvStore` streams over real ctrl sockets — folded into a
+bounded per-node x per-metric time-series store and judged continuously
+by standing SLO rules; `python -m openr_tpu.fleet` and
+`breeze fleet status|watch|report` are the operator surfaces.
+"""
+
+from openr_tpu.fleet.observer import (
+    FLEET_SLO_BREACH,
+    FleetCollector,
+    FleetConfig,
+    FleetObserver,
+    replay_scrape_files,
+    replay_soak_report,
+    watch_hosts,
+)
+from openr_tpu.fleet.rules import Finding, SloConfig, evaluate
+from openr_tpu.fleet.store import FleetStore, SeriesRing
+
+__all__ = [
+    "FLEET_SLO_BREACH",
+    "Finding",
+    "FleetCollector",
+    "FleetConfig",
+    "FleetObserver",
+    "FleetStore",
+    "SeriesRing",
+    "SloConfig",
+    "evaluate",
+    "replay_scrape_files",
+    "replay_soak_report",
+    "watch_hosts",
+]

@@ -1,0 +1,104 @@
+"""Fleet observer CLI: attach to a running fleet or replay artifacts.
+
+    # watch a live fleet (host:port ctrl endpoints) for 60s
+    python -m openr_tpu.fleet --hosts 10.0.0.1:2018,10.0.0.2:2018 \
+        --seconds 60 --out fleet.json
+
+    # ctrl-free replay of a recorded soak artifact
+    python -m openr_tpu.fleet --replay SOAK_r01.json
+
+`breeze fleet report fleet.json` renders the written report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from openr_tpu.fleet import (
+        FleetConfig,
+        SloConfig,
+        replay_scrape_files,
+        replay_soak_report,
+        watch_hosts,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="fleet",
+        description="fleet observer: telemetry collector + SLO watchdog",
+    )
+    parser.add_argument(
+        "--hosts",
+        default="",
+        help="comma-separated host:port ctrl endpoints to attach to",
+    )
+    parser.add_argument("--seconds", type=float, default=30.0)
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--budget-ms",
+        type=float,
+        default=1000.0,
+        help="convergence e2e p95 budget (SLO)",
+    )
+    parser.add_argument(
+        "--no-stream",
+        action="store_true",
+        help="scrape-only (skip the per-node subscribeKvStore streams)",
+    )
+    parser.add_argument(
+        "--forensics-dir", default=None, help="write breach dumps here"
+    )
+    parser.add_argument(
+        "--replay",
+        nargs="+",
+        default=None,
+        help="offline: a soak report JSON, or exposition text files",
+    )
+    parser.add_argument("--out", default=None, help="JSON report path")
+    args = parser.parse_args(argv)
+
+    slo = SloConfig(convergence_p95_budget_ms=args.budget_ms)
+    if args.replay:
+        first = args.replay[0]
+        if first.endswith(".json"):
+            with open(first) as fh:
+                report = replay_soak_report(json.load(fh), slo=slo)
+        else:
+            report = replay_scrape_files(args.replay, slo=slo)
+    else:
+        hosts = [h for h in args.hosts.split(",") if h]
+        if not hosts:
+            parser.error("--hosts or --replay is required")
+        report = watch_hosts(
+            hosts,
+            seconds=args.seconds,
+            config=FleetConfig(
+                scrape_interval_s=args.interval,
+                stream=not args.no_stream,
+                forensics_dir=args.forensics_dir,
+                slo=slo,
+            ),
+        )
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    verdict = report["verdict"]
+    print(
+        json.dumps(
+            {
+                "fleet": "PASS" if verdict["pass"] else "BREACH",
+                "nodes": len(report.get("nodes", [])),
+                "findings": len(report.get("findings", [])),
+                "ticks": report.get("ticks", 0),
+            }
+        )
+    )
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

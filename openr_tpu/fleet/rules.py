@@ -1,0 +1,404 @@
+"""Standing SLO rules — the continuous generalization of the soak judge.
+
+The soak harness judges a run once, after the fact
+(`testing/soak.py:_judge`): slope + double-gated step detection over the
+windowed p95 series, fault-vs-clean attribution, verdict checks. A fleet
+that runs for hours needs the same judgments made *continuously*, over
+live telemetry, with the offending node and pipeline stage named at
+breach time. Each rule here evaluates one standing check against the
+`FleetStore` every watchdog tick and yields typed findings
+(`FLEET_SLO_BREACH` LogSamples once the observer stamps them):
+
+  | rule | watches |
+  |---|---|
+  | convergence_p95   | per-node interval e2e p95 vs the budget, with
+  |                   | per-stage attribution from the stage-histogram
+  |                   | interval deltas
+  | convergence_trend | slope + step detection (`testing/soak.py
+  |                   | series_slope/detect_step`, the exact soak
+  |                   | detectors) on the per-node p95 series
+  | solver_health     | breaker/fallback state: `decision.spf.
+  |                   | fallback_active` gauges, breaker trips
+  | stream_backpressure | fan-out overflow: coalesce + marked-resync
+  |                   | rates per interval
+  | admission_rejections | typed server-busy rejections + timeouts
+  | restart_health    | warm-boot reconciliation: stale-deadline
+  |                   | flushes, stuck stale routes, GR hold expiries
+
+Interval values are computed by the collector (epoch-aware counter
+deltas + cumulative-histogram diffs, `monitor/exporter.py`
+CounterEpochTracker / histogram_interval) and recorded into the store
+under the `interval.*`-prefixed series names below; rules never touch
+raw scrapes. Gap markers veto differencing: an interval that spans a
+store gap (scrape failure, stream resync, restart window) is not
+judged, so a breach is never synthesized across a discontinuity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+from openr_tpu.fleet.store import FleetStore
+from openr_tpu.testing.soak import detect_step, series_slope
+
+# store series names the collector records (interval = between two
+# consecutive scrapes of one node, within one counter epoch)
+E2E_P95 = "interval.convergence.e2e_p95_ms"
+E2E_COUNT = "interval.convergence.events"
+STAGE_AVG_PREFIX = "interval.stage."  # + <stage histogram name> = avg ms
+GAUGE_PREFIX = "gauge."  # + <counter name> = raw gauge reading
+RATE_PREFIX = "interval.rate."  # + <counter name> = delta per interval
+
+# pipeline-stage histograms the collector diffs for attribution (the
+# convergence span stages that are exported as histograms)
+STAGE_HISTOGRAMS = (
+    "decision.debounce_ms",
+    "decision.route_build_ms",
+    "fib.program_ms",
+    "link_monitor.adj_advertise_ms",
+    "kvstore.flood.e2e_ms",
+)
+
+# counter deltas the collector records as interval rates
+RATE_COUNTERS = (
+    "ctrl.stream.coalesced",
+    "ctrl.stream.resyncs",
+    "ctrl.stream.publish_errors",
+    "ctrl.admission.rejected_queue_full",
+    "ctrl.admission.rejected_client_cap",
+    "ctrl.admission.timeouts",
+    "decision.spf.breaker_trips",
+    "decision.spf.solver_failures",
+    "fib.stale_deadline_flushes",
+    "fib.thrift.failure.add_del_route",
+    "spark.gr_hold_expiries",
+)
+
+# gauges sampled verbatim
+GAUGE_COUNTERS = (
+    "decision.spf.fallback_active",
+    "fib.num_stale_routes",
+)
+
+
+@dataclass
+class SloConfig:
+    """Budgets for the standing rules (the fleet's SLOs)."""
+
+    # convergence_p95: interval e2e p95 budget (ms); 0 disables
+    convergence_p95_budget_ms: float = 1000.0
+    # minimum interval events before a p95 is judged (noise floor)
+    convergence_min_events: int = 1
+    # convergence_trend: step detector thresholds (soak defaults) over
+    # at least trend_min_windows per-node p95 points; 0 disables
+    trend_min_windows: int = 6
+    trend_min_ratio: float = 2.0
+    trend_min_delta_ms: float = 5.0
+    # stream_backpressure: marked resyncs per interval; 0 disables
+    stream_resync_budget: float = 0.0
+    # admission_rejections per interval; 0 keeps the rule armed with a
+    # zero budget (any rejection breaches) — set <0 to disable
+    admission_reject_budget: float = 0.0
+    # restart_health: ticks a node may hold stale routes before breach
+    stale_route_ticks: int = 8
+    # per-stage attribution: a stage is named when its interval avg is
+    # at least this multiple of the fleet-wide cumulative stage avg
+    attribution_min_ratio: float = 2.0
+    attribution_stages: int = 3
+
+
+@dataclass
+class Finding:
+    """One SLO breach: rule kind, offending node, per-stage attribution
+    and the evidence a forensics dump will carry."""
+
+    kind: str
+    node: str
+    detail: str
+    value: float
+    budget: float
+    attribution: List[Dict[str, Any]] = field(default_factory=list)
+    evidence: Dict[str, Any] = field(default_factory=dict)
+    ts: float = 0.0
+    forensics_id: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "node": self.node,
+            "detail": self.detail,
+            "value": round(float(self.value), 4),
+            "budget": float(self.budget),
+            "attribution": list(self.attribution),
+            "evidence": dict(self.evidence),
+            "ts": self.ts,
+            "forensics_id": self.forensics_id,
+        }
+
+
+def _attribute_stages(
+    store: FleetStore, node: str, cfg: SloConfig
+) -> List[Dict[str, Any]]:
+    """Per-stage attribution of a convergence breach: the pipeline
+    stages whose latest interval average stands out against that
+    stage's own fleet-wide cumulative average (the stage that regressed
+    is the one whose fresh samples are slow *relative to its own
+    history*, not merely the slowest stage in absolute terms)."""
+    scored: List[Dict[str, Any]] = []
+    for stage in STAGE_HISTOGRAMS:
+        avg = store.last(node, STAGE_AVG_PREFIX + stage)
+        if avg is None or avg <= 0.0:
+            continue
+        merged = store.merged_histogram(stage)
+        if not merged.count:
+            continue  # no history at all: the stage cannot be judged
+        baseline = merged.avg
+        ratio = avg / baseline if baseline > 0 else float("inf")
+        scored.append(
+            {
+                "stage": stage,
+                "interval_avg_ms": round(avg, 4),
+                "baseline_avg_ms": round(baseline, 4),
+                "ratio": round(ratio, 3) if ratio != float("inf") else -1.0,
+            }
+        )
+    scored.sort(key=lambda s: s["interval_avg_ms"], reverse=True)
+    named = [
+        s
+        for s in scored
+        if s["ratio"] >= cfg.attribution_min_ratio or s["ratio"] == -1.0
+    ]
+    return (named or scored[:1])[: cfg.attribution_stages]
+
+
+def eval_convergence_p95(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    if cfg.convergence_p95_budget_ms <= 0:
+        return
+    worst: Optional[Finding] = None
+    offenders: List[str] = []
+    for node in store.nodes():
+        p95 = store.last(node, E2E_P95)
+        events = store.last(node, E2E_COUNT) or 0.0
+        if p95 is None or events < cfg.convergence_min_events:
+            continue
+        if p95 <= cfg.convergence_p95_budget_ms:
+            continue
+        offenders.append(node)
+        if worst is None or p95 > worst.value:
+            worst = Finding(
+                kind="convergence_p95",
+                node=node,
+                detail="",
+                value=p95,
+                budget=cfg.convergence_p95_budget_ms,
+            )
+    if worst is None:
+        return
+    worst.attribution = _attribute_stages(store, worst.node, cfg)
+    stages = ",".join(s["stage"] for s in worst.attribution) or "unattributed"
+    worst.detail = (
+        f"interval e2e p95 {worst.value:.1f}ms > budget "
+        f"{worst.budget:.1f}ms on {worst.node} "
+        f"({len(offenders)} node(s) over budget; stages: {stages})"
+    )
+    worst.evidence = {
+        "offenders": offenders,
+        "events": store.last(worst.node, E2E_COUNT),
+        "p95_series": store.series(worst.node, E2E_P95)[-16:],
+    }
+    yield worst
+
+
+def eval_convergence_trend(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    if cfg.trend_min_windows <= 0:
+        return
+    for node in store.nodes():
+        series = store.series(node, E2E_P95)
+        if len(series) < cfg.trend_min_windows:
+            continue
+        step = detect_step(
+            series,
+            min_ratio=cfg.trend_min_ratio,
+            min_delta_ms=cfg.trend_min_delta_ms,
+        )
+        if step is None:
+            continue
+        slope = series_slope(series)
+        attribution = _attribute_stages(store, node, cfg)
+        yield Finding(
+            kind="convergence_trend",
+            node=node,
+            detail=(
+                f"p95 step break on {node}: {step['before_ms']:.1f} -> "
+                f"{step['after_ms']:.1f}ms at point {int(step['index'])} "
+                f"(slope {slope:+.3f}ms/tick)"
+            ),
+            value=step["after_ms"],
+            budget=step["before_ms"] * cfg.trend_min_ratio,
+            attribution=attribution,
+            evidence={"step": step, "slope": round(slope, 4),
+                      "series": series[-32:]},
+        )
+
+
+def eval_solver_health(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    for node in store.nodes():
+        fallback = store.last(
+            node, GAUGE_PREFIX + "decision.spf.fallback_active"
+        )
+        trips = store.last(
+            node, RATE_PREFIX + "decision.spf.breaker_trips"
+        )
+        if not fallback and not trips:
+            continue
+        yield Finding(
+            kind="solver_health",
+            node=node,
+            detail=(
+                f"solver degraded on {node}: fallback_active="
+                f"{int(fallback or 0)}, breaker trips this interval="
+                f"{int(trips or 0)}"
+            ),
+            value=float(fallback or trips or 0),
+            budget=0.0,
+            evidence={
+                "fallback_active": fallback,
+                "breaker_trips": trips,
+                "solver_failures": store.last(
+                    node, RATE_PREFIX + "decision.spf.solver_failures"
+                ),
+            },
+        )
+
+
+def eval_stream_backpressure(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    if cfg.stream_resync_budget < 0:
+        return
+    for node in store.nodes():
+        resyncs = store.last(node, RATE_PREFIX + "ctrl.stream.resyncs") or 0
+        errors = (
+            store.last(node, RATE_PREFIX + "ctrl.stream.publish_errors")
+            or 0
+        )
+        if resyncs <= cfg.stream_resync_budget and not errors:
+            continue
+        coalesced = (
+            store.last(node, RATE_PREFIX + "ctrl.stream.coalesced") or 0
+        )
+        yield Finding(
+            kind="stream_backpressure",
+            node=node,
+            detail=(
+                f"fan-out overflow on {node}: {int(resyncs)} marked "
+                f"resync(s), {int(coalesced)} coalesce(s), "
+                f"{int(errors)} publish error(s) this interval"
+            ),
+            value=float(resyncs + errors),
+            budget=cfg.stream_resync_budget,
+            evidence={
+                "resyncs": resyncs,
+                "coalesced": coalesced,
+                "publish_errors": errors,
+            },
+        )
+
+
+def eval_admission_rejections(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    if cfg.admission_reject_budget < 0:
+        return
+    for node in store.nodes():
+        rejected = sum(
+            store.last(node, RATE_PREFIX + name) or 0
+            for name in (
+                "ctrl.admission.rejected_queue_full",
+                "ctrl.admission.rejected_client_cap",
+                "ctrl.admission.timeouts",
+            )
+        )
+        if rejected <= cfg.admission_reject_budget:
+            continue
+        yield Finding(
+            kind="admission_rejections",
+            node=node,
+            detail=(
+                f"{int(rejected)} typed server-busy rejection(s) on "
+                f"{node} this interval (budget "
+                f"{cfg.admission_reject_budget:g})"
+            ),
+            value=float(rejected),
+            budget=cfg.admission_reject_budget,
+            evidence={"rejected": rejected},
+        )
+
+
+def eval_restart_health(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    for node in store.nodes():
+        flushes = (
+            store.last(node, RATE_PREFIX + "fib.stale_deadline_flushes")
+            or 0
+        )
+        expiries = (
+            store.last(node, RATE_PREFIX + "spark.gr_hold_expiries") or 0
+        )
+        stale_series = store.series(
+            node, GAUGE_PREFIX + "fib.num_stale_routes"
+        )
+        stuck = (
+            len(stale_series) >= cfg.stale_route_ticks
+            and all(v > 0 for v in stale_series[-cfg.stale_route_ticks:])
+        )
+        if not flushes and not expiries and not stuck:
+            continue
+        reasons = []
+        if flushes:
+            reasons.append(f"{int(flushes)} stale-deadline flush(es)")
+        if expiries:
+            reasons.append(f"{int(expiries)} GR hold expiry(ies)")
+        if stuck:
+            reasons.append(
+                f"stale routes stuck >0 for {cfg.stale_route_ticks} ticks"
+            )
+        yield Finding(
+            kind="restart_health",
+            node=node,
+            detail=f"restart reconciliation unhealthy on {node}: "
+            + ", ".join(reasons),
+            value=float(flushes + expiries) or 1.0,
+            budget=0.0,
+            evidence={
+                "stale_deadline_flushes": flushes,
+                "gr_hold_expiries": expiries,
+                "stale_routes": stale_series[-8:],
+            },
+        )
+
+
+RULES = (
+    ("convergence_p95", eval_convergence_p95),
+    ("convergence_trend", eval_convergence_trend),
+    ("solver_health", eval_solver_health),
+    ("stream_backpressure", eval_stream_backpressure),
+    ("admission_rejections", eval_admission_rejections),
+    ("restart_health", eval_restart_health),
+)
+
+
+def evaluate(store: FleetStore, cfg: SloConfig) -> List[Finding]:
+    """One watchdog tick: run every standing rule over the store."""
+    findings: List[Finding] = []
+    for _, rule in RULES:
+        findings.extend(rule(store, cfg))
+    return findings

@@ -1,0 +1,193 @@
+"""FLEET_SMOKE tier-1 smoke (the fleet sibling of FAULT/TRACE/SOAK/
+RESTART_SMOKE): a small VirtualNetwork with the fleet observer attached
+over real ctrl sockets, one injected fault, and the observer must raise
+*exactly* the expected SLO breach — correct rule, correct node, correct
+per-stage attribution — with a well-formed forensics dump.
+
+Sequence:
+
+  1. an N-node line converges; the observer scrapes + streams every
+     node; a clean flap runs and NO rule may fire (false-positive
+     guard — solver, stream, admission and restart rules all stay armed);
+  2. ONE fault is injected: the `fib.program` action hook sets the
+     victim's `program_throttle_s` (a deterministically slow FIB agent,
+     docs/Robustness.md), so the victim's next convergence span carries
+     the delay in its fib.program stage;
+  3. a second flap runs; the observer's convergence_p95 rule must breach
+     on the victim with `fib.program_ms` named in the attribution, emit
+     one FLEET_SLO_BREACH sample carrying the forensics id, and the dump
+     must embed the victim's series tail + its solve traces.
+
+Topology size scales via FLEET_SMOKE_NODES; returns a summary dict with
+the full fleet report (`breeze fleet report --json` round-trips it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, Dict
+
+from openr_tpu.fleet.observer import FleetConfig, FleetObserver
+from openr_tpu.fleet.rules import SloConfig
+from openr_tpu.testing.faults import FaultInjector, injected
+
+
+def run_fleet_smoke() -> Dict[str, Any]:
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    n = max(3, int(os.environ.get("FLEET_SMOKE_NODES", "3")))
+    budget_ms = float(os.environ.get("FLEET_SMOKE_BUDGET_MS", "250"))
+    throttle_s = max(0.6, budget_ms / 1000.0 * 3)
+    mid = n // 2
+    victim = "n0"
+
+    async def body() -> Dict[str, Any]:
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(f"n{i}", loopback_prefix=f"10.{i}.0.0/24")
+        await net.start_all()
+        for i in range(n - 1):
+            net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+        def converged() -> bool:
+            for i in range(n):
+                got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+                want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+                if not want.issubset(got):
+                    return False
+            return True
+
+        def partitioned() -> bool:
+            left = net.wrappers["n0"].programmed_prefixes()
+            return f"10.{n - 1}.0.0/24" not in left
+
+        observer = FleetObserver.for_network(
+            net,
+            config=FleetConfig(
+                scrape_interval_s=0.15,
+                eval_every=1,
+                slo=SloConfig(
+                    convergence_p95_budget_ms=budget_ms,
+                    # the budget rule is the expected breach; the trend
+                    # detector would *also* flag the same step — keep the
+                    # smoke's "exactly one" assertion meaningful
+                    trend_min_windows=0,
+                ),
+            ),
+        )
+
+        def flap():
+            net.fail_link(
+                f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+            )
+
+        def heal():
+            net.restore_link(
+                f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+            )
+
+        with injected(FaultInjector(seed=5)) as inj:
+            try:
+                await wait_until(converged, timeout=60.0)
+                await observer.start()
+                # streams up: every node delivered its initial snapshot
+                await wait_until(
+                    lambda: observer.counters.get("fleet.stream_frames", 0)
+                    >= n,
+                    timeout=30.0,
+                )
+                # phase 1: a clean flap — no rule may fire
+                flap()
+                await wait_until(partitioned, timeout=60.0)
+                heal()
+                await wait_until(converged, timeout=60.0)
+                await wait_until(
+                    lambda: observer.store.series(victim,
+                        "interval.convergence.e2e_p95_ms") != [],
+                    timeout=30.0,
+                )
+                await asyncio.sleep(0.5)  # a few clean evaluation ticks
+                clean_findings = len(observer.findings)
+
+                # phase 2: ONE injected fault — the victim's next route
+                # programming stalls for throttle_s (a slow FIB agent)
+                victim_fib = net.wrappers[victim].daemon.fib
+                inj.arm(
+                    "fib.program",
+                    times=1,
+                    when=lambda ctx: ctx is victim_fib,
+                    action=lambda fib: setattr(
+                        fib, "program_throttle_s", throttle_s
+                    ),
+                )
+                flap()
+                await wait_until(partitioned, timeout=60.0)
+                await wait_until(
+                    lambda: len(observer.findings) > clean_findings,
+                    timeout=60.0,
+                )
+                heal()
+                await wait_until(converged, timeout=60.0)
+                fired = inj.fired("fib.program")
+            finally:
+                await observer.stop()
+                await net.stop_all()
+
+        report = observer.report()
+        summary = {
+            "nodes": n,
+            "victim": victim,
+            "throttle_s": throttle_s,
+            "budget_ms": budget_ms,
+            "clean_findings": clean_findings,
+            "faults_fired": fired,
+            "findings": [f.to_dict() for f in observer.findings],
+            "samples": [s.values() for s in observer.samples],
+            "forensics": observer.forensics,
+            "report": report,
+        }
+        # -- the smoke's contract ----------------------------------------
+        assert fired == 1, summary["faults_fired"]
+        assert clean_findings == 0, summary["findings"]
+        assert len(observer.findings) == 1, summary["findings"]
+        finding = observer.findings[0]
+        assert finding.kind == "convergence_p95", finding.to_dict()
+        assert finding.node == victim, finding.to_dict()
+        assert finding.value > budget_ms, finding.to_dict()
+        stages = [s["stage"] for s in finding.attribution]
+        assert "fib.program_ms" in stages, finding.to_dict()
+        # the breach sample is typed and carries the forensics id
+        sample = observer.samples[-1].values()
+        assert sample["event"] == "FLEET_SLO_BREACH", sample
+        assert sample["rule"] == "convergence_p95", sample
+        assert sample["node"] == victim, sample
+        assert "fib.program_ms" in sample["stages"], sample
+        # well-formed forensics: id linkage, series tail, solve traces
+        assert len(observer.forensics) == 1, summary["forensics"]
+        dump = observer.forensics[0]
+        assert dump["id"] == finding.forensics_id, dump["id"]
+        assert dump["id"] == sample["forensics_id"], dump["id"]
+        assert dump["reason"] == "convergence_p95", dump
+        assert dump["node"] == victim, dump
+        tail = dump["store_tail"]
+        assert tail["series"].get("interval.convergence.e2e_p95_ms"), tail
+        assert isinstance(dump["solve_traces"], dict), dump["solve_traces"]
+        acc = dump["accounting"]
+        assert acc["recorded"] == acc["retained"] + acc["evicted"], acc
+        # the observer actually streamed and scraped the whole fleet
+        counters = report["counters"]
+        assert counters.get("fleet.scrapes", 0) >= 2 * n, counters
+        assert counters.get("fleet.stream_frames", 0) >= n, counters
+        assert counters.get("fleet.scrape_errors", 0) == 0, counters
+        checks = report["verdict"]["checks"]
+        assert checks["store_accounting"]["ok"], checks
+        assert checks["scrape_health"]["ok"], checks
+        assert not checks["no_slo_breach"]["ok"], checks
+        return summary
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()

@@ -1,0 +1,195 @@
+"""Bounded fleet time-series store.
+
+The fleet observer (`fleet/observer.py`) scrapes every node's Prometheus
+exposition and folds the samples here: one bounded ring per
+(node, metric) pair holding (timestamp, value) points, with **exact
+eviction accounting** (`recorded == retained + evicted`, the same
+invariant the flight recorder and windowed rollups keep) so a verdict
+can always say how much history it judged from.
+
+Two non-scalar companions ride next to the rings:
+
+  - **gap markers**: when a node's telemetry stream breaks — a scrape
+    fails, a subscription overflows into a marked resync, a restart
+    window swallows a poll — the store records a typed gap for that
+    node instead of silently interpolating over the hole. Rules that
+    difference consecutive points consult the gaps so a breach is never
+    synthesized across a discontinuity, and tests can prove "no silent
+    holes" by asserting the marker exists.
+  - **histogram snapshots**: the latest cumulative `Histogram` per
+    (node, metric), rehydrated from the scrape via the sparse codec
+    (`utils/counters.py to_sparse/from_sparse`) and mergeable
+    fleet-wide with `Histogram.merge` — the distribution view forensics
+    dumps and reports serve.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from openr_tpu.utils.counters import Histogram
+
+
+class SeriesRing:
+    """One (node, metric) ring: bounded (ts, value) points with exact
+    eviction accounting."""
+
+    __slots__ = ("capacity", "points", "recorded", "evicted")
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self.points: Deque[Tuple[float, float]] = collections.deque()
+        self.recorded = 0
+        self.evicted = 0
+
+    def append(self, ts: float, value: float) -> None:
+        self.points.append((float(ts), float(value)))
+        self.recorded += 1
+        while len(self.points) > self.capacity:
+            self.points.popleft()
+            self.evicted += 1
+
+    def values(self) -> List[float]:
+        return [v for _, v in self.points]
+
+    def last(self) -> Optional[Tuple[float, float]]:
+        return self.points[-1] if self.points else None
+
+
+class FleetStore:
+    """Per-node x per-metric bounded rings + gap markers + the latest
+    per-node histogram snapshots (sparse-codec mergeable)."""
+
+    def __init__(self, capacity: int = 512, max_gaps: int = 256) -> None:
+        self.capacity = int(capacity)
+        self.max_gaps = int(max_gaps)
+        self._rings: Dict[Tuple[str, str], SeriesRing] = {}
+        # node -> bounded [(ts, reason)] discontinuity markers
+        self._gaps: Dict[str, Deque[Tuple[float, str]]] = {}
+        self.gaps_marked = 0
+        # (node, metric) -> latest cumulative Histogram (sparse-rehydrated)
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
+
+    # -- scalar rings ---------------------------------------------------
+
+    def record(self, node: str, metric: str, ts: float, value: float) -> None:
+        ring = self._rings.get((node, metric))
+        if ring is None:
+            ring = self._rings[(node, metric)] = SeriesRing(self.capacity)
+        ring.append(ts, value)
+
+    def series(self, node: str, metric: str) -> List[float]:
+        ring = self._rings.get((node, metric))
+        return ring.values() if ring is not None else []
+
+    def last(self, node: str, metric: str) -> Optional[float]:
+        ring = self._rings.get((node, metric))
+        if ring is None or not ring.points:
+            return None
+        return ring.points[-1][1]
+
+    def nodes(self) -> List[str]:
+        return sorted({node for node, _ in self._rings})
+
+    def metrics(self, node: str) -> List[str]:
+        return sorted(m for n, m in self._rings if n == node)
+
+    def accounting(self) -> Dict[str, int]:
+        """recorded == retained + evicted across every ring — the exact
+        eviction invariant the store's tests and verdicts pin."""
+        recorded = sum(r.recorded for r in self._rings.values())
+        retained = sum(len(r.points) for r in self._rings.values())
+        evicted = sum(r.evicted for r in self._rings.values())
+        return {
+            "recorded": recorded,
+            "retained": retained,
+            "evicted": evicted,
+            "rings": len(self._rings),
+        }
+
+    # -- gap markers ----------------------------------------------------
+
+    def mark_gap(self, node: str, ts: float, reason: str) -> None:
+        """Typed discontinuity for one node's telemetry (scrape failure,
+        stream resync, restart window). Never silent: bounded like the
+        rings, but the `gaps_marked` total is exact."""
+        gaps = self._gaps.get(node)
+        if gaps is None:
+            gaps = self._gaps[node] = collections.deque()
+        gaps.append((float(ts), str(reason)))
+        self.gaps_marked += 1
+        while len(gaps) > self.max_gaps:
+            gaps.popleft()
+
+    def gaps(self, node: str) -> List[Tuple[float, str]]:
+        return list(self._gaps.get(node, ()))
+
+    def gap_since(self, node: str, ts: float) -> bool:
+        """Any discontinuity for `node` at or after `ts` — the guard a
+        differencing rule consults before trusting an interval."""
+        return any(g_ts >= ts for g_ts, _ in self._gaps.get(node, ()))
+
+    # -- histogram snapshots (sparse codec) -----------------------------
+
+    def record_histogram_sparse(
+        self, node: str, metric: str, sparse: Dict[str, Any]
+    ) -> None:
+        self._hists[(node, metric)] = Histogram.from_sparse(sparse)
+
+    def record_histogram(
+        self, node: str, metric: str, hist: Histogram
+    ) -> None:
+        self._hists[(node, metric)] = hist
+
+    def node_histogram(self, node: str, metric: str) -> Optional[Histogram]:
+        return self._hists.get((node, metric))
+
+    def merged_histogram(self, metric: str) -> Histogram:
+        """Fleet-wide distribution: every node's latest snapshot folded
+        with Histogram.merge (the sparse-codec mergeability contract)."""
+        out = Histogram()
+        for (node, m), hist in self._hists.items():
+            if m == metric:
+                out.merge(hist)
+        return out
+
+    # -- export ---------------------------------------------------------
+
+    def tail(self, node: str, points: int = 32) -> Dict[str, Any]:
+        """One node's recent evidence — what a forensics dump embeds."""
+        series = {
+            metric: [
+                [round(ts, 3), value]
+                for ts, value in list(
+                    self._rings[(n, metric)].points
+                )[-points:]
+            ]
+            for (n, metric) in sorted(self._rings)
+            if n == node
+        }
+        return {
+            "node": node,
+            "series": series,
+            "gaps": [[round(ts, 3), reason] for ts, reason in
+                     self.gaps(node)][-points:],
+            "histograms": {
+                metric: hist.to_sparse()
+                for (n, metric), hist in sorted(self._hists.items())
+                if n == node
+            },
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable store summary for fleet reports."""
+        return {
+            "accounting": self.accounting(),
+            "gaps_marked": self.gaps_marked,
+            "nodes": {
+                node: {
+                    "metrics": self.metrics(node),
+                    "gaps": len(self._gaps.get(node, ())),
+                }
+                for node in self.nodes()
+            },
+        }

@@ -50,6 +50,10 @@ _GAUGE_MARKERS = (
     ".num_routes",
     ".num_unicast_routes",
     ".num_mpls_routes",
+    ".num_stale_routes",
+    ".num_dirty_prefixes",
+    ".num_dirty_labels",
+    ".synced",
     ".mesh_devices",
     ".uptime.seconds",
     ".improved_last",
@@ -226,6 +230,169 @@ def parse_metrics_text(text: str) -> Dict[str, Any]:
         "counters": counters,
         "gauges": gauges,
         "histograms": histograms,
+    }
+
+
+def histogram_from_parsed(parsed_hist: Dict[str, Any]) -> Histogram:
+    """Rehydrate a live `Histogram` from a parsed exposition histogram
+    ({"count", "sum", "buckets": {le: cumulative}}) — the scrape-side
+    bridge into the sparse-codec/merge machinery. The exposition's `le`
+    labels ARE the fixed log-bucket upper bounds (`render_metrics_text`
+    emits `Histogram.bucket_bounds(i)[1]`), so each label maps back to
+    its bucket index exactly; min/max are not carried on the wire and
+    are approximated by the occupied bucket bounds."""
+    out = Histogram()
+    per_bucket: Dict[int, int] = {}
+    prev_cum = 0.0
+    for le, cum in sorted(
+        parsed_hist.get("buckets", {}).items(),
+        key=lambda kv: (
+            float("inf") if kv[0] in ("+Inf", "inf") else float(kv[0])
+        ),
+    ):
+        if le in ("+Inf", "inf"):
+            continue  # the +Inf row re-states the total count
+        upper = float(le)
+        count = int(round(float(cum) - prev_cum))
+        prev_cum = float(cum)
+        if count <= 0:
+            continue
+        # a value epsilon under the upper bound lands in exactly the
+        # bucket this `le` label was rendered from
+        index = Histogram.bucket_index(upper * (1.0 - 1e-9))
+        per_bucket[index] = per_bucket.get(index, 0) + count
+    for index, count in per_bucket.items():
+        out.buckets[index] = count
+    out.count = int(parsed_hist.get("count", 0) or sum(per_bucket.values()))
+    out.sum = float(parsed_hist.get("sum", 0.0))
+    if per_bucket:
+        out.min = Histogram.bucket_bounds(min(per_bucket))[0]
+        out.max = Histogram.bucket_bounds(max(per_bucket))[1]
+    elif out.count:
+        out.min, out.max = 0.0, 0.0
+    return out
+
+
+class CounterEpochTracker:
+    """Typed counter-reset detection over successive scrapes of one fleet.
+
+    A restarted daemon re-exports every counter from zero. Consumers that
+    difference consecutive scrapes (rate computation, the soak harness's
+    monotonicity check, the fleet observer's interval rules) used to see
+    that as a monotonicity *violation* and had to forgive it ad hoc.
+    This tracker makes the reset a first-class **epoch**: `observe`
+    compares a node's counter map against its previous scrape and
+    returns, per scrape,
+
+      - `epoch`: the node's epoch ordinal (bumped on every detected
+        reset — Prometheus `rate()` semantics: any decrease of any
+        counter is a reset, because counters never legitimately go
+        backwards);
+      - `reset`: whether THIS observation opened a new epoch;
+      - `decreased`: the counter names that went backwards (evidence);
+      - `deltas`: per-counter increments valid *within* the epoch — on a
+        reset the new absolute values ARE the deltas (restart-from-zero
+        rebase), so rates never go negative and never double-count.
+
+    The caller decides attribution: a reset inside a known restart
+    window is expected churn; a reset with no restart to blame is the
+    violation the old check was really after.
+    """
+
+    def __init__(self) -> None:
+        self._prev: Dict[str, Dict[str, float]] = {}
+        self._epoch: Dict[str, int] = {}
+
+    def epoch(self, node: str) -> int:
+        return self._epoch.get(node, 0)
+
+    def forget(self, node: str) -> None:
+        """Drop a node's baseline without consuming a reset (the caller
+        already knows the history is discontinuous — e.g. it re-dialed a
+        brand-new emulator daemon object)."""
+        self._prev.pop(node, None)
+
+    def observe(
+        self, node: str, counters: Dict[str, float]
+    ) -> Dict[str, Any]:
+        prev = self._prev.get(node)
+        decreased = (
+            []
+            if prev is None
+            else sorted(
+                name
+                for name, value in counters.items()
+                if value < prev.get(name, 0.0)
+            )
+        )
+        reset = bool(decreased)
+        if reset:
+            self._epoch[node] = self._epoch.get(node, 0) + 1
+        base = {} if (reset or prev is None) else prev
+        deltas = {
+            name: value - base.get(name, 0.0)
+            for name, value in counters.items()
+        }
+        self._prev[node] = dict(counters)
+        return {
+            "epoch": self._epoch.get(node, 0),
+            "reset": reset,
+            "first": prev is None,
+            "decreased": decreased,
+            "deltas": deltas,
+        }
+
+
+def histogram_interval(
+    prev: Optional[Dict[str, Any]], cur: Dict[str, Any]
+) -> Dict[str, float]:
+    """Per-interval stats from two successive *cumulative* parsed
+    histograms (`parse_metrics_text` shape: {"count", "sum",
+    "buckets": {le: cumulative}}): bucket-diff the scrapes and return
+    {"count", "sum", "avg", "p95"} of just the samples recorded between
+    them. A count that went backwards is a post-restart reset — the
+    current cumulative state IS the interval (epoch rebase, same rule as
+    CounterEpochTracker)."""
+    if prev is not None and float(cur.get("count", 0)) < float(
+        prev.get("count", 0)
+    ):
+        prev = None  # counter reset: new epoch, rebase on zero
+    p_buckets = dict(prev.get("buckets", {})) if prev else {}
+    count = float(cur.get("count", 0)) - (
+        float(prev.get("count", 0)) if prev else 0.0
+    )
+    total = float(cur.get("sum", 0.0)) - (
+        float(prev.get("sum", 0.0)) if prev else 0.0
+    )
+    if count <= 0:
+        return {"count": 0.0, "sum": 0.0, "avg": 0.0, "p95": 0.0}
+
+    def le_key(le: str) -> float:
+        return float("inf") if le in ("+Inf", "inf") else float(le)
+
+    diffs = []  # (upper bound, interval cumulative count)
+    for le, cum in cur.get("buckets", {}).items():
+        d = float(cum) - float(p_buckets.get(le, 0.0))
+        diffs.append((le_key(le), max(d, 0.0)))
+    diffs.sort(key=lambda x: x[0])
+    rank = 0.95 * count
+    p95 = 0.0
+    prev_bound = 0.0
+    for bound, cum_d in diffs:
+        if cum_d >= rank:
+            # clamp +Inf to the last finite bound (the log-bucket
+            # geometry keeps finite buckets up to multi-hour tails)
+            p95 = prev_bound if bound == float("inf") else bound
+            break
+        if bound != float("inf"):
+            prev_bound = bound
+    else:
+        p95 = prev_bound
+    return {
+        "count": count,
+        "sum": total,
+        "avg": total / count,
+        "p95": p95,
     }
 
 

@@ -560,6 +560,7 @@ def run_bench_convergence(
     backend: str = "tpu",
     measure_exporter: bool = True,
     subscribers: int = 0,
+    fleet_observer: bool = False,
 ) -> dict:
     """Hello-to-programmed-route percentiles from an emulator flap run —
     bench.py's second metric line (ROADMAP "relight the benchmark").
@@ -578,7 +579,19 @@ def run_bench_convergence(
     nodes' real ctrl sockets) — bench.py's `stream_fanout_events_s` line:
     the summary gains stream_{subscribers,frames,deltas,resyncs,
     events_per_s} so delta-delivery throughput and the convergence-p95
-    cost of fan-out are measured on one run (docs/Streaming.md)."""
+    cost of fan-out are measured on one run, plus the per-subscriber
+    frame-encode bill (`ctrl.stream.encode_ms/encode_bytes`, the
+    serving-wall hypothesis meters): stream_encode_{ms_total,frames,
+    bytes} and stream_encode_share — the fraction of the batch's wall
+    clock the fleet spent re-encoding frames per connection
+    (docs/Streaming.md).
+
+    With `fleet_observer=True` the fleet observer (openr_tpu/fleet)
+    attaches over the real ctrl sockets for the whole batch — bench.py's
+    `fleet_watch_overhead_ms` line: the summary gains
+    fleet_{tick_ms,scrape_ms,scrapes,ticks} so the continuous watchdog's
+    per-tick cost is measured on the same run whose convergence p95 the
+    detached baseline measured."""
     from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
 
     n = max(3, nodes)
@@ -648,10 +661,18 @@ def run_bench_convergence(
                 and "10.0.0.0/24" not in right
             )
 
+        observer = None
         try:
             await wait_until(converged, timeout=60.0)
             if subscribers:
                 await start_subscribers()
+            if fleet_observer:
+                from openr_tpu.fleet import FleetConfig, FleetObserver
+
+                observer = FleetObserver.for_network(
+                    net, config=FleetConfig(scrape_interval_s=0.2)
+                )
+                await observer.start()
             t_stream0 = time.perf_counter()
             for _ in range(max(1, flaps)):
                 net.fail_link(
@@ -670,7 +691,55 @@ def run_bench_convergence(
             exporter_stats = (
                 _measure_exporter_overhead(net) if measure_exporter else {}
             )
+            encode_stats = {}
+            if subscribers:
+                # the serving-wall meters: per-subscriber frame encode
+                # time/bytes summed across the fleet (docs/Streaming.md)
+                ms_total = frames = nbytes = 0
+                for wrapper in net.wrappers.values():
+                    sm = wrapper.daemon.stream_manager
+                    hist = sm.histograms.get("ctrl.stream.encode_ms")
+                    if hist is not None:
+                        ms_total += hist.sum
+                        frames += hist.count
+                    nbytes += sm.counters.get(
+                        "ctrl.stream.encode_bytes", 0
+                    )
+                encode_stats = {
+                    "stream_encode_ms_total": round(ms_total, 3),
+                    "stream_encode_frames": frames,
+                    "stream_encode_bytes": nbytes,
+                    "stream_encode_us_per_frame": round(
+                        ms_total / frames * 1e3, 3
+                    )
+                    if frames
+                    else 0.0,
+                    "stream_encode_share": round(
+                        (ms_total / 1e3) / stream_elapsed, 6
+                    )
+                    if stream_elapsed > 0
+                    else 0.0,
+                }
+            fleet_stats = {}
+            if observer is not None:
+                await observer.stop()
+                tick = observer.histograms.get("fleet.tick_ms")
+                scrape = observer.histograms.get("fleet.scrape_ms")
+                fleet_stats = {
+                    "fleet_ticks": tick.count if tick else 0,
+                    "fleet_tick_ms": round(tick.avg, 4) if tick else 0.0,
+                    "fleet_scrape_ms": (
+                        round(scrape.avg, 4) if scrape else 0.0
+                    ),
+                    "fleet_scrapes": observer.counters.get(
+                        "fleet.scrapes", 0
+                    ),
+                    "fleet_findings": len(observer.findings),
+                }
+                observer = None
         finally:
+            if observer is not None:
+                await observer.stop()
             for task in sub_tasks:
                 task.cancel()
             if sub_tasks:
@@ -692,6 +761,7 @@ def run_bench_convergence(
                     if stream_elapsed > 0
                     else 0.0
                 ),
+                **encode_stats,
             }
         return {
             "nodes": n,
@@ -703,6 +773,7 @@ def run_bench_convergence(
             "e2e_max_ms": e2e["max"],
             **exporter_stats,
             **stream_stats,
+            **fleet_stats,
         }
 
     loop = asyncio.new_event_loop()

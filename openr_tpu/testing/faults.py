@@ -224,3 +224,5 @@ def injected(injector: Optional[FaultInjector] = None):
 #                         snapshot compaction), ctx=PersistentStore
 #                         (configstore/persistent_store.py)
 #   configstore.load      PersistentStore boot-time read, ctx=PersistentStore
+#   fleet.scrape          fleet-observer per-node scrape, ctx=node name
+#                         (fleet/observer.py)

@@ -42,12 +42,17 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import random
 import time
 from dataclasses import asdict, dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from openr_tpu.monitor.exporter import parse_metrics_text, prom_name
+from openr_tpu.monitor.exporter import (
+    CounterEpochTracker,
+    parse_metrics_text,
+    prom_name,
+)
 from openr_tpu.monitor.report import (
     ConvergenceRollup,
     merge_rollup_snapshots,
@@ -84,6 +89,13 @@ class SoakConfig:
     # socket, wave scrapes trigger on stream activity instead of a poll,
     # and the report gains a `stream` section (frames/resyncs per node)
     stream_scrapes: bool = False
+    # attach the fleet observer (openr_tpu/fleet) to the run over the
+    # real ctrl sockets: continuous scrape+stream collection + the SLO
+    # watchdog; the judged report gains a `fleet` section with the
+    # observer's verdict embedded (docs/Monitoring.md "Fleet observer")
+    fleet_observer: bool = False
+    fleet_budget_ms: float = 2000.0  # convergence p95 SLO for the watchdog
+    fleet_interval_s: float = 0.5
 
 
 def _chord_pool(n: int) -> List[Tuple[int, int]]:
@@ -98,50 +110,67 @@ class _ScrapeLog:
     """Per-node scrape bookkeeping: render latency, parse errors, counter
     monotonicity (the exporter's cumulative view must never go
     backwards), registry coverage (every counter/histogram the monitor
-    knows must appear in the exposition)."""
+    knows must appear in the exposition).
+
+    Restart waves are first-class, not forgiven ad hoc: `note_restart`
+    opens a restart window for a node, and within it (a) a node that
+    dies mid-scrape is *attributed* to the restart (`restart_attributed`)
+    instead of failing scrape health, and (b) the post-boot counter
+    reset is consumed as a typed epoch (`CounterEpochTracker`,
+    monitor/exporter.py) counted in `epoch_resets`. A counter decrease
+    with no restart window to blame is still a monotonicity violation —
+    the check the typed epoch sharpens rather than waters down."""
 
     def __init__(self) -> None:
         self.count = 0
         self.errors = 0
         self.monotonic_violations = 0
         self.coverage_misses = 0
+        self.restart_attributed = 0
+        self.epoch_resets = 0
         self.render_ms: List[float] = []
-        self._prev: Dict[str, Dict[str, float]] = {}
+        self._epochs = CounterEpochTracker()
+        self._restarting: set = set()
+
+    def note_restart(self, node: str) -> None:
+        """A controlled restart of `node` is in flight: attribute the
+        next scrape failure and/or counter epoch to it."""
+        self._restarting.add(node)
 
     def scrape(self, node: str, daemon) -> None:
         self.count += 1
-        # registry snapshot BEFORE the render: the exporter's own
-        # overhead metrics are recorded during the render itself, so
-        # (like Prometheus's scrape_duration) they appear one scrape
-        # late — the exported set must be a superset of this snapshot
-        expected = {
-            prom_name(name) for name in daemon.monitor.get_counters()
-        }
-        expected.update(
-            prom_name(name) + "_count"
-            for name in daemon.monitor.get_cumulative_histograms()
-        )
-        t0 = time.perf_counter()
         try:
+            # registry snapshot BEFORE the render: the exporter's own
+            # overhead metrics are recorded during the render itself, so
+            # (like Prometheus's scrape_duration) they appear one scrape
+            # late — the exported set must be a superset of this snapshot
+            expected = {
+                prom_name(name) for name in daemon.monitor.get_counters()
+            }
+            expected.update(
+                prom_name(name) + "_count"
+                for name in daemon.monitor.get_cumulative_histograms()
+            )
+            t0 = time.perf_counter()
             text = daemon.exporter.render()
             self.render_ms.append((time.perf_counter() - t0) * 1e3)
             parsed = parse_metrics_text(text)
         except Exception:
-            self.errors += 1
+            # a node that died mid-scrape (connection refused / stopped
+            # daemon) during its restart window is expected churn
+            if node in self._restarting:
+                self.restart_attributed += 1
+            else:
+                self.errors += 1
             return
-        counters = dict(parsed["counters"])
-        prev = self._prev.get(node, {})
-        for name, value in counters.items():
-            if value < prev.get(name, 0.0):
-                self.monotonic_violations += 1
-        self._prev[node] = counters
+        obs = self._epochs.observe(node, dict(parsed["counters"]))
+        if obs["reset"]:
+            if node in self._restarting:
+                self.epoch_resets += 1
+                self._restarting.discard(node)
+            else:
+                self.monotonic_violations += len(obs["decreased"])
         self.coverage_misses += len(expected - set(parsed["samples"]))
-
-    def forget(self, node: str) -> None:
-        """Drop the monotonicity baseline for one node — called after a
-        node restart, where counters legitimately reset to zero (the
-        same counter-reset tolerance Prometheus rate() applies)."""
-        self._prev.pop(node, None)
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -149,6 +178,8 @@ class _ScrapeLog:
             "errors": self.errors,
             "monotonic_violations": self.monotonic_violations,
             "coverage_misses": self.coverage_misses,
+            "restart_attributed": self.restart_attributed,
+            "epoch_resets": self.epoch_resets,
             "render_ms": percentile_summary(self.render_ms),
         }
 
@@ -471,6 +502,23 @@ def run_soak(
         fault_intervals: List[Tuple[float, float]] = []
         fired: Dict[str, int] = {}
 
+        # fleet observer (openr_tpu/fleet): continuous scrape+stream
+        # collection over the real ctrl sockets + the SLO watchdog,
+        # verdict embedded in the report's `fleet` section
+        observer = None
+        if cfg.fleet_observer:
+            from openr_tpu.fleet import FleetConfig, FleetObserver, SloConfig
+
+            observer = FleetObserver.for_network(
+                net,
+                config=FleetConfig(
+                    scrape_interval_s=cfg.fleet_interval_s,
+                    slo=SloConfig(
+                        convergence_p95_budget_ms=cfg.fleet_budget_ms
+                    ),
+                ),
+            )
+
         def scrape_all() -> None:
             for name, wrapper in net.wrappers.items():
                 scrapes.scrape(name, wrapper.daemon)
@@ -531,6 +579,8 @@ def run_soak(
                         ),
                         timeout=cfg.converge_timeout_s,
                     )
+                if observer is not None:
+                    await observer.start()
                 scrape_all()
                 for wave_i in range(cfg.waves):
                     chaos = (
@@ -573,8 +623,12 @@ def run_soak(
                         and (wave_i + 1) % cfg.restart_every == 0
                     ):
                         victim = f"n{rng.randrange(1, n - 1)}"
+                        # open the restart windows FIRST: a scrape/stream
+                        # racing the bounce is attributed, not an error
+                        scrapes.note_restart(victim)
+                        if observer is not None:
+                            observer.note_restart(victim)
                         await net.restart_node(victim)
-                        scrapes.forget(victim)  # counters reset to zero
                         restarted.append(victim)
                     t0 = time.time()
                     wave_ok = True
@@ -646,6 +700,10 @@ def run_soak(
                 fib_spans_closed = fib_spans()
                 reports = net.node_reports()
             finally:
+                fleet_report = None
+                if observer is not None:
+                    await observer.stop()
+                    fleet_report = observer.report()
                 for task in stream_tasks:
                     task.cancel()
                 if stream_tasks:
@@ -694,6 +752,7 @@ def run_soak(
                 "spans_in_rings": spans_in_rings,
                 "fib_spans_closed": fib_spans_closed,
             },
+            "fleet": fleet_report,
             **judged,
         }
 
@@ -763,9 +822,103 @@ def run_soak_smoke() -> Dict[str, Any]:
     return report
 
 
+def run_soak_round(
+    round_index: int = 1,
+    cfg: Optional[SoakConfig] = None,
+    fanout_subscribers: int = 2048,
+    fanout_nodes: int = 8,
+    fanout_flaps: int = 2,
+    out_dir: str = ".",
+) -> Dict[str, Any]:
+    """The real soak round, wired into the artifact flow (the ROADMAP
+    "run the long soak at scale" item): one full chord+chaos+restart
+    soak with stream-mode scrapes AND the fleet observer attached (its
+    verdict embedded in the artifact), followed by the fan-out push —
+    the convergence flap batch re-run under `fanout_subscribers`
+    concurrent subscriptions with the PR 13 `ctrl.stream.encode_ms` /
+    `encode_bytes` meters read off the run, so the artifact records the
+    measured per-subscriber-serialization share next to the throughput
+    it bought (the serving-wall hypothesis, docs/Streaming.md).
+
+    Writes `SOAK_r<NN>.json`; returns the artifact dict."""
+    from openr_tpu.testing.decision_harness import run_bench_convergence
+
+    if cfg is None:
+        nodes = int(os.environ.get("SOAK_ROUND_NODES", "96"))
+        cfg = SoakConfig(
+            nodes=nodes,
+            waves=int(os.environ.get("SOAK_ROUND_WAVES", "12")),
+            wave_links=2,
+            # per-wave drain time: the judged trend must measure the
+            # protocol, not cross-wave monitor-queue backlog
+            settle_s=2.0,
+            # a deep line topology floods adjacency across its whole
+            # diameter per wave: scale the deadline with the fleet
+            converge_timeout_s=max(120.0, 2.5 * nodes),
+            fault_every=3,
+            restart_every=4,
+            seed=11,
+            window_s=8.0,
+            stream_scrapes=True,
+            fleet_observer=True,
+            # the SLO budget is an operator choice per fleet: a deep
+            # line emulated on shared CPU converges in seconds, not ms
+            fleet_budget_ms=float(
+                os.environ.get("SOAK_ROUND_BUDGET_MS", "15000")
+            ),
+        )
+    t0 = time.time()
+    soak_report = run_soak(cfg)
+    soak_s = time.time() - t0
+
+    t0 = time.time()
+    fanout = run_bench_convergence(
+        nodes=fanout_nodes,
+        flaps=fanout_flaps,
+        backend="cpu",
+        measure_exporter=False,
+        subscribers=fanout_subscribers,
+    )
+    fanout_s = time.time() - t0
+    share = fanout.get("stream_encode_share", 0.0)
+    per_frame = fanout.get("stream_encode_us_per_frame", 0.0)
+    fanout["verdict"] = (
+        f"{fanout_subscribers} subscribers x {fanout_nodes} nodes: "
+        f"per-subscriber JSON encode consumed "
+        f"{share * 100:.1f}% of the batch wall clock "
+        f"({fanout.get('stream_encode_frames', 0)} frames at "
+        f"{per_frame:.1f}us/frame, "
+        f"{fanout.get('stream_encode_bytes', 0)} bytes) — the "
+        + (
+            "serving wall is real: a shared-encoding fast path would "
+            "amortize this across the fleet"
+            if share >= 0.2
+            else "encode share is below the 20% action threshold; the "
+            "fast path stays unbuilt"
+        )
+    )
+    artifact = {
+        "round": round_index,
+        "kind": "SOAK",
+        "config": asdict(cfg),
+        "soak_wall_s": round(soak_s, 1),
+        "fanout_wall_s": round(fanout_s, 1),
+        "soak": soak_report,
+        "fleet_verdict": (soak_report.get("fleet") or {}).get("verdict"),
+        "fanout": fanout,
+    }
+    path = os.path.join(out_dir, f"SOAK_r{round_index:02d}.json")
+    with open(path, "w") as fh:
+        json.dump(artifact, fh, indent=2, sort_keys=True, default=str)
+    artifact["path"] = path
+    return artifact
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI soak driver: python -m openr_tpu.testing.soak --nodes 8
-    --waves 12 --out soak.json (render with `breeze perf soak-report`)."""
+    --waves 12 --out soak.json (render with `breeze perf soak-report`);
+    `--round N` runs the full artifact round (soak + fleet observer +
+    fan-out push) and writes SOAK_rNN.json instead."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -780,8 +933,45 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=7)
     parser.add_argument("--window-s", type=float, default=1.0)
     parser.add_argument("--max-event-log", type=int, default=100)
+    parser.add_argument(
+        "--fleet-observer",
+        action="store_true",
+        help="attach the fleet observer (verdict embedded in the report)",
+    )
+    parser.add_argument(
+        "--round",
+        type=int,
+        default=None,
+        help="run the full SOAK_rNN.json artifact round instead",
+    )
+    parser.add_argument(
+        "--fanout-subscribers",
+        type=int,
+        default=2048,
+        help="fan-out push subscriber count for the artifact round",
+    )
     parser.add_argument("--out", default=None, help="JSON report path")
     args = parser.parse_args(argv)
+    if args.round is not None:
+        artifact = run_soak_round(
+            round_index=args.round,
+            fanout_subscribers=args.fanout_subscribers,
+        )
+        verdict = artifact["soak"]["verdict"]
+        fleet = artifact.get("fleet_verdict") or {}
+        print(
+            json.dumps(
+                {
+                    "soak": "PASS" if verdict["pass"] else "FAIL",
+                    "fleet": "PASS" if fleet.get("pass") else "BREACH",
+                    "encode_share": artifact["fanout"].get(
+                        "stream_encode_share"
+                    ),
+                    "artifact": artifact["path"],
+                }
+            )
+        )
+        return 0 if verdict["pass"] else 1
     cfg = SoakConfig(
         nodes=args.nodes,
         waves=args.waves,
@@ -792,6 +982,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed,
         window_s=args.window_s,
         max_event_log=args.max_event_log,
+        fleet_observer=args.fleet_observer,
     )
     report = run_soak(cfg)
     if args.out:

@@ -119,9 +119,9 @@ def test_bench_py_smoke(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_CONV_FLAPS", "1")
     bench.main([])
     out = capsys.readouterr().out.strip().splitlines()
-    assert len(out) >= 7, (
-        "bench.py must print SPF+convergence+TE+scale+exporter+stream+apsp "
-        "JSON lines"
+    assert len(out) >= 8, (
+        "bench.py must print SPF+convergence+TE+scale+exporter+stream+apsp"
+        "+fleet JSON lines"
     )
     results = [json.loads(line) for line in out]
     for result in results:
@@ -190,6 +190,18 @@ def test_bench_py_smoke(capsys, monkeypatch):
     for point in apsp["crossover"]:
         assert point["fw_close_ms"] > 0
         assert point["batched_dijkstra_ms"] > 0
+    # the fleet-observation line (ISSUE 15 'eighth metric line'): the
+    # flap batch re-run with the fleet observer attached over real ctrl
+    # sockets — mean SLO-watchdog tick cost, with the attached run's
+    # convergence p95 next to the detached baseline's (bench.py asserts
+    # the held-flat envelope itself; the contract here pins the shape)
+    fleet = results[7]
+    assert fleet["metric"] == "fleet_watch_overhead_ms"
+    assert fleet["value"] > 0
+    assert fleet["fleet_ticks"] > 0
+    assert fleet["fleet_scrapes"] > 0
+    assert fleet["attached_e2e_p95_ms"] > 0
+    assert fleet["baseline_e2e_p95_ms"] > 0
 
 
 def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):

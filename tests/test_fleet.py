@@ -1,0 +1,623 @@
+"""Fleet observer tests (docs/Monitoring.md "Fleet observer & SLO
+watchdog"): the bounded time-series store (exact eviction accounting,
+gap markers, sparse-codec histogram merge), the typed counter-reset
+epoch machinery (monitor/exporter.py), the standing SLO rules, offline
+replay, the stalled-subscription overflow/gap contract (ISSUE satellite
+3), restart attribution of mid-scrape node death (satellite 1), and the
+FLEET_SMOKE tier-1 acceptance with the `breeze fleet report --json`
+round-trip."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.fleet import (
+    FleetCollector,
+    FleetConfig,
+    FleetObserver,
+    FleetStore,
+    SloConfig,
+    evaluate,
+    replay_soak_report,
+)
+from openr_tpu.fleet.rules import (
+    E2E_COUNT,
+    E2E_P95,
+    GAUGE_PREFIX,
+    RATE_PREFIX,
+    STAGE_AVG_PREFIX,
+)
+from openr_tpu.monitor.exporter import (
+    CounterEpochTracker,
+    histogram_from_parsed,
+    histogram_interval,
+    parse_metrics_text,
+    render_metrics_text,
+)
+from openr_tpu.testing.faults import FaultInjector, injected
+from openr_tpu.utils.counters import Histogram
+
+
+def run(coro, timeout=120.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+# ---------------------------------------------------------------------------
+# store: rings, eviction accounting, gaps, histogram merge
+# ---------------------------------------------------------------------------
+
+
+class TestFleetStore:
+    def test_ring_eviction_accounting_exact(self):
+        store = FleetStore(capacity=4)
+        for i in range(11):
+            store.record("n0", "m", float(i), float(i))
+        acc = store.accounting()
+        assert acc["recorded"] == 11
+        assert acc["retained"] == 4
+        assert acc["evicted"] == 7
+        assert acc["recorded"] == acc["retained"] + acc["evicted"]
+        # the ring keeps the newest tail
+        assert store.series("n0", "m") == [7.0, 8.0, 9.0, 10.0]
+        assert store.last("n0", "m") == 10.0
+
+    def test_gap_markers_never_silent(self):
+        store = FleetStore(capacity=8)
+        store.record("n0", "m", 1.0, 1.0)
+        assert not store.gap_since("n0", 0.0)
+        store.mark_gap("n0", 2.0, "stream_resync")
+        assert store.gaps_marked == 1
+        assert store.gaps("n0") == [(2.0, "stream_resync")]
+        assert store.gap_since("n0", 1.5)
+        assert not store.gap_since("n0", 2.5)
+        # bounded, but the total stays exact
+        for i in range(600):
+            store.mark_gap("n0", float(i), "x")
+        assert store.gaps_marked == 601
+        assert len(store.gaps("n0")) == store.max_gaps
+
+    def test_histogram_merge_via_sparse_codec(self):
+        store = FleetStore()
+        h1, h2 = Histogram(), Histogram()
+        for v in (1.0, 2.0, 4.0):
+            h1.record(v)
+        for v in (8.0, 16.0):
+            h2.record(v)
+        store.record_histogram_sparse("n0", "fib.program_ms", h1.to_sparse())
+        store.record_histogram_sparse("n1", "fib.program_ms", h2.to_sparse())
+        merged = store.merged_histogram("fib.program_ms")
+        assert merged.count == 5
+        assert merged.sum == pytest.approx(31.0)
+        assert merged.max == 16.0
+        # per-node view survives next to the merge
+        assert store.node_histogram("n0", "fib.program_ms").count == 3
+
+    def test_tail_shape(self):
+        store = FleetStore(capacity=4)
+        store.record("n0", "m", 1.0, 5.0)
+        store.mark_gap("n0", 2.0, "restart")
+        h = Histogram()
+        h.record(3.0)
+        store.record_histogram("n0", "x_ms", h)
+        tail = store.tail("n0")
+        assert tail["series"]["m"] == [[1.0, 5.0]]
+        assert tail["gaps"] == [[2.0, "restart"]]
+        assert tail["histograms"]["x_ms"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# typed counter-reset epochs + histogram interval diffs (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestCounterEpochs:
+    def test_monotone_deltas_within_epoch(self):
+        tr = CounterEpochTracker()
+        first = tr.observe("n0", {"a": 5.0, "b": 1.0})
+        assert first["first"] and not first["reset"]
+        obs = tr.observe("n0", {"a": 8.0, "b": 1.0, "c": 2.0})
+        assert not obs["reset"] and obs["epoch"] == 0
+        assert obs["deltas"] == {"a": 3.0, "b": 0.0, "c": 2.0}
+
+    def test_reset_opens_typed_epoch_and_rebases(self):
+        tr = CounterEpochTracker()
+        tr.observe("n0", {"a": 100.0, "b": 7.0})
+        obs = tr.observe("n0", {"a": 3.0, "b": 7.0})
+        assert obs["reset"] is True
+        assert obs["epoch"] == 1
+        assert obs["decreased"] == ["a"]
+        # restart-from-zero rebase: the new absolutes ARE the deltas
+        assert obs["deltas"] == {"a": 3.0, "b": 7.0}
+        # next scrape differences within the new epoch
+        obs2 = tr.observe("n0", {"a": 5.0, "b": 9.0})
+        assert not obs2["reset"] and obs2["epoch"] == 1
+        assert obs2["deltas"] == {"a": 2.0, "b": 2.0}
+
+    def test_forget_consumes_no_epoch(self):
+        tr = CounterEpochTracker()
+        tr.observe("n0", {"a": 100.0})
+        tr.forget("n0")
+        obs = tr.observe("n0", {"a": 1.0})
+        assert not obs["reset"] and obs["epoch"] == 0
+
+    def test_epochs_are_per_node(self):
+        tr = CounterEpochTracker()
+        tr.observe("n0", {"a": 5.0})
+        tr.observe("n1", {"a": 5.0})
+        assert tr.observe("n0", {"a": 1.0})["epoch"] == 1
+        assert tr.observe("n1", {"a": 9.0})["epoch"] == 0
+
+
+def _parsed_hist(hist: Histogram, name: str = "convergence.e2e_ms"):
+    text = render_metrics_text({}, {name: hist}, node_name="n0")
+    parsed = parse_metrics_text(text)
+    from openr_tpu.monitor.exporter import prom_name
+
+    return parsed["histograms"][prom_name(name)]
+
+
+class TestHistogramInterval:
+    def test_interval_from_cumulative_diff(self):
+        h = Histogram()
+        for v in (10.0, 12.0):
+            h.record(v)
+        prev = _parsed_hist(h)
+        for v in (400.0, 410.0, 420.0, 430.0):
+            h.record(v)
+        cur = _parsed_hist(h)
+        interval = histogram_interval(prev, cur)
+        assert interval["count"] == 4
+        assert interval["avg"] == pytest.approx(415.0, rel=0.01)
+        # the interval p95 reflects only the NEW samples (~430ms bucket),
+        # not the old 10ms ones
+        assert 350.0 < interval["p95"] < 520.0
+
+    def test_reset_rebases_on_zero(self):
+        h = Histogram()
+        for v in (50.0, 60.0, 70.0):
+            h.record(v)
+        prev = _parsed_hist(h)
+        fresh = Histogram()
+        fresh.record(5.0)
+        interval = histogram_interval(prev, _parsed_hist(fresh))
+        assert interval["count"] == 1  # not negative, not 1-3
+        assert interval["avg"] == pytest.approx(5.0)
+
+    def test_idle_interval(self):
+        h = Histogram()
+        h.record(5.0)
+        cur = _parsed_hist(h)
+        assert histogram_interval(cur, cur)["count"] == 0
+
+    def test_histogram_from_parsed_round_trip(self):
+        h = Histogram()
+        for v in (0.5, 3.0, 3.1, 40.0, 500.0):
+            h.record(v)
+        got = histogram_from_parsed(_parsed_hist(h))
+        assert got.count == h.count
+        assert got.sum == pytest.approx(h.sum)
+        assert got.buckets == h.buckets
+        # rehydrated histograms merge like native ones
+        merged = Histogram().merge(got).merge(got)
+        assert merged.count == 2 * h.count
+
+
+# ---------------------------------------------------------------------------
+# standing SLO rules
+# ---------------------------------------------------------------------------
+
+
+def _seed_stage_baseline(store, node="n0"):
+    h = Histogram()
+    for _ in range(20):
+        h.record(2.0)
+    store.record_histogram(node, "fib.program_ms", h)
+
+
+class TestRules:
+    def test_clean_store_no_findings(self):
+        store = FleetStore()
+        store.record("n0", E2E_P95, 1.0, 20.0)
+        store.record("n0", E2E_COUNT, 1.0, 4.0)
+        store.record("n0", GAUGE_PREFIX + "decision.spf.fallback_active",
+                     1.0, 0.0)
+        assert evaluate(store, SloConfig()) == []
+
+    def test_convergence_budget_breach_names_worst_node_and_stage(self):
+        store = FleetStore()
+        for node, p95 in (("n0", 1500.0), ("n1", 2500.0), ("n2", 30.0)):
+            store.record(node, E2E_P95, 1.0, p95)
+            store.record(node, E2E_COUNT, 1.0, 3.0)
+        _seed_stage_baseline(store, "n1")
+        store.record("n1", STAGE_AVG_PREFIX + "fib.program_ms", 1.0, 2400.0)
+        store.record("n1", STAGE_AVG_PREFIX + "decision.route_build_ms",
+                     1.0, 1.0)
+        findings = evaluate(
+            store, SloConfig(convergence_p95_budget_ms=1000.0,
+                             trend_min_windows=0)
+        )
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.kind == "convergence_p95"
+        assert f.node == "n1"
+        assert f.value == 2500.0
+        assert sorted(f.evidence["offenders"]) == ["n0", "n1"]
+        stages = [s["stage"] for s in f.attribution]
+        assert stages[0] == "fib.program_ms"
+        assert "decision.route_build_ms" not in stages
+
+    def test_convergence_budget_needs_events(self):
+        store = FleetStore()
+        store.record("n0", E2E_P95, 1.0, 9999.0)
+        store.record("n0", E2E_COUNT, 1.0, 0.0)
+        cfg = SloConfig(convergence_p95_budget_ms=100.0,
+                        convergence_min_events=1, trend_min_windows=0)
+        assert evaluate(store, cfg) == []
+
+    def test_trend_step_detection(self):
+        store = FleetStore()
+        series = [10.0] * 6 + [200.0] * 4
+        for i, v in enumerate(series):
+            store.record("n0", E2E_P95, float(i), v)
+        findings = evaluate(
+            store,
+            SloConfig(convergence_p95_budget_ms=0.0, trend_min_windows=6),
+        )
+        assert [f.kind for f in findings] == ["convergence_trend"]
+        step = findings[0].evidence["step"]
+        assert step["index"] == 6
+        assert step["before_ms"] == pytest.approx(10.0)
+
+    def test_solver_health_fallback_and_trips(self):
+        store = FleetStore()
+        store.record("n0", GAUGE_PREFIX + "decision.spf.fallback_active",
+                     1.0, 1.0)
+        store.record("n1", RATE_PREFIX + "decision.spf.breaker_trips",
+                     1.0, 2.0)
+        kinds = sorted(
+            (f.kind, f.node)
+            for f in evaluate(
+                store, SloConfig(convergence_p95_budget_ms=0.0,
+                                 trend_min_windows=0)
+            )
+        )
+        assert kinds == [("solver_health", "n0"), ("solver_health", "n1")]
+
+    def test_stream_backpressure_and_admission(self):
+        store = FleetStore()
+        store.record("n0", RATE_PREFIX + "ctrl.stream.resyncs", 1.0, 3.0)
+        store.record("n1", RATE_PREFIX + "ctrl.admission.timeouts", 1.0, 1.0)
+        kinds = sorted(
+            (f.kind, f.node)
+            for f in evaluate(
+                store, SloConfig(convergence_p95_budget_ms=0.0,
+                                 trend_min_windows=0)
+            )
+        )
+        assert kinds == [
+            ("admission_rejections", "n1"),
+            ("stream_backpressure", "n0"),
+        ]
+
+    def test_restart_health_stuck_stale_routes(self):
+        store = FleetStore()
+        for i in range(8):
+            store.record("n0", GAUGE_PREFIX + "fib.num_stale_routes",
+                         float(i), 4.0)
+        store.record("n1", RATE_PREFIX + "fib.stale_deadline_flushes",
+                     1.0, 1.0)
+        findings = evaluate(
+            store, SloConfig(convergence_p95_budget_ms=0.0,
+                             trend_min_windows=0, stale_route_ticks=8)
+        )
+        assert sorted((f.kind, f.node) for f in findings) == [
+            ("restart_health", "n0"),
+            ("restart_health", "n1"),
+        ]
+
+
+# ---------------------------------------------------------------------------
+# collector: scrape folding, epochs -> gaps
+# ---------------------------------------------------------------------------
+
+
+def _scrape_text(counters, hists):
+    return render_metrics_text(counters, hists, node_name="n0")
+
+
+class TestCollector:
+    def test_fold_interval_series_and_epoch_gap(self):
+        store = FleetStore()
+        collector = FleetCollector(store)
+        h = Histogram()
+        h.record(10.0)
+        collector.fold(
+            "n0",
+            1.0,
+            _scrape_text(
+                {"ctrl.stream.resyncs": 0, "decision.spf.fallback_active": 0},
+                {"convergence.e2e_ms": h, "fib.program_ms": h},
+            ),
+        )
+        h.record(300.0)
+        h.record(320.0)
+        collector.fold(
+            "n0",
+            2.0,
+            _scrape_text(
+                {"ctrl.stream.resyncs": 2, "decision.spf.fallback_active": 0},
+                {"convergence.e2e_ms": h, "fib.program_ms": h},
+            ),
+        )
+        assert store.series("n0", E2E_COUNT) == [2.0]
+        assert store.series("n0", RATE_PREFIX + "ctrl.stream.resyncs") == [
+            2.0
+        ]
+        (p95,) = store.series("n0", E2E_P95)
+        assert 250.0 < p95 < 400.0
+        assert store.series("n0", STAGE_AVG_PREFIX + "fib.program_ms")
+        assert store.merged_histogram("fib.program_ms").count == 3
+
+        # counter reset (restarted node): typed epoch -> gap marker
+        fresh = Histogram()
+        fresh.record(5.0)
+        obs = collector.fold(
+            "n0",
+            3.0,
+            _scrape_text(
+                {"ctrl.stream.resyncs": 0, "decision.spf.fallback_active": 0},
+                {"convergence.e2e_ms": fresh, "fib.program_ms": fresh},
+            ),
+        )
+        assert obs["reset"] is True
+        assert store.gap_since("n0", 2.5)
+        assert any(r == "counter_epoch" for _, r in store.gaps("n0"))
+
+
+# ---------------------------------------------------------------------------
+# offline replay
+# ---------------------------------------------------------------------------
+
+
+class TestReplay:
+    def _soak_report(self, series, faulted=()):
+        return {
+            "windows": [
+                {
+                    "start": float(i),
+                    "events": 3,
+                    "faulted": i in faulted,
+                    "e2e_p50_ms": v / 2,
+                    "e2e_p95_ms": v,
+                    "e2e_max_ms": v * 2,
+                }
+                for i, v in enumerate(series)
+            ],
+            "verdict": {"pass": True},
+        }
+
+    def test_replay_clean_soak_passes(self):
+        report = replay_soak_report(
+            self._soak_report([10.0] * 10),
+            slo=SloConfig(convergence_p95_budget_ms=100.0),
+        )
+        assert report["verdict"]["pass"] is True
+        assert report["replayed"]["windows"] == 10
+
+    def test_replay_detects_step(self):
+        report = replay_soak_report(
+            self._soak_report([10.0] * 6 + [300.0] * 4),
+            slo=SloConfig(convergence_p95_budget_ms=100.0),
+        )
+        assert report["verdict"]["pass"] is False
+        kinds = {f["kind"] for f in report["findings"]}
+        assert "convergence_p95" in kinds
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: mid-scrape node death attribution
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeDeathAttribution:
+    def test_dead_node_counts_error_without_restart_window(self):
+        observer = FleetObserver.for_hosts(["127.0.0.1:9"])
+
+        async def body():
+            ok = await observer._scrape_node("127.0.0.1:9", {})
+            assert ok is False
+
+        run(body())
+        assert observer.counters.get("fleet.scrape_errors") == 1
+        assert not observer.counters.get("fleet.restart_attributed")
+        assert observer.store.gaps("127.0.0.1:9")[-1][1] == "scrape_error"
+
+    def test_dead_node_attributed_inside_restart_window(self):
+        observer = FleetObserver.for_hosts(["127.0.0.1:9"])
+        observer.note_restart("127.0.0.1:9", window_s=60.0)
+
+        async def body():
+            await observer._scrape_node("127.0.0.1:9", {})
+
+        run(body())
+        assert not observer.counters.get("fleet.scrape_errors")
+        assert observer.counters.get("fleet.restart_attributed") == 1
+        assert observer.store.gaps("127.0.0.1:9")[-1][1] == "restart"
+
+    def test_soak_scrape_log_attribution(self):
+        from openr_tpu.testing.soak import _ScrapeLog
+
+        class _DeadDaemon:
+            class monitor:
+                @staticmethod
+                def get_counters():
+                    raise ConnectionRefusedError("node restarting")
+
+        log = _ScrapeLog()
+        log.scrape("n1", _DeadDaemon())
+        assert log.errors == 1 and log.restart_attributed == 0
+        log.note_restart("n1")
+        log.scrape("n1", _DeadDaemon())
+        assert log.errors == 1 and log.restart_attributed == 1
+        summary = log.summary()
+        assert summary["restart_attributed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: stalled fleet subscription -> marked resync, gap-marked
+# ---------------------------------------------------------------------------
+
+
+class TestStalledSubscriptionGap:
+    def test_overflow_resync_gap_marked_no_silent_holes(self):
+        from openr_tpu.ctrl import CtrlServer
+        from openr_tpu.kvstore import InProcessTransport, KvStore
+        from openr_tpu.streaming import StreamConfig, StreamManager
+
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            store.db("0").set_key_vals(
+                {"adj:n1": _value("n1")}
+            )
+            manager = StreamManager(
+                kvstore_updates=store.updates_queue,
+                config=StreamConfig(
+                    subscriber_max_pending=1, coalesce_budget=2
+                ),
+            )
+            manager.start()
+            server = CtrlServer(
+                "n1", port=0, kvstore=store, stream_manager=manager
+            )
+            port = await server.start()
+            observer = FleetObserver.for_hosts(
+                [f"127.0.0.1:{port}"],
+                config=FleetConfig(scrape_interval_s=0.1),
+            )
+            node = f"127.0.0.1:{port}"
+            with injected(FaultInjector()) as inj:
+                # server-side stall of exactly the observer's stream
+                inj.arm(
+                    "ctrl.stream.deliver",
+                    times=None,
+                    action=lambda sub: setattr(sub, "throttle_s", 0.05),
+                    when=lambda sub: getattr(sub, "label", "")
+                    == "fleet-observer",
+                )
+                await observer.start()
+                # wait for the subscription snapshot
+                deadline = asyncio.get_running_loop().time() + 20
+                while not observer.counters.get("fleet.stream_frames"):
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                # burst far past the coalesce budget while delivery crawls
+                for i in range(30):
+                    store.db("0").set_key_vals(
+                        {f"adj:k{i}": _value("n1", version=i + 1)}
+                    )
+                    await asyncio.sleep(0.01)
+                deadline = asyncio.get_running_loop().time() + 30
+                while not observer.counters.get("fleet.stream_resyncs"):
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                inj.disarm("ctrl.stream.deliver")
+            await observer.stop()
+            await server.stop()
+            manager.stop()
+            store.stop()
+            return observer
+
+        observer = run(body())
+        # the stalled stream recovered via a MARKED resync...
+        assert observer.counters["fleet.stream_resyncs"] >= 1
+        node = observer.store.nodes()[0] if observer.store.nodes() else None
+        # ...and the store is provably gap-marked: no silent holes
+        gaps = [
+            reason
+            for n in {g for g in observer._targets_fn()}
+            for _, reason in observer.store.gaps(n)
+        ]
+        assert "stream_resync" in gaps, gaps
+        # server side confirms the overflow actually happened
+        # (coalesce -> budget exceeded -> marked resync)
+        assert observer.counters["fleet.stream_frames"] >= 2
+
+
+def _value(originator, version=1, value=b"x"):
+    from openr_tpu.types import Value
+
+    return Value(
+        version=version, originator_id=originator, value=value, ttl=600000
+    )
+
+
+# ---------------------------------------------------------------------------
+# FLEET_SMOKE (tier-1 acceptance) + breeze round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSmoke:
+    def test_fleet_smoke(self, tmp_path, capsys):
+        from openr_tpu.cli.breeze import main as breeze_main
+        from openr_tpu.fleet.smoke import run_fleet_smoke
+
+        summary = run_fleet_smoke()
+        # the acceptance assertions live inside run_fleet_smoke; pin the
+        # headline evidence here too
+        assert summary["faults_fired"] == 1
+        assert len(summary["findings"]) == 1
+        finding = summary["findings"][0]
+        assert finding["kind"] == "convergence_p95"
+        assert finding["node"] == summary["victim"]
+        assert any(
+            s["stage"] == "fib.program_ms" for s in finding["attribution"]
+        )
+        assert summary["forensics"][0]["id"] == finding["forensics_id"]
+
+        # `breeze fleet report --json` round-trips the report (offline:
+        # no daemon is dialed)
+        path = tmp_path / "fleet.json"
+        path.write_text(
+            json.dumps(summary["report"], sort_keys=True, default=str)
+        )
+        rc = breeze_main(["fleet", "report", str(path), "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fleet verdict: BREACH" in out
+        # the --json block is the exact report, round-tripped
+        blob = out[out.index("{"):]
+        assert json.loads(blob) == json.loads(path.read_text())
+
+
+# ---------------------------------------------------------------------------
+# python -m openr_tpu.fleet --replay (CLI)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_cli_replay(tmp_path, capsys):
+    from openr_tpu.fleet.__main__ import main as fleet_main
+
+    soak = {
+        "windows": [
+            {"start": float(i), "events": 2, "faulted": False,
+             "e2e_p50_ms": 5.0, "e2e_p95_ms": 10.0, "e2e_max_ms": 20.0}
+            for i in range(8)
+        ],
+        "verdict": {"pass": True},
+    }
+    src = tmp_path / "soak.json"
+    src.write_text(json.dumps(soak))
+    out = tmp_path / "fleet.json"
+    rc = fleet_main(
+        ["--replay", str(src), "--out", str(out), "--budget-ms", "100"]
+    )
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["fleet"] == "PASS"
+    report = json.loads(out.read_text())
+    assert report["verdict"]["pass"] is True
+    assert report["replayed"]["windows"] == 8
