@@ -340,6 +340,7 @@ def _apply_smoke_env() -> None:
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "200"),
             ("BENCH_STREAM_SUBS", "8"),
+            ("BENCH_STREAM_SWEEP", "4"),
             ("BENCH_APSP_N", "96"),
             ("BENCH_APSP_SWEEP", "48,96"),
             ("BENCH_APSP_REPEATS", "1"),
@@ -368,6 +369,7 @@ def _apply_reduced_env() -> None:
             ("BENCH_SCALE_FLAPS", "2"),
             ("BENCH_EXPORTER_RECORDS", "500"),
             ("BENCH_STREAM_SUBS", "16"),
+            ("BENCH_STREAM_SWEEP", "8"),
             ("BENCH_APSP_N", "256"),
             ("BENCH_APSP_SWEEP", "64,128,256"),
             ("BENCH_APSP_REPEATS", "1"),
@@ -713,12 +715,41 @@ def _bench_stream() -> dict:
             f"convergence p95 {p95:.1f}ms with {subscribers} subscribers "
             f"vs {baseline_p95:.1f}ms baseline: fan-out is not isolated"
         )
+    # subscriber sweep: the same flap batch at other fan-out widths, so
+    # one BENCH round records how delivery rate and encode share scale
+    # with subscriber count (BENCH_STREAM_SWEEP, comma-separated counts;
+    # smoke/reduced envs pin tiny defaults — degraded rounds inherit the
+    # reduced sweep like every other knob)
+    sweep_counts = [
+        int(x)
+        for x in os.environ.get("BENCH_STREAM_SWEEP", "16,256").split(",")
+        if x.strip() and int(x) != subscribers
+    ]
+    sweep = []
+    for count in sweep_counts:
+        point = run_bench_convergence(
+            nodes=nodes,
+            flaps=flaps,
+            backend=backend,
+            measure_exporter=False,
+            subscribers=count,
+        )
+        sweep.append(
+            {
+                "subscribers": count,
+                "events_s": round(point["stream_events_per_s"], 1),
+                "encode_share": point["stream_encode_share"],
+                "class_hit_rate": point["stream_class_hit_rate"],
+            }
+        )
     _note(
         f"stream: {subscribers} subscriber(s) x {summary['nodes']}-node "
         f"flap batch -> {summary['stream_deltas']} deliveries "
         f"({summary['stream_events_per_s']:,.0f}/s), "
-        f"{summary['stream_resyncs']} resync(s); e2e p95 {p95:.1f}ms "
-        f"vs {baseline_p95:.1f}ms without subscribers"
+        f"{summary['stream_resyncs']} resync(s); encode share "
+        f"{summary['stream_encode_share'] * 100:.1f}% (class hit rate "
+        f"{summary['stream_class_hit_rate'] * 100:.0f}%); e2e p95 "
+        f"{p95:.1f}ms vs {baseline_p95:.1f}ms without subscribers"
     )
     return {
         "metric": "stream_fanout_events_s",
@@ -733,6 +764,13 @@ def _bench_stream() -> dict:
         "subscribers": subscribers,
         "deliveries": summary["stream_deltas"],
         "resyncs": summary["stream_resyncs"],
+        # the shared-encode meters (docs/Streaming.md): fraction of the
+        # batch wall clock spent on REAL body serializations, and how
+        # often subscribers reused a filter-class's shared bytes
+        "encode_share": summary["stream_encode_share"],
+        "encode_classes": summary["stream_encode_classes"],
+        "class_hit_rate": summary["stream_class_hit_rate"],
+        "sweep": sweep,
         "e2e_p95_ms": round(p95, 2),
         "baseline_e2e_p95_ms": round(baseline_p95, 2),
     }

@@ -134,6 +134,7 @@ def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
             prefixes=[args.prefix] if args.prefix else [],
             originators=args.originator or [],
             client=args.client,
+            codec=args.codec,
         ):
             kind = frame.get("type", "delta")
             pub = frame.get("pub", {})
@@ -324,7 +325,7 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
         # initial RIB snapshot then per-event DecisionRouteUpdate deltas
         # fed from Decision's DeltaPath stream (docs/Streaming.md)
         for frame in client.subscribe(
-            "subscribeRouteDb", client=args.client
+            "subscribeRouteDb", client=args.client, codec=args.codec
         ):
             kind = frame.get("type", "delta")
             if kind in ("snapshot", "resync"):
@@ -993,6 +994,13 @@ def build_parser() -> argparse.ArgumentParser:
         default="breeze",
         help="client label (admission fairness / stream stats)",
     )
+    p.add_argument(
+        "--codec",
+        default="json",
+        choices=["json", "binary"],
+        help="stream frame codec; binary negotiates length-prefixed "
+        "frames, falling back to JSON on old servers",
+    )
 
     dec = sub.add_parser("decision").add_subparsers(dest="cmd", required=True)
     dec.add_parser("adj")
@@ -1036,6 +1044,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--client",
         default="breeze",
         help="client label (admission fairness / stream stats)",
+    )
+    p.add_argument(
+        "--codec",
+        default="json",
+        choices=["json", "binary"],
+        help="stream frame codec; binary negotiates length-prefixed "
+        "frames, falling back to JSON on old servers",
     )
     p = dec.add_parser("path")
     p.add_argument("src")

@@ -114,23 +114,78 @@ class CtrlClient:
             _raise_ctrl_error(resp)
         return resp.get("result")
 
-    async def subscribe(self, method: str, **params):
-        """Async iterator over stream frames (subscribeKvStoreFilter)."""
+    async def subscribe(self, method: str, decode: bool = True, **params):
+        """Async iterator over stream frames (subscribeKvStoreFilter).
+
+        Pass ``codec="binary"`` to request the length-prefixed binary
+        frame codec (docs/Streaming.md "Codec negotiation"): the server
+        acks with one ``{"id": N, "codec": "binary"}`` line before
+        switching framing. A server that predates the codec ignores the
+        param and streams newline-JSON — the absent ack IS the graceful
+        fallback, so consumers see identical payload dicts either way.
+
+        ``decode=False`` is the fast-consumer mode for meters and
+        benchmark watchers: every frame is still read off the socket in
+        full, but the payload is not parsed — frames yield just
+        ``{"type": kind}`` (plus ``seq`` on binary streams), read from
+        the frame header / envelope prefix. The first JSON line is
+        always fully parsed so codec negotiation and typed errors
+        behave identically."""
         assert self._writer is not None, "not connected"
         self._next_id += 1
+        want_binary = params.get("codec") == "binary"
         req = {"id": self._next_id, "method": method, "params": params}
         self._writer.write(json.dumps(req).encode() + b"\n")
         await self._writer.drain()
+        binary = False
+        first = True
         while True:
+            if binary:
+                payload = await self._read_binary_frame(method, decode)
+                if payload is None:
+                    return
+                yield payload
+                continue
             line = await self._reader.readline()
             if not line:
                 return
+            if not decode and not first:
+                # the envelope prefix is pinned byte-identical to
+                # json.dumps (streaming/codec.py), so the frame type
+                # sits at a fixed early offset — sniff it instead of
+                # parsing the whole line; anything unexpected (done,
+                # error) falls through to the full parse below
+                i = line.find(b'"type": "', 0, 96)
+                if i >= 0:
+                    j = i + 9
+                    yield {"type": line[j : line.index(b'"', j)].decode()}
+                    continue
             frame = json.loads(line)
             if "error" in frame:
                 _raise_ctrl_error(frame)
+            if first and want_binary and frame.get("codec") == "binary":
+                binary = True
+                first = False
+                continue
+            first = False
             if frame.get("done"):
                 return
             yield frame["stream"]
+
+    async def _read_binary_frame(self, method: str, decode: bool = True):
+        from openr_tpu.streaming import codec as stream_codec
+
+        try:
+            header = await self._reader.readexactly(4)
+            length, _ = stream_codec.frame_header_info(header)
+            payload = await self._reader.readexactly(length)
+        except asyncio.IncompleteReadError:
+            return None  # connection closed mid-frame: end of stream
+        if not decode:
+            kind, seq = stream_codec.frame_kind_seq(payload)
+            return {"type": kind, "seq": seq}
+        stream = "routes" if "Route" in method else "kv"
+        return stream_codec.decode_binary_frame(payload, stream)
 
 
 class BlockingCtrlClient:
@@ -176,17 +231,58 @@ class BlockingCtrlClient:
         return resp.get("result")
 
     def subscribe(self, method: str, **params) -> Iterator[Dict]:
+        """Sync stream iterator; ``codec="binary"`` negotiates the
+        binary framing exactly as CtrlClient.subscribe does, with the
+        same graceful JSON fallback against old servers."""
         self._next_id += 1
+        want_binary = params.get("codec") == "binary"
         req = {"id": self._next_id, "method": method, "params": params}
         self._file.write(json.dumps(req).encode() + b"\n")
         self._file.flush()
+        binary = False
+        first = True
         while True:
+            if binary:
+                payload = self._read_binary_frame(method)
+                if payload is None:
+                    return
+                yield payload
+                continue
             line = self._file.readline()
             if not line:
                 return
             frame = json.loads(line)
             if "error" in frame:
                 _raise_ctrl_error(frame)
+            if first and want_binary and frame.get("codec") == "binary":
+                binary = True
+                first = False
+                continue
+            first = False
             if frame.get("done"):
                 return
             yield frame["stream"]
+
+    def _read_binary_frame(self, method: str):
+        from openr_tpu.streaming import codec as stream_codec
+
+        header = self._read_exact(4)
+        if header is None:
+            return None
+        length, _ = stream_codec.frame_header_info(header)
+        payload = self._read_exact(length)
+        if payload is None:
+            return None
+        stream = "routes" if "Route" in method else "kv"
+        return stream_codec.decode_binary_frame(payload, stream)
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._file.read(remaining)
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)

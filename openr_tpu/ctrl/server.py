@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from openr_tpu.kvstore import wire
 from openr_tpu.messaging import QueueClosedError
+from openr_tpu.testing.faults import fault_point
 from openr_tpu.types import (
     ADJ_DB_MARKER,
     IpPrefix,
@@ -868,18 +869,63 @@ class CtrlServer:
             )
         return self.kvstore.dump_all(area=area, filters=filters)
 
-    async def _send_frame(self, writer, req_id, payload) -> None:
-        # per-subscriber JSON re-encoding is the ROADMAP's candidate next
-        # serving wall: time and size every frame encode here so the
-        # shared-encoding hypothesis is measurable before anyone builds
-        # the fast path (ctrl.stream.encode_* — docs/Streaming.md)
+    def _encode_body(self, encode, *args) -> bytes:
+        """One PRIVATE body serialization (snapshot, resync, or a
+        coalesced per-subscriber frame) — metered as a real encode so
+        `ctrl.stream.encode_*` stays the full serialization bill; the
+        shared path meters its class encodes in `SharedFrame.body`."""
         t0 = time.perf_counter()
-        data = json.dumps({"id": req_id, "stream": payload}).encode() + b"\n"
+        body = encode(*args)
         if self.stream_manager is not None:
             self.stream_manager.note_encode(
-                (time.perf_counter() - t0) * 1e3, len(data)
+                (time.perf_counter() - t0) * 1e3, len(body)
             )
-        writer.write(data)
+        return body
+
+    async def _write_frame(
+        self, writer, segments, drain: bool = True, legacy_path: bool = False
+    ) -> None:
+        """Per-subscriber delivery: splice the envelope around the
+        (possibly shared) body in ONE transport write — writev-style,
+        `writelines` joins the segments once instead of issuing one
+        socket send per segment. `ctrl.stream.deliver_*` meters exactly
+        this work; the drain (socket backpressure, a slow client's
+        stall) stays outside it. Callers delivering a burst pass
+        `drain=False` while the subscriber queue still holds frames and
+        drain once at burst end — the buffered bytes stay bounded by
+        `subscriber_max_pending` frames, and a stalled client still
+        blocks its own task at the burst-end drain, nobody else's.
+
+        `legacy_path` (the `stream_config.shared_encode: false` A/B
+        baseline / rollback, docs/Streaming.md) restores the
+        pre-sharing delivery verbatim: one transport write per segment
+        and an unconditional per-frame drain — so the before/after
+        meters compare the old serving path against the new one, not a
+        half-upgraded hybrid."""
+        t0 = time.perf_counter()
+        if legacy_path:
+            total = 0
+            for seg in segments:
+                writer.write(seg)
+                total += len(seg)
+        else:
+            writer.writelines(segments)
+            total = sum(len(seg) for seg in segments)
+        if self.stream_manager is not None:
+            self.stream_manager.note_deliver(
+                (time.perf_counter() - t0) * 1e3, total
+            )
+        if drain or legacy_path:
+            await writer.drain()
+
+    async def _ack_codec(self, writer, req_id, codec_name) -> None:
+        """Codec negotiation (docs/Streaming.md): one JSON ack line, then
+        every frame on this stream is length-prefixed binary. A server
+        without binary support never sends the ack, so old clients and
+        old servers both fall back to newline-JSON gracefully."""
+        writer.write(
+            json.dumps({"id": req_id, "codec": codec_name}).encode() + b"\n"
+        )
         await writer.drain()
 
     async def _deliver_gate(self, sub) -> None:
@@ -888,8 +934,6 @@ class CtrlServer:
         stream down (the client reconnects and resyncs), an armed action
         may set `sub.throttle_s` to emulate a slow client; the throttle
         is consumed one-shot per frame."""
-        from openr_tpu.testing.faults import fault_point
-
         fault_point("ctrl.stream.deliver", sub)
         delay, sub.throttle_s = sub.throttle_s, 0.0
         if delay:
@@ -899,50 +943,78 @@ class CtrlServer:
         self, req_id, writer, params, legacy: bool = False
     ) -> None:
         assert self.stream_manager is not None, "stream manager not wired"
+        from openr_tpu.streaming import SharedFrame
+        from openr_tpu.streaming import codec as stream_codec
+
         area = params.get("area", "0")
         prefixes = params.get("prefixes") or []
         originators = params.get("originators") or []
+        # legacy streams stay newline-JSON (the debug/compat path);
+        # unknown codec names degrade to JSON, never error
+        codec_name = stream_codec.CODEC_JSON
+        if not legacy:
+            codec_name = stream_codec.normalize_codec(params.get("codec"))
         sub = self.stream_manager.add_kvstore_subscriber(
             area=area,
             prefixes=prefixes,
             originators=set(originators),
             label=str(params.get("client") or ""),
         )
+        # shared_encode=false is the A/B baseline: serve exactly the way
+        # the pre-sharing code did (see _write_frame)
+        legacy_delivery = not self.stream_manager.config.shared_encode
         try:
+            if codec_name == stream_codec.CODEC_BINARY:
+                await self._ack_codec(writer, req_id, codec_name)
             # register-then-snapshot: a publication landing between the
             # two shows up in the snapshot AND as a delta — per-key
             # version merge makes the replay idempotent, nothing is lost
             snapshot = self._kv_snapshot(area, prefixes, originators)
             seq = 0
-            await self._send_frame(
+            body = self._encode_body(
+                stream_codec.encode_kv_body, snapshot, codec_name
+            )
+            await self._write_frame(
                 writer,
-                req_id,
-                _publication_to_json(snapshot)
-                if legacy
-                else {
-                    "type": "snapshot",
-                    "seq": seq,
-                    "area": area,
-                    "pub": _publication_to_json(snapshot),
-                },
+                stream_codec.kv_frame_segments(
+                    codec_name, req_id, "snapshot", seq, area, body, legacy
+                ),
             )
             while True:
-                kind, pub, t_enq = await sub.next_frame()
+                kind, frame, t_enq = await sub.next_frame()
                 if kind == "closed":
                     return
                 await self._deliver_gate(sub)
                 seq += 1
                 if kind == "resync":
+                    # per-subscriber state: a fresh marked snapshot,
+                    # encoded privately — it re-enters the shared path
+                    # once the class re-converges on live deltas
                     pub = self._kv_snapshot(area, prefixes, originators)
-                payload = _publication_to_json(pub)
-                if not legacy:
-                    payload = {
-                        "type": kind,
-                        "seq": seq,
-                        "area": area,
-                        "pub": payload,
-                    }
-                await self._send_frame(writer, req_id, payload)
+                    body = self._encode_body(
+                        stream_codec.encode_kv_body, pub, codec_name
+                    )
+                elif isinstance(frame, SharedFrame):
+                    # the shared path: bytes encoded once per
+                    # filter-equivalence class, reused here
+                    body = frame.body(codec_name)
+                else:
+                    # coalesced merges (and the shared_encode=false
+                    # path) are per-subscriber state: private encode
+                    body = self._encode_body(
+                        stream_codec.encode_kv_body, frame, codec_name
+                    )
+                # burst-drain: while the queue holds more frames, keep
+                # splicing into the transport buffer and drain once at
+                # burst end (bounded by subscriber_max_pending frames)
+                await self._write_frame(
+                    writer,
+                    stream_codec.kv_frame_segments(
+                        codec_name, req_id, kind, seq, area, body, legacy
+                    ),
+                    drain=not (sub._frames or sub._resync_at is not None),
+                    legacy_path=legacy_delivery,
+                )
                 self.stream_manager.mark_delivered(sub, t_enq)
         # CancelledError must PROPAGATE: server shutdown cancels this
         # connection task mid-stream, and swallowing the cancel here sent
@@ -960,8 +1032,9 @@ class CtrlServer:
     async def _kvstore_stream_legacy(self, req_id, writer, params) -> None:
         await self._kvstore_stream(req_id, writer, params, legacy=True)
 
-    def _route_db_payload(self, kind: str, seq: int) -> Dict[str, Any]:
-        """Full computed RIB as a snapshot/resync frame payload."""
+    def _route_db_fields(self) -> Dict[str, Any]:
+        """Full computed RIB as the four route-list fields of a
+        snapshot/resync frame body."""
         db = self.decision.get_decision_route_db(None)
         unicast = mpls = []
         if db is not None:
@@ -974,8 +1047,6 @@ class CtrlServer:
                 for e in db.mpls_entries.values()
             ]
         return {
-            "type": kind,
-            "seq": seq,
             "unicast_to_update": unicast,
             "unicast_to_delete": [],
             "mpls_to_update": mpls,
@@ -984,44 +1055,57 @@ class CtrlServer:
 
     async def _route_stream(self, req_id, writer, params) -> None:
         assert self.stream_manager is not None, "stream manager not wired"
+        from openr_tpu.streaming import SharedFrame
+        from openr_tpu.streaming import codec as stream_codec
+
+        codec_name = stream_codec.normalize_codec(params.get("codec"))
         sub = self.stream_manager.add_route_subscriber(
             label=str(params.get("client") or "")
         )
+        legacy_delivery = not self.stream_manager.config.shared_encode
         try:
+            if codec_name == stream_codec.CODEC_BINARY:
+                await self._ack_codec(writer, req_id, codec_name)
             seq = 0
-            await self._send_frame(
-                writer, req_id, self._route_db_payload("snapshot", seq)
+            body = self._encode_body(
+                stream_codec.encode_route_body,
+                self._route_db_fields(),
+                codec_name,
+            )
+            await self._write_frame(
+                writer,
+                stream_codec.route_frame_segments(
+                    codec_name, req_id, "snapshot", seq, body
+                ),
             )
             while True:
-                kind, update, t_enq = await sub.next_frame()
+                kind, frame, t_enq = await sub.next_frame()
                 if kind == "closed":
                     return
                 await self._deliver_gate(sub)
                 seq += 1
                 if kind == "resync":
-                    payload = self._route_db_payload("resync", seq)
+                    body = self._encode_body(
+                        stream_codec.encode_route_body,
+                        self._route_db_fields(),
+                        codec_name,
+                    )
+                elif isinstance(frame, SharedFrame):
+                    body = frame.body(codec_name)
                 else:
-                    payload = {
-                        "type": "delta",
-                        "seq": seq,
-                        "unicast_to_update": [
-                            _obj_to_json(e.to_unicast_route())
-                            for e in update.unicast_routes_to_update
-                        ],
-                        "unicast_to_delete": [
-                            str(p)
-                            for p in update.unicast_routes_to_delete
-                        ],
-                        "mpls_to_update": [
-                            _obj_to_json(e.to_mpls_route())
-                            for e in update.mpls_routes_to_update
-                        ],
-                        "mpls_to_delete": [
-                            int(label)
-                            for label in update.mpls_routes_to_delete
-                        ],
-                    }
-                await self._send_frame(writer, req_id, payload)
+                    body = self._encode_body(
+                        stream_codec.encode_route_body,
+                        stream_codec.route_fields_from_update(frame),
+                        codec_name,
+                    )
+                await self._write_frame(
+                    writer,
+                    stream_codec.route_frame_segments(
+                        codec_name, req_id, kind, seq, body
+                    ),
+                    drain=not (sub._frames or sub._resync_at is not None),
+                    legacy_path=legacy_delivery,
+                )
                 self.stream_manager.mark_delivered(sub, t_enq)
         # CancelledError must propagate (see _kvstore_stream)
         except (

@@ -10,6 +10,7 @@ from openr_tpu.streaming.admission import (
 from openr_tpu.streaming.subscription import (
     KvSubscription,
     RouteSubscription,
+    SharedFrame,
     StreamConfig,
     StreamManager,
     SubscriberLimitError,
@@ -22,6 +23,7 @@ __all__ = [
     "KvSubscription",
     "RouteSubscription",
     "ServerBusyError",
+    "SharedFrame",
     "StreamConfig",
     "StreamManager",
     "SubscriberLimitError",

@@ -1,0 +1,318 @@
+"""Stream frame codecs: newline-JSON (default/debug) and length-prefixed
+binary, with the body/envelope split the shared-encode fan-out rides on.
+
+Every stream frame the ctrl server sends is an envelope (request id,
+frame type, sequence number) around a **body** — the serialized KvStore
+publication or route-update lists. The body is the expensive part and is
+identical for every subscriber in a filter-equivalence class, so it is
+encoded here as standalone bytes that a `SharedFrame` can memoize once
+per class and every class member can splice into its own envelope with
+plain buffer writes (writev-style — no per-subscriber re-serialization,
+no body copy; docs/Streaming.md "Shared-encode fan-out").
+
+Two codecs produce interchangeable frames:
+
+  - ``json`` — the wire stays exactly what it always was: one
+    ``{"id": N, "stream": {...}}`` line per frame. The envelope splice is
+    byte-identical to ``json.dumps`` of the whole frame (same default
+    separators, same key order), so a shared-path frame and a privately
+    encoded frame cannot be told apart on the wire.
+  - ``binary`` — length-prefixed frames negotiated per connection at
+    subscribe time (docs/Streaming.md "Codec negotiation"): a JSON ack
+    line ``{"id": N, "codec": "binary"}``, then ``u32 length`` +
+    ``u8 frame-type`` + ``u32 seq`` + body. Bodies carry raw value bytes
+    (no base64) and struct-packed fields; decode reproduces the exact
+    JSON payload dict, so consumers stay codec-agnostic.
+
+Snapshot/resync/coalesced frames are per-subscriber state: they use the
+same body encoders privately and re-enter the shared path only when
+their class re-converges on live deltas.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from openr_tpu.types import Publication
+
+CODEC_JSON = "json"
+CODEC_BINARY = "binary"
+CODECS = (CODEC_JSON, CODEC_BINARY)
+
+# binary frame types (u8 in the frame header)
+FT_SNAPSHOT = 1
+FT_DELTA = 2
+FT_RESYNC = 3
+_FT_BY_KIND = {"snapshot": FT_SNAPSHOT, "delta": FT_DELTA, "resync": FT_RESYNC}
+_KIND_BY_FT = {v: k for k, v in _FT_BY_KIND.items()}
+
+# binary frame header: payload length (excl. itself), frame type, seq
+_HDR = struct.Struct("!IBI")
+# per-value metadata: flags, version, ttl, ttl_version, hash, value length
+_VAL = struct.Struct("!Bqqqqi")
+_F_HAS_VALUE = 1
+_F_HAS_HASH = 2
+
+# hard cap on one binary frame payload, mirroring the JSON _LINE_LIMIT
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def normalize_codec(name: Optional[str]) -> str:
+    """Clamp a client-requested codec to a supported one. Unknown names
+    fall back to JSON (graceful degradation, never an error)."""
+    return CODEC_BINARY if name == CODEC_BINARY else CODEC_JSON
+
+
+# ---------------------------------------------------------------------------
+# body encoders — the per-class (shared) serialization work
+# ---------------------------------------------------------------------------
+
+
+def _pub_to_json(pub: Publication) -> Dict[str, Any]:
+    """Subscriber-facing publication dict (node_ids/tobe_updated_keys are
+    peer-sync internals, intentionally omitted — ctrl/server.py keeps the
+    same shape)."""
+    from openr_tpu.kvstore import wire
+
+    return {
+        "area": pub.area,
+        "key_vals": wire.key_vals_to_json(pub.key_vals),
+        "expired_keys": list(pub.expired_keys),
+    }
+
+
+def _pack_str(out: List[bytes], text: str) -> None:
+    raw = text.encode()
+    out.append(struct.pack("!H", len(raw)))
+    out.append(raw)
+
+
+class _Cursor:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        chunk = self.data[self.pos : self.pos + n]
+        if len(chunk) != n:
+            raise ValueError("truncated binary frame body")
+        self.pos += n
+        return chunk
+
+    def unpack(self, st: struct.Struct) -> tuple:
+        return st.unpack(self.take(st.size))
+
+    def read_str(self) -> str:
+        (n,) = self.unpack(struct.Struct("!H"))
+        return self.take(n).decode()
+
+
+def encode_kv_body(pub: Publication, codec: str) -> bytes:
+    """Serialize one publication as a standalone frame body."""
+    if codec == CODEC_JSON:
+        return json.dumps(_pub_to_json(pub)).encode()
+    out: List[bytes] = []
+    _pack_str(out, pub.area)
+    out.append(struct.pack("!I", len(pub.key_vals)))
+    for key, v in pub.key_vals.items():
+        _pack_str(out, key)
+        flags = (_F_HAS_VALUE if v.value is not None else 0) | (
+            _F_HAS_HASH if v.hash is not None else 0
+        )
+        raw = v.value or b""
+        out.append(
+            _VAL.pack(
+                flags,
+                v.version,
+                v.ttl,
+                v.ttl_version,
+                v.hash if v.hash is not None else 0,
+                len(raw),
+            )
+        )
+        _pack_str(out, v.originator_id)
+        out.append(raw)
+    out.append(struct.pack("!I", len(pub.expired_keys)))
+    for key in pub.expired_keys:
+        _pack_str(out, key)
+    return b"".join(out)
+
+
+def decode_kv_body(body: bytes) -> Dict[str, Any]:
+    """Binary kv body -> the exact `_pub_to_json` dict shape (value bytes
+    back to base64, None-ness restored) — codec-agnostic consumers."""
+    cur = _Cursor(body)
+    area = cur.read_str()
+    (nkeys,) = cur.unpack(struct.Struct("!I"))
+    key_vals: Dict[str, Any] = {}
+    for _ in range(nkeys):
+        key = cur.read_str()
+        flags, version, ttl, ttl_version, vhash, vlen = cur.unpack(_VAL)
+        originator = cur.read_str()
+        raw = cur.take(vlen)
+        key_vals[key] = {
+            "version": version,
+            "originator_id": originator,
+            "value": (
+                base64.b64encode(raw).decode()
+                if flags & _F_HAS_VALUE
+                else None
+            ),
+            "ttl": ttl,
+            "ttl_version": ttl_version,
+            "hash": vhash if flags & _F_HAS_HASH else None,
+        }
+    (nexpired,) = cur.unpack(struct.Struct("!I"))
+    expired = [cur.read_str() for _ in range(nexpired)]
+    return {"area": area, "key_vals": key_vals, "expired_keys": expired}
+
+
+def route_fields_from_update(update) -> Dict[str, Any]:
+    """DecisionRouteUpdate -> the four route-list fields of a delta frame
+    (b64 serializer blobs, the shape docs/Streaming.md documents)."""
+    from openr_tpu.utils import serializer
+
+    def blob(obj) -> str:
+        return base64.b64encode(serializer.dumps(obj)).decode()
+
+    return {
+        "unicast_to_update": [
+            blob(e.to_unicast_route()) for e in update.unicast_routes_to_update
+        ],
+        "unicast_to_delete": [
+            str(p) for p in update.unicast_routes_to_delete
+        ],
+        "mpls_to_update": [
+            blob(e.to_mpls_route()) for e in update.mpls_routes_to_update
+        ],
+        "mpls_to_delete": [
+            int(label) for label in update.mpls_routes_to_delete
+        ],
+    }
+
+
+def encode_route_body(fields: Dict[str, Any], codec: str) -> bytes:
+    """Serialize the four route-list fields as a standalone body. JSON
+    bodies keep the object braces — the envelope splice strips them."""
+    if codec == CODEC_JSON:
+        return json.dumps(fields).encode()
+    out: List[bytes] = []
+    for field in ("unicast_to_update", "mpls_to_update"):
+        blobs = fields[field]
+        out.append(struct.pack("!I", len(blobs)))
+        for b64_text in blobs:
+            raw = base64.b64decode(b64_text)
+            out.append(struct.pack("!I", len(raw)))
+            out.append(raw)
+    out.append(struct.pack("!I", len(fields["unicast_to_delete"])))
+    for prefix in fields["unicast_to_delete"]:
+        _pack_str(out, prefix)
+    out.append(struct.pack("!I", len(fields["mpls_to_delete"])))
+    for label in fields["mpls_to_delete"]:
+        out.append(struct.pack("!i", int(label)))
+    return b"".join(out)
+
+
+def decode_route_body(body: bytes) -> Dict[str, Any]:
+    cur = _Cursor(body)
+    u32 = struct.Struct("!I")
+    updates: Dict[str, List[str]] = {}
+    for field in ("unicast_to_update", "mpls_to_update"):
+        (n,) = cur.unpack(u32)
+        blobs = []
+        for _ in range(n):
+            (blen,) = cur.unpack(u32)
+            blobs.append(base64.b64encode(cur.take(blen)).decode())
+        updates[field] = blobs
+    (n,) = cur.unpack(u32)
+    unicast_delete = [cur.read_str() for _ in range(n)]
+    (n,) = cur.unpack(u32)
+    mpls_delete = [
+        cur.unpack(struct.Struct("!i"))[0] for _ in range(n)
+    ]
+    return {
+        "unicast_to_update": updates["unicast_to_update"],
+        "unicast_to_delete": unicast_delete,
+        "mpls_to_update": updates["mpls_to_update"],
+        "mpls_to_delete": mpls_delete,
+    }
+
+
+# ---------------------------------------------------------------------------
+# envelopes — the cheap per-subscriber splice around a shared body
+# ---------------------------------------------------------------------------
+
+
+def kv_frame_segments(
+    codec: str,
+    req_id: int,
+    kind: str,
+    seq: int,
+    area: str,
+    body: bytes,
+    legacy: bool = False,
+) -> List[bytes]:
+    """Write-ready segments for one kv frame: a per-subscriber envelope
+    prefix, the (possibly shared) body, a suffix. The JSON splice is
+    byte-identical to json.dumps of the whole frame."""
+    if codec == CODEC_BINARY:
+        return [_HDR.pack(len(body) + 5, _FT_BY_KIND[kind], seq), body]
+    if legacy:
+        prefix = '{"id": %d, "stream": ' % req_id
+        return [prefix.encode(), body, b"}\n"]
+    prefix = '{"id": %d, "stream": {"type": "%s", "seq": %d, "area": %s, "pub": ' % (
+        req_id,
+        kind,
+        seq,
+        json.dumps(area),
+    )
+    return [prefix.encode(), body, b"}}\n"]
+
+
+def route_frame_segments(
+    codec: str, req_id: int, kind: str, seq: int, body: bytes
+) -> List[bytes]:
+    """Write-ready segments for one route frame. The JSON body keeps its
+    braces; the splice strips them with a zero-copy memoryview."""
+    if codec == CODEC_BINARY:
+        return [_HDR.pack(len(body) + 5, _FT_BY_KIND[kind], seq), body]
+    prefix = '{"id": %d, "stream": {"type": "%s", "seq": %d, ' % (
+        req_id,
+        kind,
+        seq,
+    )
+    return [prefix.encode(), memoryview(body)[1:-1], b"}}\n"]
+
+
+def decode_binary_frame(payload: bytes, stream: str) -> Dict[str, Any]:
+    """One received binary frame payload (everything after the length
+    word) -> the JSON-equivalent stream payload dict."""
+    ftype, seq = struct.unpack("!BI", payload[:5])
+    kind = _KIND_BY_FT[ftype]
+    body = payload[5:]
+    if stream == "kv":
+        pub = decode_kv_body(body)
+        return {"type": kind, "seq": seq, "area": pub["area"], "pub": pub}
+    fields = decode_route_body(body)
+    return {"type": kind, "seq": seq, **fields}
+
+
+def frame_header_info(header: bytes) -> Tuple[int, int]:
+    """(payload length, total header size) for one binary frame."""
+    (length,) = struct.unpack("!I", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"binary frame too large ({length} bytes)")
+    return length, 4
+
+
+def frame_kind_seq(payload: bytes) -> Tuple[str, int]:
+    """(kind, seq) straight off a binary frame payload, body left
+    unparsed — the fast-consumer path
+    (`CtrlClient.subscribe(decode=False)`, docs/Streaming.md)."""
+    ftype, seq = struct.unpack("!BI", payload[:5])
+    return _KIND_BY_FT[ftype], seq

@@ -10,6 +10,15 @@ per-connection stream handlers:
     publications, Decision route updates) and fans each item out to every
     registered subscriber with a **non-blocking** `offer()` — publication
     never waits on any client.
+  - Fan-out encode cost is O(filter-equivalence-classes), not
+    O(subscribers): subscribers with equal filters (KvStore: area +
+    key-prefixes + originators; routes: unfiltered, one class) are
+    grouped, each source item is filtered once per class, and the
+    resulting `SharedFrame` memoizes its serialized body once per codec —
+    per-subscriber work is a queue append plus an envelope splice and
+    buffer write in the connection task (docs/Streaming.md
+    "Shared-encode fan-out"; `shared_encode: false` restores the
+    historical per-subscriber re-encode path for measurement).
   - Each subscriber holds a **bounded** frame queue. When a slow client
     falls `max_pending` frames behind, the queue is coalesced: KvStore
     deltas merge per key (newest value wins, expiry/update cancel each
@@ -63,6 +72,64 @@ class StreamConfig:
     coalesce_budget: int = 4096
     # hard cap on concurrent subscriptions (typed server-busy beyond)
     max_subscribers: int = 1024
+    # encode each delta once per filter-equivalence class and share the
+    # bytes across the class (docs/Streaming.md "Shared-encode fan-out");
+    # off = the historical per-subscriber re-encode path, kept for
+    # before/after measurement on identical flap batches
+    shared_encode: bool = True
+
+
+class SharedFrame:
+    """One source item filtered for one filter-equivalence class.
+
+    Every subscriber in the class holds a reference to the same
+    SharedFrame in its bounded queue; the frame's body bytes are encoded
+    lazily, once per codec, by the first connection task that delivers
+    it (`body()`), and every later delivery reuses the memoized bytes.
+    `body()` is synchronous and all consumers share one asyncio loop, so
+    the memoization is race-free without locks.
+
+    The per-subscriber oldest-enqueue stamp `publish_to_deliver_ms`
+    depends on NEVER rides this object — it stays on the queue entry
+    (`_frames` stores `(frame, t_enq)` tuples), so shared bytes cannot
+    overwrite another subscriber's latency accounting.
+    """
+
+    __slots__ = ("item", "kind", "_manager", "_bodies")
+
+    def __init__(self, item: Any, kind: str, manager: "StreamManager") -> None:
+        self.item = item
+        self.kind = kind  # "kvstore" | "routes"
+        self._manager = manager
+        self._bodies: Dict[str, bytes] = {}
+
+    def body(self, codec_name: str) -> bytes:
+        """Frame body bytes for `codec_name`; encodes on first use (the
+        class encode), reuses thereafter (the class hit)."""
+        cached = self._bodies.get(codec_name)
+        if cached is not None:
+            self._manager.note_class_hit()
+            return cached
+        from openr_tpu.streaming import codec as _codec
+
+        t0 = time.perf_counter()
+        if self.kind == "kvstore":
+            body = _codec.encode_kv_body(self.item, codec_name)
+        else:
+            body = _codec.encode_route_body(
+                _codec.route_fields_from_update(self.item), codec_name
+            )
+        self._bodies[codec_name] = body
+        self._manager.note_class_encode(
+            (time.perf_counter() - t0) * 1e3, len(body)
+        )
+        return body
+
+
+def _unwrap(frame: Any) -> Any:
+    """Queue entries may be SharedFrames (shared path) or raw items
+    (direct `offer`, coalesced merges) — coalescing works on the item."""
+    return frame.item if type(frame) is SharedFrame else frame
 
 
 class SubscriberLimitError(RuntimeError):
@@ -101,12 +168,25 @@ class _BaseSubscription:
 
     def offer(self, item: Any, t_enq: float) -> None:
         """Non-blocking enqueue; never raises, never waits. Called by the
-        StreamManager dispatch task for every source-queue item."""
+        StreamManager dispatch task for every source-queue item (the
+        per-subscriber-filter path; the shared path pre-filters once per
+        class and calls `offer_shared`)."""
         if self.closed:
             return
         filtered = self._filter(item)
         if filtered is None:
             return
+        self._enqueue(filtered, t_enq)
+
+    def offer_shared(self, frame: SharedFrame, t_enq: float) -> None:
+        """Shared-path enqueue: the dispatch task already filtered the
+        item once for this subscriber's whole filter-equivalence class,
+        so per-subscriber work is exactly one queue append."""
+        if self.closed:
+            return
+        self._enqueue(frame, t_enq)
+
+    def _enqueue(self, filtered: Any, t_enq: float) -> None:
         if self._resync_at is not None:
             # a pending resync supersedes deltas: the snapshot the
             # handler is about to take will already contain this change
@@ -177,6 +257,13 @@ class _BaseSubscription:
 
     # -- kind-specific hooks --------------------------------------------
 
+    @property
+    def filter_key(self) -> Tuple:
+        """Filter-equivalence class key: subscriptions with equal keys
+        see byte-identical filtered frames, so one class encode serves
+        them all (docs/Streaming.md "Shared-encode fan-out")."""
+        raise NotImplementedError
+
     def _filter(self, item: Any) -> Optional[Any]:
         raise NotImplementedError
 
@@ -206,6 +293,15 @@ class KvSubscription(_BaseSubscription):
         self.area = area
         self.prefixes = list(prefixes or [])
         self.originators = set(originators or ())
+
+    @property
+    def filter_key(self) -> Tuple:
+        return (
+            "kvstore",
+            self.area,
+            tuple(sorted(self.prefixes)),
+            tuple(sorted(self.originators)),
+        )
 
     def _filter(self, pub: Publication) -> Optional[Publication]:
         if pub.area != self.area:
@@ -243,7 +339,8 @@ class KvSubscription(_BaseSubscription):
         t0 = frames[0][1]
         key_vals: Dict[str, Any] = {}
         expired: Dict[str, None] = {}
-        for pub, _ in frames:
+        for frame, _ in frames:
+            pub = _unwrap(frame)
             for key in pub.expired_keys:
                 key_vals.pop(key, None)
                 expired[key] = None
@@ -265,6 +362,11 @@ class RouteSubscription(_BaseSubscription):
 
     kind = "routes"
 
+    @property
+    def filter_key(self) -> Tuple:
+        # route subscriptions carry no filters: one class for all
+        return ("routes",)
+
     def _filter(
         self, update: DecisionRouteUpdate
     ) -> Optional[DecisionRouteUpdate]:
@@ -274,7 +376,8 @@ class RouteSubscription(_BaseSubscription):
         t0 = frames[0][1]
         unicast: Dict[Any, Any] = {}
         mpls: Dict[int, Any] = {}
-        for update, _ in frames:
+        for frame, _ in frames:
+            update = _unwrap(frame)
             for prefix in update.unicast_routes_to_delete:
                 unicast[prefix] = _DELETE
             for entry in update.unicast_routes_to_update:
@@ -324,6 +427,11 @@ class StreamManager(CountersMixin, HistogramsMixin):
         # publisher-side enqueue is the sanctioned handover seam)
         self._kv_subs: List[KvSubscription] = []  # analysis: queue
         self._route_subs: List[RouteSubscription] = []  # analysis: queue
+        # filter-equivalence classes, maintained incrementally on add/
+        # remove so dispatch never re-groups 100k subscribers per frame:
+        # filter_key -> members (same handover seam as the registries)
+        self._kv_classes: Dict[Tuple, List[KvSubscription]] = {}  # analysis: queue
+        self._route_classes: Dict[Tuple, List[RouteSubscription]] = {}  # analysis: queue
         self._tasks: List[asyncio.Task] = []
         self._started = False
         self._ensure_counters()
@@ -345,7 +453,10 @@ class StreamManager(CountersMixin, HistogramsMixin):
             self._tasks.append(
                 self.loop().create_task(
                     self._dispatch(
-                        self._kvstore_updates.get_reader(), self._kv_subs
+                        self._kvstore_updates.get_reader(),
+                        self._kv_subs,
+                        self._kv_classes,
+                        "kvstore",
                     )
                 )
             )
@@ -353,7 +464,10 @@ class StreamManager(CountersMixin, HistogramsMixin):
             self._tasks.append(
                 self.loop().create_task(
                     self._dispatch(
-                        self._route_updates.get_reader(), self._route_subs
+                        self._route_updates.get_reader(),
+                        self._route_subs,
+                        self._route_classes,
+                        "routes",
                     )
                 )
             )
@@ -367,6 +481,8 @@ class StreamManager(CountersMixin, HistogramsMixin):
             sub.close()
         self._kv_subs.clear()
         self._route_subs.clear()
+        self._kv_classes.clear()
+        self._route_classes.clear()
 
     # -- subscription registry (ctrl connection tasks) ------------------
 
@@ -374,6 +490,7 @@ class StreamManager(CountersMixin, HistogramsMixin):
         self._check_capacity()
         sub = KvSubscription(self, **kw)
         self._kv_subs.append(sub)
+        self._kv_classes.setdefault(sub.filter_key, []).append(sub)
         self._bump("ctrl.stream.subscribed_total")
         self._gauge_subscribers()
         return sub
@@ -382,6 +499,7 @@ class StreamManager(CountersMixin, HistogramsMixin):
         self._check_capacity()
         sub = RouteSubscription(self, **kw)
         self._route_subs.append(sub)
+        self._route_classes.setdefault(sub.filter_key, []).append(sub)
         self._bump("ctrl.stream.subscribed_total")
         self._gauge_subscribers()
         return sub
@@ -391,6 +509,14 @@ class StreamManager(CountersMixin, HistogramsMixin):
         for registry in (self._kv_subs, self._route_subs):
             if sub in registry:
                 registry.remove(sub)
+        classes = (
+            self._kv_classes if sub.kind == "kvstore" else self._route_classes
+        )
+        members = classes.get(sub.filter_key)
+        if members is not None and sub in members:
+            members.remove(sub)
+            if not members:
+                del classes[sub.filter_key]
         self._gauge_subscribers()
 
     def ensure_capacity(self) -> None:
@@ -415,14 +541,33 @@ class StreamManager(CountersMixin, HistogramsMixin):
         )
 
     def note_encode(self, ms: float, nbytes: int) -> None:
-        """Per-frame JSON encode attribution, recorded by the ctrl
-        server's stream handlers: every subscriber frame is re-encoded
-        per connection today, so `ctrl.stream.encode_ms` x
-        `ctrl.stream.delivered` is the fleet-wide serialization bill the
-        ROADMAP's shared-encoding fast path would amortize — measured
-        here first, built only if the numbers say so."""
+        """One REAL body serialization (docs/Monitoring.md): on the
+        shared path this fires once per filter-class per frame (via
+        `note_class_encode`); snapshot/resync/coalesced frames are
+        per-subscriber state and meter their private encodes here too.
+        `encode_ms` x `encode_bytes` is therefore the actual
+        serialization bill — compare against `deliver_*` for the
+        per-subscriber splice-and-write cost the sharing reduced it to."""
         self._observe("ctrl.stream.encode_ms", ms)
         self._bump("ctrl.stream.encode_bytes", nbytes)
+
+    def note_class_encode(self, ms: float, nbytes: int) -> None:
+        """A shared-path class encode: the one serialization a whole
+        filter-equivalence class amortizes (`SharedFrame.body` miss)."""
+        self._bump("ctrl.stream.encode_classes")
+        self.note_encode(ms, nbytes)
+
+    def note_class_hit(self) -> None:
+        """A shared-bytes reuse (`SharedFrame.body` hit): hit rate =
+        encode_class_hits / (encode_class_hits + encode_classes)."""
+        self._bump("ctrl.stream.encode_class_hits")
+
+    def note_deliver(self, ms: float, nbytes: int) -> None:
+        """Per-subscriber delivery work (envelope splice + buffer
+        write), recorded by the ctrl server per frame actually sent —
+        the O(subscribers) half of the fan-out bill."""
+        self._observe("ctrl.stream.deliver_ms", ms)
+        self._bump("ctrl.stream.deliver_bytes", nbytes)
 
     def mark_delivered(self, sub: _BaseSubscription, t_enq: float) -> None:
         """Delivery accounting, called by the stream handler after the
@@ -440,6 +585,9 @@ class StreamManager(CountersMixin, HistogramsMixin):
         return {
             "kv_subscribers": len(self._kv_subs),
             "route_subscribers": len(self._route_subs),
+            "kv_filter_classes": len(self._kv_classes),
+            "route_filter_classes": len(self._route_classes),
+            "shared_encode": self.config.shared_encode,
             "max_subscribers": self.config.max_subscribers,
             "subscriber_max_pending": self.config.subscriber_max_pending,
             "coalesce_budget": self.config.coalesce_budget,
@@ -448,7 +596,14 @@ class StreamManager(CountersMixin, HistogramsMixin):
 
     # -- fan-out dispatch -----------------------------------------------
 
-    async def _dispatch(self, reader, subs: List[_BaseSubscription]) -> None:
+    async def _dispatch(
+        self,
+        reader,
+        subs: List[_BaseSubscription],
+        classes: Dict[Tuple, List[_BaseSubscription]],
+        kind: str,
+    ) -> None:
+        shared = self.config.shared_encode
         try:
             while True:
                 item = await reader.get()
@@ -458,8 +613,23 @@ class StreamManager(CountersMixin, HistogramsMixin):
                     # named fault seam: an injected fan-out failure must
                     # degrade to marked resyncs, never silent loss
                     fault_point("ctrl.stream.publish", item)
-                    for sub in list(subs):
-                        sub.offer(item, t_enq)
+                    if shared:
+                        # filter ONCE per filter-equivalence class, wrap
+                        # the result in a SharedFrame whose body bytes
+                        # every class member reuses; per-subscriber work
+                        # is one queue append
+                        for members in list(classes.values()):
+                            if not members:
+                                continue
+                            filtered = members[0]._filter(item)
+                            if filtered is None:
+                                continue
+                            frame = SharedFrame(filtered, kind, self)
+                            for sub in list(members):
+                                sub.offer_shared(frame, t_enq)
+                    else:
+                        for sub in list(subs):
+                            sub.offer(item, t_enq)
                 except Exception:
                     self._bump("ctrl.stream.publish_errors")
                     for sub in list(subs):

@@ -178,6 +178,18 @@ def test_bench_py_smoke(capsys, monkeypatch):
     assert stream["value"] > 0
     assert stream["e2e_p95_ms"] > 0
     assert stream["baseline_e2e_p95_ms"] > 0
+    # shared-encode columns (ISSUE 16): the encode-share meter and the
+    # class-level sharing evidence ride the line, plus the subscriber
+    # sweep (BENCH_STREAM_SWEEP; the smoke env pins one extra point)
+    assert 0.0 <= stream["encode_share"] < 1.0
+    assert stream["encode_classes"] > 0
+    assert 0.0 <= stream["class_hit_rate"] <= 1.0
+    assert isinstance(stream["sweep"], list) and stream["sweep"]
+    for point in stream["sweep"]:
+        assert point["subscribers"] > 0
+        assert point["events_s"] > 0
+        assert 0.0 <= point["encode_share"] < 1.0
+        assert 0.0 <= point["class_hit_rate"] <= 1.0
     # the blocked-FW APSP line (ISSUE 12 'seventh metric line'): cold
     # close plus the warm re-close of a single-link event and the
     # FW-vs-batched-Dijkstra crossover sweep; the warm path must report

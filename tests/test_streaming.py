@@ -586,18 +586,325 @@ class TestWire:
 
 
 # ---------------------------------------------------------------------------
+# frame codecs: golden bytes, round-trips, negotiation + graceful fallback
+# ---------------------------------------------------------------------------
+
+
+class TestFrameCodec:
+    def sample_pub(self) -> Publication:
+        return Publication(
+            key_vals={
+                "adj:n1": Value(
+                    version=3,
+                    originator_id="n1",
+                    value=b"\x00\xffraw",
+                    ttl=600000,
+                    ttl_version=2,
+                    hash=-12345,
+                ),
+                "prefix:n2": Value(
+                    version=1,
+                    originator_id="n2",
+                    value=None,
+                    ttl=7,
+                    ttl_version=0,
+                    hash=None,
+                ),
+            },
+            expired_keys=["gone:k"],
+            area="0",
+        )
+
+    def test_binary_kv_body_golden(self):
+        """The binary kv body layout is a wire contract: pin the exact
+        bytes so an accidental struct/order change cannot slip through
+        as a silent protocol break."""
+        import struct
+
+        from openr_tpu.streaming import codec as sc
+
+        body = sc.encode_kv_body(self.sample_pub(), "binary")
+        golden = b"".join(
+            [
+                struct.pack("!H", 1),
+                b"0",  # area
+                struct.pack("!I", 2),  # key count
+                struct.pack("!H", 6),
+                b"adj:n1",
+                # flags=HAS_VALUE|HAS_HASH, version, ttl, ttl_version,
+                # hash, value length
+                struct.pack("!Bqqqqi", 3, 3, 600000, 2, -12345, 5),
+                struct.pack("!H", 2),
+                b"n1",
+                b"\x00\xffraw",
+                struct.pack("!H", 9),
+                b"prefix:n2",
+                struct.pack("!Bqqqqi", 0, 1, 7, 0, 0, 0),
+                struct.pack("!H", 2),
+                b"n2",
+                struct.pack("!I", 1),  # expired count
+                struct.pack("!H", 6),
+                b"gone:k",
+            ]
+        )
+        assert body == golden
+
+    def test_binary_kv_body_roundtrip_matches_json_payload(self):
+        """decode(encode(pub, binary)) is the EXACT JSON payload dict —
+        consumers stay codec-agnostic, None-ness and b64 restored."""
+        from openr_tpu.streaming import codec as sc
+
+        pub = self.sample_pub()
+        decoded = sc.decode_kv_body(sc.encode_kv_body(pub, "binary"))
+        assert decoded == sc._pub_to_json(pub)
+        # and the binary body is smaller than its JSON twin (raw bytes,
+        # struct-packed ints — the codec's reason to exist)
+        assert len(sc.encode_kv_body(pub, "binary")) < len(
+            sc.encode_kv_body(pub, "json")
+        )
+
+    def test_binary_route_body_roundtrip(self):
+        from openr_tpu.streaming import codec as sc
+
+        update = DecisionRouteUpdate(
+            unicast_routes_to_update=[
+                RibUnicastEntry(
+                    prefix=IpPrefix("10.0.0.0/24"),
+                    nexthops={
+                        NextHop(address="fe80::1", iface="if0", metric=10)
+                    },
+                )
+            ],
+            unicast_routes_to_delete=[IpPrefix("10.1.0.0/24")],
+        )
+        fields = sc.route_fields_from_update(update)
+        decoded = sc.decode_route_body(
+            sc.encode_route_body(fields, "binary")
+        )
+        assert decoded == fields
+
+    def test_json_splice_bit_identical_to_dumps(self):
+        """The shared-path envelope splice must be byte-identical to
+        json.dumps of the whole frame: a shared and a privately encoded
+        frame cannot be told apart on the wire."""
+        import json
+
+        from openr_tpu.streaming import codec as sc
+
+        pub = self.sample_pub()
+        body = sc.encode_kv_body(pub, "json")
+        spliced = b"".join(
+            sc.kv_frame_segments("json", 7, "delta", 42, "0", body)
+        )
+        whole = {
+            "id": 7,
+            "stream": {
+                "type": "delta",
+                "seq": 42,
+                "area": "0",
+                "pub": sc._pub_to_json(pub),
+            },
+        }
+        assert spliced == json.dumps(whole).encode() + b"\n"
+        # legacy (subscribeKvStoreFilter): bare publication frame
+        legacy = b"".join(
+            sc.kv_frame_segments(
+                "json", 7, "delta", 42, "0", body, legacy=True
+            )
+        )
+        assert (
+            legacy
+            == json.dumps(
+                {"id": 7, "stream": sc._pub_to_json(pub)}
+            ).encode()
+            + b"\n"
+        )
+
+    def test_unknown_codec_normalizes_to_json(self):
+        from openr_tpu.streaming import codec as sc
+
+        assert sc.normalize_codec("binary") == "binary"
+        assert sc.normalize_codec("json") == "json"
+        assert sc.normalize_codec(None) == "json"
+        assert sc.normalize_codec("zstd") == "json"
+
+    def test_negotiation_binary_end_to_end_and_payload_equality(self):
+        """One JSON and one binary subscriber on the same server: both
+        must observe identical payload dicts for the snapshot AND the
+        delta (bit-identical semantics across codecs), with the binary
+        connection actually negotiated (ack consumed by the client)."""
+
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            store.db("0").set_key_vals({"adj:n1": _value("n1")})
+            server = CtrlServer("n1", port=0, kvstore=store)
+            port = await server.start()
+            got = {"json": [], "binary": []}
+
+            async def consume(codec):
+                client = await CtrlClient("127.0.0.1", port).connect()
+                try:
+                    async for frame in client.subscribe(
+                        "subscribeKvStore",
+                        area="0",
+                        client=f"t-{codec}",
+                        codec=codec,
+                    ):
+                        got[codec].append(frame)
+                        if len(got[codec]) >= 2:
+                            return
+                finally:
+                    await client.close()
+
+            tasks = [
+                asyncio.ensure_future(consume("json")),
+                asyncio.ensure_future(consume("binary")),
+            ]
+            await asyncio.sleep(0.1)
+            store.db("0").set_key_vals({"prefix:n2": _value("n2")})
+            await asyncio.wait_for(asyncio.gather(*tasks), 10)
+            await server.stop()
+            store.stop()
+            return got
+
+        got = run(body())
+        assert [f["type"] for f in got["json"]] == ["snapshot", "delta"]
+        assert got["binary"] == got["json"]
+
+    def test_binary_request_against_old_server_falls_back_to_json(self):
+        """A server that predates the codec ignores the param and streams
+        newline-JSON; the absent ack IS the fallback — the client must
+        yield the JSON frames instead of misreading them as binary."""
+        import json
+
+        async def old_server(reader, writer):
+            req = json.loads(await reader.readline())
+            pub = {"area": "0", "key_vals": {}, "expired_keys": []}
+            for seq, kind in enumerate(["snapshot", "delta"]):
+                frame = {
+                    "id": req["id"],
+                    "stream": {
+                        "type": kind,
+                        "seq": seq,
+                        "area": "0",
+                        "pub": pub,
+                    },
+                }
+                writer.write(json.dumps(frame).encode() + b"\n")
+            await writer.drain()
+            writer.close()
+
+        async def body():
+            server = await asyncio.start_server(
+                old_server, "127.0.0.1", 0
+            )
+            port = server.sockets[0].getsockname()[1]
+            client = await CtrlClient("127.0.0.1", port).connect()
+            frames = []
+            async for frame in client.subscribe(
+                "subscribeKvStore", area="0", codec="binary"
+            ):
+                frames.append(frame)
+            await client.close()
+            server.close()
+            await server.wait_closed()
+            return frames
+
+        frames = run(body())
+        assert [f["type"] for f in frames] == ["snapshot", "delta"]
+
+    @pytest.mark.parametrize("codec", ["json", "binary"])
+    def test_overflow_resync_state_equals_fresh_dump_both_codecs(
+        self, codec
+    ):
+        """The resync-snapshot invariant holds bit-identically in both
+        codecs: a subscriber throttled through overflow recovers via a
+        marked resync to a state equal to a fresh dump."""
+
+        async def body():
+            store = KvStore("n1", ["0"], InProcessTransport())
+            manager = StreamManager(
+                kvstore_updates=store.updates_queue,
+                config=StreamConfig(
+                    subscriber_max_pending=1, coalesce_budget=2
+                ),
+            )
+            manager.start()
+            server = CtrlServer(
+                "n1", port=0, kvstore=store, stream_manager=manager
+            )
+            port = await server.start()
+            client = await CtrlClient("127.0.0.1", port).connect()
+            state: dict = {}
+            kinds = []
+
+            async def consume():
+                async for frame in client.subscribe(
+                    "subscribeKvStore",
+                    area="0",
+                    client="stalled",
+                    codec=codec,
+                ):
+                    kinds.append(frame["type"])
+                    _apply_kv_frame(state, frame)
+
+            with injected(FaultInjector()) as inj:
+                inj.arm(
+                    "ctrl.stream.deliver",
+                    times=None,
+                    action=lambda sub: setattr(sub, "throttle_s", 0.05),
+                    when=lambda sub: (
+                        getattr(sub, "label", "") == "stalled"
+                    ),
+                )
+                task = asyncio.ensure_future(consume())
+                await asyncio.sleep(0.05)
+                for i in range(30):
+                    store.db("0").set_key_vals(
+                        {f"adj:k{i}": _value("n1", version=i + 1)}
+                    )
+                    await asyncio.sleep(0.01)
+                await asyncio.sleep(1.0)
+                inj.disarm("ctrl.stream.deliver")
+                await asyncio.sleep(0.5)
+
+            assert "resync" in kinds, kinds
+            dump = await (
+                await CtrlClient("127.0.0.1", port).connect()
+            ).call("getKvStoreKeyValsFiltered", area="0", prefixes=[])
+            expect = {
+                k: (v["version"], v["value"])
+                for k, v in dump["key_vals"].items()
+            }
+            assert state == expect
+            task.cancel()
+            await client.close()
+            manager.stop()
+            await server.stop()
+            store.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
 # concurrent-client regression suite (the ISSUE 11 acceptance criteria)
 # ---------------------------------------------------------------------------
 
 
-def _flap_network(subscribers: int, stall_one: bool):
+def _flap_network(subscribers: int, stall_one: bool, codec: str = "json"):
     """Drive a 3-node line through 2 flap cycles with N concurrent
     subscribeKvStore subscribers (one optionally server-side-throttled
     into overflow) plus a burst of snapshot/scrape clients; returns the
-    evidence dict."""
+    evidence dict. `codec` is "json", "binary", or "mixed" (round-robin
+    across the cohort — the soak-round shape)."""
     from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
 
     n = 3
+
+    def _sub_codec(i: int) -> str:
+        if codec == "mixed":
+            return "binary" if i % 2 else "json"
+        return codec
 
     async def body() -> dict:
         net = VirtualNetwork()
@@ -653,7 +960,10 @@ def _flap_network(subscribers: int, stall_one: bool):
         async def watch(idx, client, label):
             try:
                 async for frame in client.subscribe(
-                    "subscribeKvStore", area="0", client=label
+                    "subscribeKvStore",
+                    area="0",
+                    client=label,
+                    codec=_sub_codec(idx),
                 ):
                     if label == "stalled":
                         stalled_kinds.append(frame["type"])
@@ -785,13 +1095,15 @@ def _flap_network(subscribers: int, stall_one: bool):
 class TestConcurrentClients:
     def test_fanout_64_subscribers_with_stall_and_admission(self):
         """The acceptance run: baseline flap batch without subscribers,
-        then the same batch against 64 concurrent subscribers (one
-        server-side-stalled into overflow) plus a snapshot-client burst.
-        Convergence must stay within noise, every healthy subscriber
-        must see deltas, and the stalled one must recover via a marked
-        resync to a state equal to a fresh dump."""
+        then the same batch against 64 concurrent subscribers — MIXED
+        JSON/binary codecs round-robin across the cohort (ISSUE 16),
+        one server-side-stalled into overflow — plus a snapshot-client
+        burst. Convergence must stay within noise, every healthy
+        subscriber must see deltas regardless of codec, and the stalled
+        one must recover via a marked resync to a state equal to a
+        fresh dump."""
         baseline = _flap_network(subscribers=0, stall_one=False)
-        loaded = _flap_network(subscribers=64, stall_one=True)
+        loaded = _flap_network(subscribers=64, stall_one=True, codec="mixed")
 
         # routes kept programming: same flap sequence converged, spans
         # closed on every node, and the p95 stayed inside the noise
@@ -1094,3 +1406,26 @@ class TestSoakJudge:
         checks = report["verdict"]["checks"]
         assert "no_clean_trend_break" in checks
         assert report["verdict"]["pass"], checks
+
+
+# ---------------------------------------------------------------------------
+# STREAM_SMOKE (tier-1 acceptance): one class encode per frame
+# ---------------------------------------------------------------------------
+
+
+class TestStreamSmoke:
+    def test_stream_smoke(self):
+        """The shared-encode invariant end-to-end over real ctrl
+        sockets: N subscribers in one filter-equivalence class cost
+        exactly one class encode per dispatched frame (the acceptance
+        assertions live inside run_stream_smoke; pin the headline
+        evidence here too)."""
+        from openr_tpu.streaming.smoke import run_stream_smoke
+
+        summary = run_stream_smoke()
+        assert summary["filter_classes_live"] == 1
+        assert summary["class_encodes"] == summary["frames_per_subscriber"]
+        assert summary["class_hits"] == (
+            (summary["subscribers"] - 1) * summary["class_encodes"]
+        )
+        assert summary["resyncs"] == 0
