@@ -948,6 +948,70 @@ def _bench_fleet() -> dict:
     }
 
 
+def _bench_journal() -> dict:
+    """Ninth metric line: state-journal recording overhead — the standard
+    convergence flap batch re-run with every node journaling its KvStore
+    publications and RIB deltas (openr_tpu/journal). The metric is the
+    mean per-record cost from the sampled `journal.record_ms` guard; the
+    line carries the journal-on run's convergence e2e p95 next to the
+    journal-off baseline's (the convergence line measured earlier on the
+    same config) under the same held-flat envelope as the fan-out and
+    fleet lines, and every node's final state is replay-verified against
+    the CPU oracle (docs/Journal.md). Degraded-aware like every line."""
+    from openr_tpu.testing.decision_harness import run_bench_convergence
+
+    nodes = int(os.environ.get("BENCH_CONV_NODES", "5"))
+    flaps = int(os.environ.get("BENCH_CONV_FLAPS", "2"))
+    backend = os.environ.get("BENCH_CONV_BACKEND", "tpu")
+    summary = run_bench_convergence(
+        nodes=nodes,
+        flaps=flaps,
+        backend=backend,
+        measure_exporter=False,
+        journal=True,
+    )
+    baseline_p95 = _CONV_SUMMARY.get("e2e_p95_ms", 0.0)
+    p95 = summary["e2e_p95_ms"]
+    if baseline_p95 > 0:
+        # held-flat envelope vs the journal-off baseline: a recorder
+        # that serializes into the convergence path blows through it
+        assert p95 <= baseline_p95 * 5.0 + 250.0, (
+            f"convergence p95 {p95:.1f}ms with the state journal "
+            f"recording vs {baseline_p95:.1f}ms journal-off: the "
+            f"recorder is not O(changes)"
+        )
+    verified = summary["journal_replay_verified"]
+    assert verified == summary["journal_nodes"], (
+        f"replay determinism broke under the flap batch: only {verified} "
+        f"of {summary['journal_nodes']} nodes' replayed RIBs matched the "
+        f"CPU oracle"
+    )
+    _note(
+        f"journal: {summary['journal_records']} records over the "
+        f"{summary['nodes']}-node flap batch at "
+        f"{summary['journal_record_us']:.1f}us/record (sampled), "
+        f"{verified}/{summary['journal_nodes']} nodes replay-verified; "
+        f"e2e p95 {p95:.1f}ms journal-on vs {baseline_p95:.1f}ms off"
+    )
+    return {
+        "metric": "journal_record_us",
+        "value": round(max(summary["journal_record_us"], 1e-4), 4),
+        "unit": (
+            f"us mean journal record (sampled guard, every node of the "
+            f"{summary['nodes']}-node line emulator flap batch recording "
+            f"publications + RIB deltas)"
+        ),
+        "vs_baseline": 0.0,
+        "baseline": "none",
+        "journal_records": summary["journal_records"],
+        "journal_evicted": summary["journal_evicted"],
+        "journal_replay_verified": verified,
+        "journal_nodes": summary["journal_nodes"],
+        "attached_e2e_p95_ms": round(p95, 2),
+        "baseline_e2e_p95_ms": round(baseline_p95, 2),
+    }
+
+
 def _reexec_degraded(fault_kind: str) -> int:
     """Re-run this bench in a fresh process pinned to JAX_PLATFORMS=cpu.
 
@@ -1012,6 +1076,13 @@ def main(argv=None) -> None:
             # defined against the convergence flap batch: the detached
             # baseline p95 is the held-flat comparison
             results.append(_bench_fleet())
+        if (
+            os.environ.get("BENCH_JOURNAL", "1") == "1"
+            and os.environ.get("BENCH_CONVERGENCE", "1") == "1"
+        ):
+            # defined against the convergence flap batch: the journal-off
+            # baseline p95 is the held-flat comparison
+            results.append(_bench_journal())
     except Exception as exc:
         # route the failure through the solver fault domain's vocabulary:
         # classify, then degrade exactly like the supervisor's breaker
@@ -1036,7 +1107,17 @@ def main(argv=None) -> None:
             result["degraded"] = True
             if degraded_reason:
                 result["fault_kind"] = degraded_reason
+    from openr_tpu.utils.build_info import (
+        ARTIFACT_SCHEMA_VERSION,
+        build_fingerprint,
+    )
+
+    fingerprint = build_fingerprint()
     for result in results:
+        # artifact provenance stamp: BENCH_r* consumers trace every line
+        # to the exact code + field contract that produced it
+        result["schema_version"] = ARTIFACT_SCHEMA_VERSION
+        result["build"] = fingerprint
         print(json.dumps(result))
 
 

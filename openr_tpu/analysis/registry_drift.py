@@ -73,6 +73,10 @@ ALLOWED_PREFIXES = {
     "monitor",
     "ctrl",
     "fleet",
+    # the state journal (openr_tpu/journal — docs/Journal.md): recorder,
+    # durable log and replay engine telemetry (docs/Monitoring.md
+    # "State journal")
+    "journal",
 }
 
 # <module>.<name>[.<name>...], lowercase snake segments

@@ -6,11 +6,14 @@ talking to the ctrl server (kvstore / decision / fib / lm / prefixmgr /
 monitor / openr). argparse instead of click (no extra deps in this image);
 same command vocabulary:
 
-  breeze kvstore keys|keyvals|peers|areas
+  breeze kvstore keys|keyvals|peers|areas|history KEY [--area A]
   breeze decision adj|prefixes|routes|rib-policy|solver-health|
                   solve-traces [--json]|profile [--seconds N] [--out DIR]|
                   profile-status|
-                  te-optimize [--demands file.json] [--steps N] [--json]
+                  te-optimize [--demands file.json] [--steps N] [--json]|
+                  explain-route PREFIX [--at T]|
+                  rib-diff [--from T1] [--to T2]|verify-replay [--at T]
+                  (state-journal provenance + time travel, docs/Journal.md)
   breeze fib routes|unicast-routes|mpls-routes|counters
   breeze lm links|set-node-overload|unset-node-overload|
             set-link-overload|unset-link-overload|
@@ -153,6 +156,43 @@ def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
                 )
             for key in pub.get("expired_keys", []):
                 print(f"{key} EXPIRED")
+    elif args.cmd == "history":
+        # journaled publication history of one key (docs/Journal.md)
+        report = client.call(
+            "getKvStoreKeyHistory", key=args.key, area=args.area
+        )
+        if args.json:
+            _print_json(report)
+            return
+        if not report.get("enabled"):
+            print("state journal not enabled (journal_config.enabled)")
+            return
+        rows = [
+            [
+                e["seq"],
+                _fmt_ts(e.get("ts")),
+                e.get("area", "-"),
+                "DELETED" if e.get("deleted") else e.get("version"),
+                e.get("ttl_version") if not e.get("deleted") else "-",
+                e.get("originator_id") or "-",
+            ]
+            for e in report.get("history", [])
+        ]
+        if not rows:
+            print(f"no journaled history for {args.key}")
+            return
+        _print_table(
+            ["Seq", "Time", "Area", "Version", "TTL-Version", "Originator"],
+            rows,
+        )
+
+
+def _fmt_ts(ts) -> str:
+    if ts is None:
+        return "-"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S.%f")[:-3]
 
 
 def cmd_decision(client: BlockingCtrlClient, args) -> None:
@@ -346,6 +386,126 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
                 )
             for label in frame.get("mpls_to_delete", []):
                 print(f"- label {label}")
+    elif args.cmd == "explain-route":
+        # provenance chain over the state journal (docs/Journal.md):
+        # route -> contributing keys -> originating publication -> solve
+        report = client.call(
+            "explainRoute", prefix=args.prefix, at=args.at
+        )
+        if args.json:
+            _print_json(report)
+            return
+        if not report.get("enabled"):
+            print("state journal not enabled (journal_config.enabled)")
+            return
+        when = (
+            _fmt_ts(report.get("at_ts"))
+            if report.get("at_ts") is not None
+            else "latest"
+        )
+        if not report.get("found"):
+            print(
+                f"{report['prefix']}: no route at {when} "
+                f"(seq {report.get('at_seq')})"
+            )
+            return
+        route = report.get("route", {})
+        nexthops = ", ".join(
+            f"{nh.get('address')}%{nh.get('iface') or '-'}"
+            for nh in route.get("nexthops", [])
+        )
+        chain = "complete" if report.get("complete") else "INCOMPLETE"
+        print(
+            f"{report['prefix']} at {when} (seq {report.get('at_seq')}) "
+            f"via [{nexthops}] — provenance {chain}"
+        )
+        rows = []
+        for info in report.get("prefix_keys", []) + report.get(
+            "adjacency_keys", []
+        ):
+            pub = info.get("publication") or {}
+            rows.append(
+                [
+                    info["key"],
+                    info["area"],
+                    pub.get("seq", info.get("seq", "-")),
+                    _fmt_ts(pub.get("ts")),
+                    "DELETED" if pub.get("deleted") else pub.get("version"),
+                    pub.get("originator_id") or "-",
+                ]
+            )
+        _print_table(
+            ["Contributing key", "Area", "Seq", "Published", "Version",
+             "Originator"],
+            rows,
+        )
+        trace = report.get("solve_trace")
+        if trace:
+            phases = trace.get("phases") or {}
+            print(
+                f"solve: seq={trace.get('seq')} event={trace.get('event')} "
+                f"layout={trace.get('layout')} "
+                f"ms={trace.get('solve_ms')}"
+                + (
+                    "  " + " ".join(
+                        f"{k}={v:.2f}" for k, v in sorted(phases.items())
+                    )
+                    if phases
+                    else ""
+                )
+            )
+        if report.get("rib_policy_active"):
+            print(
+                "note: RibPolicy is active — journaled routes include "
+                "policy edits the replay oracle does not model"
+            )
+    elif args.cmd == "rib-diff":
+        report = client.call(
+            "getRibDiff", from_ts=args.from_ts, to_ts=args.to_ts
+        )
+        if args.json:
+            _print_json(report)
+            return
+        if not report.get("enabled"):
+            print("state journal not enabled (journal_config.enabled)")
+            return
+        f, t = report.get("from", {}), report.get("to", {})
+        print(
+            f"rib-diff: {f.get('routes')} route(s) at seq {f.get('at_seq')}"
+            f" -> {t.get('routes')} route(s) at seq {t.get('at_seq')}"
+        )
+        if not report.get("changed"):
+            print("no route changes across the window")
+            return
+        delta = report.get("delta", {})
+        for entry in delta.get("unicast_update", []):
+            nexthops = ", ".join(
+                f"{nh.get('address')}%{nh.get('iface') or '-'}"
+                for nh in entry.get("nexthops", [])
+            )
+            print(f"+ {entry['prefix']} via [{nexthops}]")
+        for prefix in delta.get("unicast_delete", []):
+            print(f"- {prefix}")
+        for entry in delta.get("mpls_update", []):
+            print(f"+ label {entry['label']}")
+        for label in delta.get("mpls_delete", []):
+            print(f"- label {label}")
+    elif args.cmd == "verify-replay":
+        report = client.call("verifyJournalReplay", at=args.at)
+        if args.json:
+            _print_json(report)
+            return
+        if not report.get("enabled"):
+            print("state journal not enabled (journal_config.enabled)")
+            return
+        verdict = "MATCH" if report.get("match") else "MISMATCH"
+        print(
+            f"replay audit: {verdict} — {report.get('routes')} journaled "
+            f"route(s) vs {report.get('oracle_routes')} oracle route(s) "
+            f"({report.get('applied')} record(s) replayed)"
+        )
+        for mm in report.get("mismatches", []):
+            print(f"  {mm}")
     elif args.cmd == "path":
         # all shortest paths src -> dst over the live adjacency dump
         # (py/openr/cli/commands/decision.py PathCmd equivalent)
@@ -406,12 +566,36 @@ def _all_shortest_paths(graph, src, dst, limit=16):
     return [(c, p) for c, p in paths]
 
 
+def _check_artifact_schema(artifact: dict) -> None:
+    """SOAK_r*/BENCH_r*/fleet artifacts are stamped with schema_version +
+    build fingerprint (utils/build_info.py). An unknown version means the
+    offline render below may misread fields — warn and render best-effort
+    anyway; a missing stamp just gets a note (pre-stamp artifacts stay
+    readable)."""
+    from openr_tpu.utils.build_info import ARTIFACT_SCHEMA_VERSION
+
+    version = artifact.get("schema_version")
+    if version is None:
+        print(
+            "note: artifact has no schema_version stamp (written by a "
+            f"pre-v{ARTIFACT_SCHEMA_VERSION} build); rendering best-effort"
+        )
+    elif version != ARTIFACT_SCHEMA_VERSION:
+        print(
+            f"warning: artifact schema_version {version} != supported "
+            f"{ARTIFACT_SCHEMA_VERSION} "
+            f"(build {artifact.get('build', 'unknown')}): fields may "
+            "render incorrectly"
+        )
+
+
 def cmd_soak_report(args) -> None:
     """Render a judged soak report written by the topology-churn harness
     (python -m openr_tpu.testing.soak --out FILE). Offline: reads the
     JSON file, never dials a daemon."""
     with open(args.file) as fh:
         report = json.load(fh)
+    _check_artifact_schema(report)
     if "verdict" not in report and isinstance(report.get("soak"), dict):
         report = report["soak"]  # a SOAK_r* artifact wraps the report
     verdict = report.get("verdict", {})
@@ -646,6 +830,7 @@ def cmd_fleet_report(args) -> None:
     (the round-trip the FLEET_SMOKE pins)."""
     with open(args.file) as fh:
         report = json.load(fh)
+    _check_artifact_schema(report)
     if "findings" not in report:
         # also accept a soak report / SOAK_r* artifact: render the
         # embedded fleet section
@@ -980,6 +1165,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = kv.add_parser("snoop")
     p.add_argument("--prefix", default="")
     p.add_argument("--area", default="0")
+    p = kv.add_parser("history")
+    p.add_argument("key", help="exact key, e.g. adj:r1")
+    p.add_argument(
+        "--area", default=None, help="area filter (all areas when omitted)"
+    )
+    p.add_argument("--json", action="store_true")
     p = kv.add_parser("subscribe")
     p.add_argument("--prefix", default="")
     p.add_argument(
@@ -1052,6 +1243,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="stream frame codec; binary negotiates length-prefixed "
         "frames, falling back to JSON on old servers",
     )
+    p = dec.add_parser("explain-route")
+    p.add_argument("prefix", help="route prefix, e.g. 10.0.0.0/24")
+    p.add_argument(
+        "--at", type=float, default=None,
+        help="replay instant: unix seconds, negative = seconds before "
+        "now (default: latest journaled state)",
+    )
+    p.add_argument("--json", action="store_true")
+    p = dec.add_parser("rib-diff")
+    p.add_argument(
+        "--from", dest="from_ts", type=float, default=None,
+        help="window start (unix seconds; negative = relative to now)",
+    )
+    p.add_argument(
+        "--to", dest="to_ts", type=float, default=None,
+        help="window end (same axis; default: latest)",
+    )
+    p.add_argument("--json", action="store_true")
+    p = dec.add_parser("verify-replay")
+    p.add_argument("--at", type=float, default=None)
+    p.add_argument("--json", action="store_true")
     p = dec.add_parser("path")
     p.add_argument("src")
     p.add_argument("dst")

@@ -3,6 +3,7 @@
 from openr_tpu.config.config import (
     AreaConfig,
     Config,
+    JournalConfigSection,
     KvstoreConfig,
     LinkMonitorConfig,
     MonitorConfig,
@@ -17,6 +18,7 @@ from openr_tpu.config.config import (
 __all__ = [
     "AreaConfig",
     "Config",
+    "JournalConfigSection",
     "KvstoreConfig",
     "LinkMonitorConfig",
     "MonitorConfig",

@@ -231,6 +231,28 @@ class StreamConfigSection:
 
 
 @dataclass
+class JournalConfigSection:
+    """State-journal knobs (docs/Journal.md): bounded record ring +
+    compacted base, the sampled-overhead guard cadence, and the optional
+    crash-safe on-disk log."""
+
+    enabled: bool = False
+    # in-memory record ring bound; older records fold into the base
+    ring_size: int = 4096
+    # per-(area, key) publication-history entries for `kvstore history`
+    key_history: int = 16
+    # every Nth record takes perf_counter stamps into journal.record_ms
+    # (0 disables the guard, never the recording)
+    sample_every: int = 16
+    # durable log file (RecordLog framing); None = memory only
+    path: Optional[str] = None
+    # append-batch debounce; a crash loses at most this window
+    flush_interval_s: float = 0.2
+    # appended-tail size that forces the next flush to compact
+    min_compact_bytes: int = 65536
+
+
+@dataclass
 class OpenrConfig:
     """OpenrConfig.thrift OpenrConfig:180."""
 
@@ -274,6 +296,9 @@ class OpenrConfig:
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     stream_config: StreamConfigSection = field(
         default_factory=StreamConfigSection
+    )
+    journal_config: JournalConfigSection = field(
+        default_factory=JournalConfigSection
     )
     enable_bgp_peering: bool = False
     bgp_use_igp_metric: bool = False

@@ -1,8 +1,11 @@
 """Durable on-disk key-value store (config-store).
 
-Equivalent of openr/config-store/PersistentStore.{h,cpp}.
+Equivalent of openr/config-store/PersistentStore.{h,cpp}. The shared
+journaled-file framing lives in `record_log` (also used by the state
+journal, openr_tpu/journal/).
 """
 
 from openr_tpu.configstore.persistent_store import PersistentStore
+from openr_tpu.configstore.record_log import RecordLog
 
-__all__ = ["PersistentStore"]
+__all__ = ["PersistentStore", "RecordLog"]

@@ -32,16 +32,16 @@ from __future__ import annotations
 
 import asyncio
 import os
-import struct
 from typing import Any, Dict, Optional
 
+from openr_tpu.configstore import record_log
 from openr_tpu.testing.faults import fault_point
 from openr_tpu.utils import ExponentialBackoff
 from openr_tpu.utils import serializer
 
 _MAGIC = b"ONRPS1\n"
 _REC_SNAPSHOT, _REC_ADD, _REC_DEL = 0, 1, 2
-_REC_HEADER = struct.Struct("<BII")
+_REC_HEADER = record_log.HEADER
 
 INITIAL_BACKOFF = 0.1  # Constants.h:81-83
 MAX_BACKOFF = 5.0
@@ -65,6 +65,9 @@ class PersistentStore:
         self.path = path
         self.dryrun = dryrun
         self._loop = loop
+        self._log = record_log.RecordLog(
+            path, _MAGIC, (_REC_SNAPSHOT, _REC_ADD, _REC_DEL)
+        )
         self.data: Dict[str, bytes] = {}
         self._journal: list = []  # pending (rec_type, key, value) records
         self._backoff = ExponentialBackoff(INITIAL_BACKOFF, MAX_BACKOFF)
@@ -126,8 +129,7 @@ class PersistentStore:
 
     @staticmethod
     def _pack_record(rec_type: int, key: str, value: bytes) -> bytes:
-        kb = key.encode()
-        return _REC_HEADER.pack(rec_type, len(kb), len(value)) + kb + value
+        return record_log.pack(rec_type, key.encode(), value)
 
     def _flush_to_disk(self) -> None:
         """One durable write: append the pending journal records, or
@@ -163,16 +165,8 @@ class PersistentStore:
 
     def _write_snapshot(self) -> None:
         """Atomic full-state rewrite (tmp + rename)."""
-        blob = bytearray(_MAGIC)
         payload = serializer.dumps(dict(self.data))
-        blob += self._pack_record(_REC_SNAPSHOT, "", payload)
-        tmp = self.path + ".tmp"
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
-        with open(tmp, "wb") as f:
-            f.write(bytes(blob))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        self._log.rewrite(self._pack_record(_REC_SNAPSHOT, "", payload))
         self._journal.clear()
         self._snapshot_bytes = len(payload)
         self._journal_bytes = 0
@@ -188,10 +182,7 @@ class PersistentStore:
             self._pack_record(rec_type, key, value)
             for rec_type, key, value in self._journal
         )
-        with open(self.path, "ab") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
+        self._log.append(blob)
         self._journal.clear()
         self._journal_bytes += len(blob)
         self.num_writes_to_disk += 1
@@ -205,55 +196,36 @@ class PersistentStore:
             # named fault seam: an injected load failure degrades to an
             # empty store (state rebuilds from the network)
             fault_point("configstore.load", self)
-            with open(self.path, "rb") as f:
-                raw = f.read()
+            records, truncated = self._log.scan()
+        except record_log.BadMagicError:
+            self.data = {}
+            self._needs_compact = True
+            return
         except Exception:
             self.num_load_errors += 1
             self.data = {}
             self._needs_compact = True
             return
-        if not raw.startswith(_MAGIC):
-            self.data = {}
-            self._needs_compact = True
-            return
-        # recover to the longest well-formed record prefix: a torn tail
-        # (crash mid-append) truncates back to the last durable record
+        # fold the recovered record prefix back into the kv map
         data: Dict[str, bytes] = {}
         journal_bytes = 0
         snapshot_bytes = 0
-        off = len(_MAGIC)
-        truncated = False
-        while off < len(raw):
-            if off + _REC_HEADER.size > len(raw):
-                truncated = True
-                break
-            rec_type, klen, vlen = _REC_HEADER.unpack_from(raw, off)
-            body_end = off + _REC_HEADER.size + klen + vlen
-            if rec_type not in (
-                _REC_SNAPSHOT, _REC_ADD, _REC_DEL
-            ) or body_end > len(raw):
-                truncated = True
-                break
-            key_off = off + _REC_HEADER.size
-            value = raw[key_off + klen : body_end]
+        for rec_type, key_b, value in records:
             if rec_type == _REC_SNAPSHOT:
                 try:
                     data = dict(serializer.loads(value))
                 except Exception:
                     truncated = True  # torn snapshot body
                     break
-                snapshot_bytes = vlen
+                snapshot_bytes = len(value)
                 journal_bytes = 0
             else:
-                key = raw[key_off : key_off + klen].decode(
-                    errors="replace"
-                )
+                key = key_b.decode(errors="replace")
                 if rec_type == _REC_ADD:
                     data[key] = value
                 else:
                     data.pop(key, None)
-                journal_bytes += body_end - off
-            off = body_end
+                journal_bytes += _REC_HEADER.size + len(key_b) + len(value)
         self.data = data
         self._snapshot_bytes = snapshot_bytes
         self._journal_bytes = journal_bytes

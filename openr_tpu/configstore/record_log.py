@@ -1,0 +1,105 @@
+"""Shared journaled-file framing for crash-safe append logs.
+
+Extracted from PersistentStore so every durable log in the tree — the
+config store, the state journal — shares one framing and one recovery
+discipline instead of re-deriving it:
+
+  - records are ``<BII>``-framed (type byte, key length, value length)
+    behind a per-log magic prefix;
+  - full rewrites are atomic (tmp + fsync + rename): a kill mid-rewrite
+    leaves the previous file intact plus a stray ``.tmp`` that load
+    ignores;
+  - appends are fsynced, and ``scan()`` recovers to the **longest
+    well-formed record prefix**: a torn/truncated tail (crash
+    mid-append, torn sector) truncates back to the last durable record
+    instead of discarding the whole file.
+
+Policy stays with the caller: what the records mean, when to compact,
+how to count failures. This module only owns bytes on disk.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import FrozenSet, Iterable, List, Tuple
+
+HEADER = struct.Struct("<BII")
+
+# one scanned record: (rec_type, key bytes, value bytes)
+Record = Tuple[int, bytes, bytes]
+
+
+class BadMagicError(ValueError):
+    """The file exists but does not start with this log's magic."""
+
+
+def pack(rec_type: int, key: bytes, value: bytes) -> bytes:
+    return HEADER.pack(rec_type, len(key), len(value)) + key + value
+
+
+class RecordLog:
+    """One journaled file: magic prefix + framed records.
+
+    Stateless over the file contents — ``scan()`` re-reads from disk, and
+    the caller tracks geometry (snapshot vs journal bytes) from the
+    records it writes/reads.
+    """
+
+    def __init__(
+        self, path: str, magic: bytes, valid_types: Iterable[int]
+    ) -> None:
+        self.path = path
+        self.magic = magic
+        self.valid_types: FrozenSet[int] = frozenset(valid_types)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def append(self, blob: bytes) -> None:
+        """Fsynced append of already-packed records."""
+        with open(self.path, "ab") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def rewrite(self, blob: bytes) -> None:
+        """Atomic full rewrite: magic + packed records, tmp + rename."""
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(self.magic + blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def scan(self) -> Tuple[List[Record], bool]:
+        """Read the file back as (records, truncated).
+
+        Recovers to the longest well-formed record prefix; ``truncated``
+        is True when a torn tail was dropped. Raises ``BadMagicError``
+        when the file does not start with this log's magic; OSError from
+        the read propagates (the caller decides how to count it).
+        """
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        if not raw.startswith(self.magic):
+            raise BadMagicError(self.path)
+        records: List[Record] = []
+        off = len(self.magic)
+        truncated = False
+        while off < len(raw):
+            if off + HEADER.size > len(raw):
+                truncated = True
+                break
+            rec_type, klen, vlen = HEADER.unpack_from(raw, off)
+            body_end = off + HEADER.size + klen + vlen
+            if rec_type not in self.valid_types or body_end > len(raw):
+                truncated = True
+                break
+            key_off = off + HEADER.size
+            records.append(
+                (rec_type, raw[key_off : key_off + klen], raw[key_off + klen : body_end])
+            )
+            off = body_end
+        return records, truncated

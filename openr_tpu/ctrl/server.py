@@ -118,6 +118,7 @@ class CtrlServer:
         config=None,
         stream_manager=None,
         admission=None,
+        journal=None,
         route_updates=None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
         ssl_context=None,
@@ -142,6 +143,7 @@ class CtrlServer:
         # embeddings (tests, tools) get defaults built in start()
         self.stream_manager = stream_manager
         self.admission = admission
+        self.journal = journal
         self._route_updates = route_updates
         self._own_stream_manager = False
         # on-demand jax profiling window (monitor/profiling.py), built
@@ -847,6 +849,110 @@ class CtrlServer:
             out["stream"] = self.stream_manager.stats()
         if self.admission is not None:
             out["admission"] = self.admission.stats()
+        return out
+
+    # -- state journal (docs/Journal.md) --------------------------------
+
+    def _journal_or_error(self) -> Any:
+        if self.journal is None or not self.journal.config.enabled:
+            return None
+        return self.journal
+
+    def m_getJournalStats(self, params) -> Dict[str, Any]:
+        """Journal ring/base/durable-log state + journal.* counters."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False}
+        return journal.stats()
+
+    def m_getJournalTail(self, params) -> Dict[str, Any]:
+        """Most recent journal records, raw (forensics attachment +
+        `breeze` debugging). params: last_n."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False, "records": []}
+        return {
+            "enabled": True,
+            "records": journal.tail(int(params.get("last_n", 32))),
+        }
+
+    def m_getKvStoreKeyHistory(self, params) -> Dict[str, Any]:
+        """Bounded publication history of one key (`breeze kvstore
+        history <key>`). params: key (required), area (filter)."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False, "history": []}
+        key = params.get("key")
+        assert key, "key is required"
+        return {
+            "enabled": True,
+            "key": key,
+            "history": journal.key_history(
+                key, area=params.get("area") or None
+            ),
+        }
+
+    def m_getRibDiff(self, params) -> Dict[str, Any]:
+        """RIB delta between two replayed instants (`breeze decision
+        rib-diff --from T1 --to T2`). params: from_ts / to_ts — unix
+        seconds, negative = relative to now, absent = latest."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False}
+        from_ts = params.get("from_ts")
+        to_ts = params.get("to_ts")
+        out = journal.rib_diff(
+            float(from_ts) if from_ts is not None else None,
+            float(to_ts) if to_ts is not None else None,
+        )
+        out["enabled"] = True
+        return out
+
+    def m_verifyJournalReplay(self, params) -> Dict[str, Any]:
+        """Standing correctness audit: replay(T) vs the CPU oracle over
+        the reconstructed LSDB. params: at."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False}
+        at = params.get("at")
+        out = journal.verify_replay(
+            float(at) if at is not None else None
+        )
+        out["enabled"] = True
+        return out
+
+    def m_explainRoute(self, params) -> Dict[str, Any]:
+        """Provenance chain: route → contributing prefix/adjacency keys →
+        originating publication → (when sampled) the SolveTrace that
+        computed it. params: prefix (required), at."""
+        journal = self._journal_or_error()
+        if journal is None:
+            return {"enabled": False, "found": False}
+        prefix = params.get("prefix")
+        assert prefix, "prefix is required"
+        at = params.get("at")
+        out = journal.explain_route(
+            prefix, float(at) if at is not None else None
+        )
+        out["enabled"] = True
+        # link the nearest sampled SolveTrace at-or-before the replayed
+        # instant (the flight recorder lives in Decision, not the journal)
+        out["solve_trace"] = None
+        if self.decision is not None and out.get("found"):
+            at_ts = out.get("at_ts") or time.time()
+            traces = self.decision.get_solve_traces().get("traces", [])
+            best = None
+            for trace in traces:
+                ts = trace.get("ts")
+                if ts is None or ts > at_ts:
+                    continue
+                if best is None or ts > best.get("ts", 0.0):
+                    best = trace
+            out["solve_trace"] = best
+            if self.config is not None:
+                out["rib_policy_active"] = bool(
+                    self.config.config.enable_rib_policy
+                )
         return out
 
     def _client_id(self, writer, params) -> str:

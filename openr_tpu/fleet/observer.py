@@ -83,6 +83,10 @@ class FleetConfig:
     forensics_traces: int = 8
     forensics_max: int = 32
     forensics_dir: Optional[str] = None
+    # journal evidence attached to each breach dump (docs/Journal.md):
+    # journal-tail record count and the rib-diff lookback window
+    forensics_journal_tail: int = 32
+    forensics_rib_window_s: float = 60.0
     # how long after note_restart a node's failures stay attributed
     restart_window_s: float = 30.0
     slo: SloConfig = field(default_factory=SloConfig)
@@ -410,9 +414,7 @@ class FleetObserver(CountersMixin, HistogramsMixin):
         for finding in self._evaluate():
             dump = self.forensics[-1] if self.forensics else None
             if dump is not None and dump["id"] == finding.forensics_id:
-                dump["solve_traces"] = await self._fetch_traces(
-                    finding.node
-                )
+                await self._attach_forensics(dump, finding)
                 self._write_forensics(dump)
 
     def _evaluate(self) -> List[Finding]:
@@ -482,28 +484,58 @@ class FleetObserver(CountersMixin, HistogramsMixin):
             "accounting": self.store.accounting(),
             "counters": dict(self._ensure_counters()),
             "solve_traces": None,
+            "stream_stats": None,
+            "journal_tail": None,
+            "rib_diff": None,
         }
         self.forensics.append(dump)
         del self.forensics[: -self.config.forensics_max]
         self._bump("fleet.forensics_dumps")
         return dump
 
-    async def _fetch_traces(self, node: str) -> Optional[Dict[str, Any]]:
-        """Best-effort flight-recorder pull from the offending node (a
-        one-shot connection: the scrape client may be mid-request)."""
+    async def _attach_forensics(
+        self, dump: Dict[str, Any], finding: "Finding"
+    ) -> None:
+        """Best-effort evidence pull from the offending node over one
+        one-shot connection (the scrape client may be mid-request): the
+        flight-recorder traces, the stream/admission state (so
+        backpressure breaches are self-contained), and the journaled
+        state change across the breach window — the journal tail plus a
+        rib-diff covering forensics_rib_window_s before the breach."""
+        node = finding.node
         if self._targets_fn is None or node not in self._targets_fn():
-            return None
+            return
         client = None
         try:
             client = await self._connect(node)
-            return await client.call(
-                "getSolveTraces", last_n=self.config.forensics_traces
+        except Exception:
+            return
+        try:
+            dump["solve_traces"] = await self._call_quiet(
+                client, "getSolveTraces",
+                last_n=self.config.forensics_traces,
             )
+            dump["stream_stats"] = await self._call_quiet(
+                client, "getStreamStats"
+            )
+            dump["journal_tail"] = await self._call_quiet(
+                client, "getJournalTail",
+                last_n=self.config.forensics_journal_tail,
+            )
+            dump["rib_diff"] = await self._call_quiet(
+                client, "getRibDiff",
+                from_ts=finding.ts - self.config.forensics_rib_window_s,
+                to_ts=finding.ts,
+            )
+        finally:
+            self._drop_client(client)
+
+    @staticmethod
+    async def _call_quiet(client, method: str, **params):
+        try:
+            return await client.call(method, **params)
         except Exception:
             return None
-        finally:
-            if client is not None:
-                self._drop_client(client)
 
     def _write_forensics(self, dump: Dict[str, Any]) -> None:
         if not self.config.forensics_dir:
@@ -561,7 +593,14 @@ class FleetObserver(CountersMixin, HistogramsMixin):
                 or "none"
             ),
         )
+        from openr_tpu.utils.build_info import (
+            ARTIFACT_SCHEMA_VERSION,
+            build_fingerprint,
+        )
+
         return {
+            "schema_version": ARTIFACT_SCHEMA_VERSION,
+            "build": build_fingerprint(),
             "config": {
                 "scrape_interval_s": self.config.scrape_interval_s,
                 "store_capacity": self.config.store_capacity,

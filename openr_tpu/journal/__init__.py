@@ -1,0 +1,27 @@
+"""State journal: journaled state history, deterministic replay, and
+route provenance (docs/Journal.md).
+
+  - `StateJournal` (journal.py): the per-node recorder — every KvStore
+    publication delta and every DecisionRouteUpdate into a bounded ring
+    with a compacted base and an optional crash-safe on-disk log (the
+    PR 14 `RecordLog` framing shared with PersistentStore).
+  - `JournalReplay` / `LsdbFolder` (replay.py): reconstruct LSDB + RIB
+    at any journaled instant, audit the reconstruction against the CPU
+    oracle, and walk route → keys → publication provenance chains.
+"""
+
+from openr_tpu.journal.journal import (
+    JournalConfig,
+    JournalRecord,
+    StateJournal,
+)
+from openr_tpu.journal.replay import JournalReplay, LsdbFolder, resolve_ts
+
+__all__ = [
+    "JournalConfig",
+    "JournalRecord",
+    "JournalReplay",
+    "LsdbFolder",
+    "StateJournal",
+    "resolve_ts",
+]

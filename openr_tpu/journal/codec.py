@@ -1,0 +1,174 @@
+"""Journal record payload codec.
+
+Journal records persist across restarts, so payloads are the tagged
+plain-JSON form of the wire serializer (utils/serializer.py) — the same
+deterministic encoding KvStore values already use on the wire. Two rules
+keep records replayable:
+
+  - only wire-crossing state is recorded: a publication's host-local
+    fields (``ts_monotonic``, ``span_stages``, ``perf_events``) and a
+    route update's ``span``/``perf_events`` are dropped — they are
+    meaningless across processes and would break record determinism;
+  - RIB entries carry ``nexthops`` as a Python set, which the serializer
+    refuses (sets have no canonical JSON form), so entries are encoded
+    field-by-field with nexthops sorted the same way
+    ``to_unicast_route`` sorts them: ``(address, iface or "")``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from openr_tpu import types as T
+from openr_tpu.solver.routes import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    RibMplsEntry,
+    RibUnicastEntry,
+)
+from openr_tpu.utils import serializer
+
+# journal payloads embed KvStore Values verbatim
+serializer.register_type(T.Value)
+
+
+def _nh_key(nh: T.NextHop):
+    return (nh.address, nh.iface or "")
+
+
+def _encode_nexthops(nexthops) -> List[Any]:
+    return [
+        serializer.to_jsonable(nh) for nh in sorted(nexthops, key=_nh_key)
+    ]
+
+
+def _decode_nexthops(items: List[Any]):
+    return {serializer.from_jsonable(nh) for nh in items}
+
+
+# ---------------------------------------------------------------------------
+# publications
+# ---------------------------------------------------------------------------
+
+
+def encode_publication(pub: T.Publication) -> Dict[str, Any]:
+    return {
+        "area": pub.area,
+        "key_vals": {
+            k: serializer.to_jsonable(v) for k, v in pub.key_vals.items()
+        },
+        "expired_keys": list(pub.expired_keys),
+        "node_ids": list(pub.node_ids) if pub.node_ids else None,
+    }
+
+
+def decode_publication(payload: Dict[str, Any]) -> T.Publication:
+    return T.Publication(
+        key_vals={
+            k: serializer.from_jsonable(v)
+            for k, v in payload.get("key_vals", {}).items()
+        },
+        expired_keys=list(payload.get("expired_keys", [])),
+        node_ids=payload.get("node_ids"),
+        area=payload.get("area", "0"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# RIB entries / deltas / full dbs
+# ---------------------------------------------------------------------------
+
+
+def encode_unicast_entry(entry: RibUnicastEntry) -> Dict[str, Any]:
+    return {
+        "prefix": str(entry.prefix),
+        "nexthops": _encode_nexthops(entry.nexthops),
+        "best_prefix_entry": serializer.to_jsonable(entry.best_prefix_entry),
+        "best_area": entry.best_area,
+        "do_not_install": entry.do_not_install,
+        "best_nexthop": serializer.to_jsonable(entry.best_nexthop),
+    }
+
+
+def decode_unicast_entry(payload: Dict[str, Any]) -> RibUnicastEntry:
+    return RibUnicastEntry(
+        prefix=T.IpPrefix(payload["prefix"]),
+        nexthops=_decode_nexthops(payload.get("nexthops", [])),
+        best_prefix_entry=serializer.from_jsonable(
+            payload.get("best_prefix_entry")
+        ),
+        best_area=payload.get("best_area"),
+        do_not_install=bool(payload.get("do_not_install", False)),
+        best_nexthop=serializer.from_jsonable(payload.get("best_nexthop")),
+    )
+
+
+def encode_mpls_entry(entry: RibMplsEntry) -> Dict[str, Any]:
+    return {
+        "label": entry.label,
+        "nexthops": _encode_nexthops(entry.nexthops),
+    }
+
+
+def decode_mpls_entry(payload: Dict[str, Any]) -> RibMplsEntry:
+    return RibMplsEntry(
+        label=int(payload["label"]),
+        nexthops=_decode_nexthops(payload.get("nexthops", [])),
+    )
+
+
+def encode_route_update(update: DecisionRouteUpdate) -> Dict[str, Any]:
+    return {
+        "unicast_update": [
+            encode_unicast_entry(e) for e in update.unicast_routes_to_update
+        ],
+        "unicast_delete": [
+            str(p) for p in update.unicast_routes_to_delete
+        ],
+        "mpls_update": [
+            encode_mpls_entry(e) for e in update.mpls_routes_to_update
+        ],
+        "mpls_delete": list(update.mpls_routes_to_delete),
+    }
+
+
+def decode_route_update(payload: Dict[str, Any]) -> DecisionRouteUpdate:
+    return DecisionRouteUpdate(
+        unicast_routes_to_update=[
+            decode_unicast_entry(e)
+            for e in payload.get("unicast_update", [])
+        ],
+        unicast_routes_to_delete=[
+            T.IpPrefix(p) for p in payload.get("unicast_delete", [])
+        ],
+        mpls_routes_to_update=[
+            decode_mpls_entry(e) for e in payload.get("mpls_update", [])
+        ],
+        mpls_routes_to_delete=list(payload.get("mpls_delete", [])),
+    )
+
+
+def encode_route_db(db: DecisionRouteDb) -> Dict[str, Any]:
+    return {
+        "unicast": {
+            str(p): encode_unicast_entry(e)
+            for p, e in db.unicast_entries.items()
+        },
+        "mpls": {
+            str(label): encode_mpls_entry(e)
+            for label, e in db.mpls_entries.items()
+        },
+    }
+
+
+def decode_route_db(payload: Optional[Dict[str, Any]]) -> DecisionRouteDb:
+    db = DecisionRouteDb()
+    if not payload:
+        return db
+    for p, e in payload.get("unicast", {}).items():
+        entry = decode_unicast_entry(e)
+        db.unicast_entries[entry.prefix] = entry
+    for label, e in payload.get("mpls", {}).items():
+        entry = decode_mpls_entry(e)
+        db.mpls_entries[entry.label] = entry
+    return db

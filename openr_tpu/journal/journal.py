@@ -1,0 +1,552 @@
+"""Bounded, crash-safe state journal: every KvStore publication delta and
+every DecisionRouteUpdate, recorded per node.
+
+Where the flight recorder keeps *metrics about* solves and the exporter
+keeps rollups, the journal keeps the **state history itself** — the raw
+deltas that produced the LSDB and RIB — so "what did the RIB look like at
+T" and "which publication made this route exist" are answerable after the
+fact. Recording rides the same ReplicateQueue fan-out the streaming layer
+uses (`get_reader()` per source; StreamManager pattern), so cost is
+O(changes): the journal sees exactly the deltas the daemon already
+produced, never a full-state walk. A sampled-overhead guard mirrors the
+flight recorder's: every record is kept, but only every Nth record takes
+`perf_counter` stamps into ``journal.record_ms`` — measuring the tap must
+not become the tap's cost.
+
+In-memory shape: a bounded ring of `JournalRecord`s plus a **compacted
+base** — when the ring overflows, the oldest record is folded into the
+base (publication records fold into a per-area key→Value map, which is
+lossless for replay because KvStore is a CRDT map: replaying the folded
+map as one synthetic publication reproduces the same LSDB as replaying
+the evicted history; RIB records fold with the delta algebra,
+`apply_route_delta`). Accounting invariant (like the flight recorder's):
+``journal.records == retained + journal.evicted``.
+
+On disk (optional ``path``): a `RecordLog` (the PR 14 journaled-file
+framing, shared with PersistentStore) holding one snapshot record (the
+base) followed by appended journal records. Appends are batched on a
+debounced flush and fsynced per batch — a crash loses at most the last
+unflushed interval, and a torn tail recovers to the longest well-formed
+record prefix exactly like the config store. When the appended tail
+outgrows ``max(snapshot_bytes, min_compact_bytes)`` the next flush
+compacts: one atomic rewrite of base + ring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from openr_tpu.configstore import record_log
+from openr_tpu.journal import codec
+from openr_tpu.messaging import QueueClosedError
+from openr_tpu.utils.counters import CountersMixin, HistogramsMixin
+
+_MAGIC = b"ONRSJ1\n"
+_REC_SNAPSHOT, _REC_RECORD = 0, 1
+
+
+@dataclass
+class JournalConfig:
+    enabled: bool = False
+    ring_size: int = 4096  # in-memory record ring bound
+    key_history: int = 16  # per-(area,key) history entries retained
+    sample_every: int = 16  # Nth-record timing guard (0 disables)
+    path: Optional[str] = None  # durable log; None = memory only
+    flush_interval_s: float = 0.2  # append-batch debounce
+    min_compact_bytes: int = 65536  # journal tail size forcing compaction
+
+
+@dataclass
+class JournalRecord:
+    seq: int
+    ts: float  # wall clock (time.time()) — the replay/query time axis
+    kind: str  # "pub" | "rib"
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq,
+            "ts": self.ts,
+            "kind": self.kind,
+            "payload": self.payload,
+        }
+
+
+class StateJournal(CountersMixin, HistogramsMixin):
+    """Per-node state journal: recorder + compacted base + durable log.
+
+    Registered with the Monitor as the ``journal`` module so ``journal.*``
+    counters land in every scrape (docs/Monitoring.md "State journal").
+    """
+
+    def __init__(
+        self,
+        node_name: str,
+        config: Optional[JournalConfig] = None,
+        *,
+        kvstore_updates=None,
+        route_updates=None,
+        solver_flags: Optional[Dict[str, Any]] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.config = config or JournalConfig()
+        self._kvstore_updates = kvstore_updates
+        self._route_updates = route_updates
+        # CPU-oracle flags for the replay audit — must match Decision's
+        # so re-derived routes are comparable to the recorded ones
+        self.solver_flags = dict(solver_flags or {})
+        self._loop = loop
+        self._ring: Deque[JournalRecord] = deque()
+        # compacted base: everything evicted from the ring, folded
+        self._base_keys: Dict[str, Dict[str, Any]] = {}  # area -> key -> Value jsonable
+        self._base_rib: Dict[str, Dict[str, Any]] = {"unicast": {}, "mpls": {}}
+        self._base_seq = 0
+        self._base_ts = 0.0
+        self._seq = 0
+        # bounded per-(area,key) publication history for `kvstore history`
+        self._key_history: Dict[Tuple[str, str], Deque[Dict[str, Any]]] = {}
+        # durable log state (PersistentStore geometry discipline)
+        self._log: Optional[record_log.RecordLog] = None
+        self._pending: List[bytes] = []
+        self._flush_timer: Optional[asyncio.TimerHandle] = None
+        self._snapshot_bytes = 0
+        self._journal_bytes = 0
+        self._needs_compact = True
+        self._tasks: List[asyncio.Task] = []
+        self._started = False
+        self._ensure_counters()
+        self._ensure_histograms()
+        if self.config.path:
+            self._log = record_log.RecordLog(
+                self.config.path, _MAGIC, (_REC_SNAPSHOT, _REC_RECORD)
+            )
+            self._load_from_disk()
+
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop or asyncio.get_event_loop()
+
+    # ------------------------------------------------------------------
+    # lifecycle (StreamManager dispatch-task pattern)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started or not self.config.enabled:
+            return
+        self._started = True
+        if self._kvstore_updates is not None:
+            self._tasks.append(
+                self.loop().create_task(
+                    self._consume(
+                        self._kvstore_updates.get_reader(),
+                        self.record_publication,
+                    )
+                )
+            )
+        if self._route_updates is not None:
+            self._tasks.append(
+                self.loop().create_task(
+                    self._consume(
+                        self._route_updates.get_reader(),
+                        self.record_route_update,
+                    )
+                )
+            )
+
+    def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+        self._started = False
+        self.flush()
+
+    async def _consume(self, reader, recorder) -> None:
+        try:
+            while True:
+                item = await reader.get()
+                try:
+                    recorder(item)
+                except Exception:
+                    # a malformed item must not kill the tap
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "journal record failed"
+                    )
+                    self._bump("journal.record_errors")
+        except (QueueClosedError, asyncio.CancelledError):
+            return
+        finally:
+            reader.close()
+
+    # ------------------------------------------------------------------
+    # recording (hot path: O(changes), host-side only)
+    # ------------------------------------------------------------------
+
+    def record_publication(self, pub) -> None:
+        t0 = self._maybe_t0()
+        payload = codec.encode_publication(pub)
+        rec = self._record("pub", payload)
+        self._bump("journal.pub_records")
+        for key, val in payload["key_vals"].items():
+            self._push_history(
+                pub.area,
+                key,
+                {
+                    "seq": rec.seq,
+                    "ts": rec.ts,
+                    "version": val.get("version"),
+                    "ttl_version": val.get("ttl_version"),
+                    "originator_id": val.get("originator_id"),
+                    "deleted": False,
+                },
+            )
+        for key in payload["expired_keys"]:
+            self._push_history(
+                pub.area,
+                key,
+                {
+                    "seq": rec.seq,
+                    "ts": rec.ts,
+                    "version": None,
+                    "ttl_version": None,
+                    "originator_id": None,
+                    "deleted": True,
+                },
+            )
+        self._maybe_observe(t0)
+
+    def record_route_update(self, update) -> None:
+        if update.empty():
+            return
+        t0 = self._maybe_t0()
+        self._record("rib", codec.encode_route_update(update))
+        self._bump("journal.rib_records")
+        self._maybe_observe(t0)
+
+    def _record(self, kind: str, payload: Dict[str, Any]) -> JournalRecord:
+        self._seq += 1
+        rec = JournalRecord(self._seq, time.time(), kind, payload)
+        self._ring.append(rec)
+        self._bump("journal.records")
+        while len(self._ring) > max(self.config.ring_size, 1):
+            self._evict(self._ring.popleft())
+        if self._log is not None:
+            self._pending.append(
+                record_log.pack(
+                    _REC_RECORD, b"", json.dumps(rec.to_dict()).encode()
+                )
+            )
+            self._schedule_flush()
+        return rec
+
+    def _maybe_t0(self) -> Optional[float]:
+        n = self.config.sample_every
+        if n <= 0 or self.counters.get("journal.records", 0) % n:
+            return None
+        return time.perf_counter()
+
+    def _maybe_observe(self, t0: Optional[float]) -> None:
+        if t0 is not None:
+            self._observe(
+                "journal.record_ms", (time.perf_counter() - t0) * 1e3
+            )
+
+    def _push_history(self, area: str, key: str, entry: Dict[str, Any]) -> None:
+        hist = self._key_history.get((area, key))
+        if hist is None:
+            hist = deque(maxlen=max(self.config.key_history, 1))
+            self._key_history[(area, key)] = hist
+        hist.append(entry)
+
+    # ------------------------------------------------------------------
+    # eviction: fold the oldest record into the compacted base
+    # ------------------------------------------------------------------
+
+    def _evict(self, rec: JournalRecord) -> None:
+        if rec.kind == "pub":
+            area_keys = self._base_keys.setdefault(
+                rec.payload.get("area", "0"), {}
+            )
+            for key, val in rec.payload.get("key_vals", {}).items():
+                area_keys[key] = val
+            for key in rec.payload.get("expired_keys", []):
+                area_keys.pop(key, None)
+        else:
+            unicast = self._base_rib["unicast"]
+            mpls = self._base_rib["mpls"]
+            for entry in rec.payload.get("unicast_update", []):
+                unicast[entry["prefix"]] = entry
+            for prefix in rec.payload.get("unicast_delete", []):
+                unicast.pop(prefix, None)
+            for entry in rec.payload.get("mpls_update", []):
+                mpls[str(entry["label"])] = entry
+            for label in rec.payload.get("mpls_delete", []):
+                mpls.pop(str(label), None)
+        self._base_seq = rec.seq
+        self._base_ts = rec.ts
+        self._bump("journal.evicted")
+
+    # ------------------------------------------------------------------
+    # durable log (PersistentStore write-behind discipline)
+    # ------------------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._flush_timer is not None:
+            self._flush_timer.cancel()
+            self._flush_timer = None
+        self._flush_to_disk()
+
+    def _schedule_flush(self, retry: bool = False) -> None:
+        try:
+            loop = self._loop or asyncio.get_running_loop()
+        except RuntimeError:
+            if not retry:
+                self._flush_to_disk()  # no loop (tools): write now
+            return
+        if self._flush_timer is not None:
+            return
+        self._flush_timer = loop.call_later(
+            self.config.flush_interval_s, self._flush_cb
+        )
+
+    def _flush_cb(self) -> None:
+        self._flush_timer = None
+        self._flush_to_disk()
+
+    def _flush_to_disk(self) -> None:
+        """One durable write: append the pending batch, or compact when
+        the tail outgrew the snapshot (or is suspect). Failures keep the
+        batch pending and retry on the flush interval — journaling must
+        never crash the daemon."""
+        if self._log is None or (not self._pending and not self._needs_compact):
+            return
+        t0 = time.perf_counter()
+        try:
+            blob = b"".join(self._pending)
+            if (
+                self._needs_compact
+                or not self._log.exists()
+                or self._journal_bytes + len(blob)
+                >= max(self._snapshot_bytes, self.config.min_compact_bytes)
+            ):
+                self._write_snapshot()
+            else:
+                self._log.append(blob)
+                self._pending.clear()
+                self._journal_bytes += len(blob)
+                self._bump("journal.appends")
+        except Exception:
+            self._bump("journal.write_failures")
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "journal write failed; retrying"
+            )
+            self._schedule_flush(retry=True)
+            return
+        self._observe("journal.flush_ms", (time.perf_counter() - t0) * 1e3)
+
+    def _write_snapshot(self) -> None:
+        """Atomic rewrite: base snapshot + the live ring re-appended."""
+        snap = {
+            "seq": self._base_seq,
+            "ts": self._base_ts,
+            "keys": self._base_keys,
+            "rib": self._base_rib,
+        }
+        payload = json.dumps(snap, sort_keys=True).encode()
+        blob = record_log.pack(_REC_SNAPSHOT, b"", payload)
+        blob += b"".join(
+            record_log.pack(
+                _REC_RECORD, b"", json.dumps(rec.to_dict()).encode()
+            )
+            for rec in self._ring
+        )
+        self._log.rewrite(blob)
+        self._pending.clear()
+        self._snapshot_bytes = len(payload)
+        self._journal_bytes = len(blob) - record_log.HEADER.size - len(payload)
+        self._needs_compact = False
+        self._bump("journal.snapshots")
+
+    def _load_from_disk(self) -> None:
+        if not self._log.exists():
+            return
+        try:
+            records, truncated = self._log.scan()
+        except record_log.BadMagicError:
+            self._needs_compact = True
+            return
+        except Exception:
+            self._bump("journal.load_errors")
+            self._needs_compact = True
+            return
+        for rec_type, _key, value in records:
+            try:
+                doc = json.loads(value)
+            except Exception:
+                truncated = True  # torn body
+                break
+            if rec_type == _REC_SNAPSHOT:
+                self._base_keys = doc.get("keys", {})
+                self._base_rib = doc.get(
+                    "rib", {"unicast": {}, "mpls": {}}
+                )
+                self._base_seq = int(doc.get("seq", 0))
+                self._base_ts = float(doc.get("ts", 0.0))
+                self._ring.clear()
+                self._seq = self._base_seq
+            else:
+                rec = JournalRecord(
+                    int(doc["seq"]),
+                    float(doc["ts"]),
+                    doc["kind"],
+                    doc.get("payload", {}),
+                )
+                self._ring.append(rec)
+                self._seq = max(self._seq, rec.seq)
+                self._bump("journal.records")
+                while len(self._ring) > max(self.config.ring_size, 1):
+                    self._evict(self._ring.popleft())
+        # rebuild bounded key history: base keys at the base seq, then
+        # ring publication records in order
+        for area, keys in self._base_keys.items():
+            for key, val in keys.items():
+                self._push_history(
+                    area,
+                    key,
+                    {
+                        "seq": self._base_seq,
+                        "ts": self._base_ts,
+                        "version": val.get("version"),
+                        "ttl_version": val.get("ttl_version"),
+                        "originator_id": val.get("originator_id"),
+                        "deleted": False,
+                    },
+                )
+        for rec in self._ring:
+            if rec.kind != "pub":
+                continue
+            area = rec.payload.get("area", "0")
+            for key, val in rec.payload.get("key_vals", {}).items():
+                self._push_history(
+                    area,
+                    key,
+                    {
+                        "seq": rec.seq,
+                        "ts": rec.ts,
+                        "version": val.get("version"),
+                        "ttl_version": val.get("ttl_version"),
+                        "originator_id": val.get("originator_id"),
+                        "deleted": False,
+                    },
+                )
+            for key in rec.payload.get("expired_keys", []):
+                self._push_history(
+                    area,
+                    key,
+                    {
+                        "seq": rec.seq,
+                        "ts": rec.ts,
+                        "version": None,
+                        "ttl_version": None,
+                        "originator_id": None,
+                        "deleted": True,
+                    },
+                )
+        if truncated:
+            self._bump("journal.load_truncations")
+            self._needs_compact = True  # never append after garbage
+        else:
+            self._needs_compact = False
+
+    # ------------------------------------------------------------------
+    # query surfaces (ctrl handlers call these; all host-side)
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.config.enabled,
+            "node": self.node_name,
+            "retained": len(self._ring),
+            "base_seq": self._base_seq,
+            "last_seq": self._seq,
+            "base_ts": self._base_ts,
+            "ring_size": self.config.ring_size,
+            "path": self.config.path,
+            "counters": dict(self.counters),
+        }
+
+    def tail(self, last_n: int = 32) -> List[Dict[str, Any]]:
+        n = max(int(last_n), 0)
+        recs = list(self._ring)[-n:] if n else []
+        return [rec.to_dict() for rec in recs]
+
+    def key_history(
+        self, key: str, area: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for (a, k), hist in self._key_history.items():
+            if k != key or (area is not None and a != area):
+                continue
+            out.extend(dict(entry, area=a, key=k) for entry in hist)
+        out.sort(key=lambda e: e["seq"])
+        return out
+
+    def records(self) -> List[JournalRecord]:
+        return list(self._ring)
+
+    def base(self) -> Dict[str, Any]:
+        return {
+            "seq": self._base_seq,
+            "ts": self._base_ts,
+            "keys": self._base_keys,
+            "rib": self._base_rib,
+        }
+
+    # ------------------------------------------------------------------
+    # replay entry points (journal/replay.py does the work)
+    # ------------------------------------------------------------------
+
+    def replayer(self):
+        from openr_tpu.journal.replay import JournalReplay
+
+        return JournalReplay(
+            self.node_name, self.base(), self.records(), self.solver_flags
+        )
+
+    def _timed_replay(self, fn):
+        t0 = time.perf_counter()
+        try:
+            return fn(self.replayer())
+        finally:
+            self._bump("journal.replays")
+            self._observe(
+                "journal.replay_ms", (time.perf_counter() - t0) * 1e3
+            )
+
+    def replay_at(self, at: Optional[float] = None):
+        """Reconstructed (LSDB folder, RIB, meta) at instant `at`."""
+        return self._timed_replay(lambda r: r.replay(at))
+
+    def verify_replay(self, at: Optional[float] = None) -> Dict[str, Any]:
+        """Standing correctness audit: re-derive routes through the CPU
+        oracle over the reconstructed LSDB and diff against the journaled
+        RIB. Advisory — exact at quiescent instants with no RibPolicy."""
+        return self._timed_replay(lambda r: r.verify(at))
+
+    def explain_route(
+        self, prefix: str, at: Optional[float] = None
+    ) -> Dict[str, Any]:
+        return self._timed_replay(lambda r: r.explain_route(prefix, at))
+
+    def rib_diff(
+        self, from_ts: Optional[float], to_ts: Optional[float]
+    ) -> Dict[str, Any]:
+        return self._timed_replay(lambda r: r.rib_diff(from_ts, to_ts))

@@ -1,0 +1,442 @@
+"""Deterministic replay: reconstruct the LSDB and RIB at any journaled
+instant, and answer provenance queries over the reconstruction.
+
+`LsdbFolder` mirrors Decision's publication fold exactly (decision.py
+`process_publication` → `_process_key` → `_update_node_prefix_database`):
+adj values load with copy-on-write area stamping, prefix values aggregate
+per (node, area) with per-prefix keys overriding full-db keys and the
+self-redistribution filter applied, expired keys delete the matching db.
+The one intentional difference: ordered-FIB hold TTLs are replayed as
+zero — holds only stage *when* an update lands, and replay targets the
+settled state, not the schedule.
+
+The base-seeding trick that keeps the journal bounded: KvStore is a CRDT
+**map**, so folding evicted publication records into a key→Value map and
+replaying that map as one synthetic publication reproduces the same
+LSDB/aggregation state as replaying the evicted history record by record.
+RIB records fold with the delta algebra (`apply_route_delta`), whose
+round-trip identity PR 7 proved. replay(T) therefore equals the live
+RIB snapshot at T for any T the ring still brackets — the standing
+correctness audit `verify()` re-derives routes through the CPU oracle
+over the reconstructed LSDB and diffs against the journaled RIB
+(advisory: exact at quiescent instants with no active RibPolicy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from openr_tpu.journal import codec
+from openr_tpu.journal.journal import JournalRecord
+from openr_tpu.lsdb import LinkState, PrefixState
+from openr_tpu.solver import SpfSolver, get_route_delta
+from openr_tpu.solver.routes import DecisionRouteDb, apply_route_delta
+from openr_tpu.types import (
+    ADJ_DB_MARKER,
+    PREFIX_DB_MARKER,
+    AdjacencyDatabase,
+    IpPrefix,
+    PrefixDatabase,
+    Publication,
+    parse_prefix_key,
+)
+from openr_tpu.utils import serializer
+
+# the CPU-oracle flags replay accepts (must match Decision's so
+# re-derived routes are comparable to the recorded ones)
+_SOLVER_FLAGS = (
+    "enable_v4",
+    "compute_lfa_paths",
+    "enable_ordered_fib",
+    "bgp_dry_run",
+    "bgp_use_igp_metric",
+)
+
+
+def resolve_ts(t: Optional[float]) -> Optional[float]:
+    """CLI time axis: None = latest, t >= 0 = unix seconds, t < 0 =
+    seconds relative to now (`--at -40` = forty seconds ago)."""
+    if t is None:
+        return None
+    t = float(t)
+    return time.time() + t if t < 0 else t
+
+
+class LsdbFolder:
+    """Decision's LSDB fold, replayed offline (no debounce, no solver)."""
+
+    def __init__(self, my_node_name: str) -> None:
+        self.my_node_name = my_node_name
+        self.area_link_states: Dict[str, LinkState] = {}
+        self.prefix_state = PrefixState()
+        self._per_prefix: Dict[Tuple[str, str], Dict] = {}
+        self._full_db: Dict[Tuple[str, str], Dict] = {}
+        self.errors = 0
+        # provenance indexes maintained during the fold:
+        #   key_last_applied: (area, key) -> the publication that last
+        #       touched the key at the replayed instant
+        #   prefix_sources: prefix str -> {(area, key): seq} — which
+        #       prefix keys currently advertise the prefix
+        self.key_last_applied: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.prefix_sources: Dict[str, Dict[Tuple[str, str], int]] = {}
+        self._key_contrib: Dict[Tuple[str, str], Set[str]] = {}
+
+    # -- publication fold (mirrors decision.process_publication) --------
+
+    def apply_publication(
+        self, pub: Publication, seq: int, ts: float
+    ) -> None:
+        area = pub.area
+        link_state = self.area_link_states.get(area)
+        if link_state is None:
+            link_state = LinkState(area)
+            self.area_link_states[area] = link_state
+        for key in sorted(pub.key_vals):
+            value = pub.key_vals[key]
+            if value.value is None:
+                continue  # ttl refresh only
+            try:
+                self._apply_key(key, value, area, link_state, seq, ts)
+            except Exception:
+                self.errors += 1
+        for key in pub.expired_keys:
+            try:
+                self._apply_expired(key, area, link_state, seq, ts)
+            except Exception:
+                self.errors += 1
+
+    def _apply_key(
+        self, key: str, value, area: str, link_state: LinkState,
+        seq: int, ts: float,
+    ) -> None:
+        self.key_last_applied[(area, key)] = {
+            "seq": seq,
+            "ts": ts,
+            "version": value.version,
+            "ttl_version": value.ttl_version,
+            "originator_id": value.originator_id,
+            "deleted": False,
+        }
+        if key.startswith(ADJ_DB_MARKER):
+            adj_db = serializer.loads(value.value)
+            assert isinstance(adj_db, AdjacencyDatabase)
+            if adj_db.area != area:
+                adj_db = dataclasses.replace(adj_db, area=area)
+            # holds replayed as zero: ordered-FIB TTLs stage apply
+            # *timing*, and replay reconstructs the settled state
+            link_state.update_adjacency_database(adj_db, 0, 0)
+        elif key.startswith(PREFIX_DB_MARKER):
+            prefix_db = serializer.loads(value.value)
+            assert isinstance(prefix_db, PrefixDatabase)
+            self._apply_prefix_db(key, prefix_db, area, seq)
+
+    def _apply_expired(
+        self, key: str, area: str, link_state: LinkState,
+        seq: int, ts: float,
+    ) -> None:
+        self.key_last_applied[(area, key)] = {
+            "seq": seq,
+            "ts": ts,
+            "version": None,
+            "ttl_version": None,
+            "originator_id": None,
+            "deleted": True,
+        }
+        if key.startswith(ADJ_DB_MARKER):
+            link_state.delete_adjacency_database(key[len(ADJ_DB_MARKER):])
+        elif key.startswith(PREFIX_DB_MARKER):
+            node, _, _ = parse_prefix_key(key)
+            delete_db = PrefixDatabase(
+                this_node_name=node, delete_prefix=True
+            )
+            self._apply_prefix_db(key, delete_db, area, seq)
+
+    def _apply_prefix_db(
+        self, key: str, prefix_db: PrefixDatabase, area: str, seq: int
+    ) -> None:
+        node_db = self._update_node_prefix_database(
+            key, prefix_db, area, seq
+        )
+        if node_db is None:
+            return
+        node_db.area = area
+        self.prefix_state.update_prefix_database(node_db)
+
+    def _update_node_prefix_database(
+        self, key: str, prefix_db: PrefixDatabase, pub_area: str, seq: int
+    ) -> Optional[PrefixDatabase]:
+        """Per-(node, area) aggregation — decision.py's
+        `_update_node_prefix_database` with provenance tracking bolted
+        on; the merge semantics are byte-for-byte the same."""
+        node = prefix_db.this_node_name
+        _, key_area, key_prefix = parse_prefix_key(key)
+        area = key_area if key_area is not None else pub_area
+        agg_key = (node, area)
+        per_prefix = self._per_prefix.setdefault(agg_key, {})
+        full_db = self._full_db.setdefault(agg_key, {})
+        src = (area, key)
+        if key_prefix is not None:
+            if prefix_db.delete_prefix:
+                per_prefix.pop(key_prefix, None)
+                self._drop_source(str(key_prefix), src)
+            else:
+                assert len(prefix_db.prefix_entries) == 1, key
+                entry = prefix_db.prefix_entries[0]
+                if (
+                    node == self.my_node_name
+                    and entry.area_stack
+                    and entry.area_stack[0] in self.area_link_states
+                ):
+                    return None  # self-redistribution reflection
+                per_prefix[key_prefix] = entry
+                self.prefix_sources.setdefault(str(key_prefix), {})[
+                    src
+                ] = seq
+        else:
+            full_db.clear()
+            fresh = {str(e.prefix) for e in prefix_db.prefix_entries}
+            for stale in self._key_contrib.get(src, set()) - fresh:
+                self._drop_source(stale, src)
+            self._key_contrib[src] = fresh
+            for entry in prefix_db.prefix_entries:
+                full_db[entry.prefix] = entry
+                self.prefix_sources.setdefault(str(entry.prefix), {})[
+                    src
+                ] = seq
+
+        node_db = PrefixDatabase(this_node_name=node)
+        node_db.prefix_entries.extend(per_prefix.values())
+        node_db.prefix_entries.extend(
+            entry
+            for prefix, entry in full_db.items()
+            if prefix not in per_prefix
+        )
+        return node_db
+
+    def _drop_source(self, prefix_str: str, src: Tuple[str, str]) -> None:
+        sources = self.prefix_sources.get(prefix_str)
+        if sources is not None:
+            sources.pop(src, None)
+            if not sources:
+                del self.prefix_sources[prefix_str]
+
+
+@dataclass
+class ReplayResult:
+    folder: LsdbFolder
+    rib: DecisionRouteDb
+    at_ts: Optional[float]
+    at_seq: int
+    applied: int
+    base_seq: int
+    fold_errors: int = 0
+
+
+class JournalReplay:
+    """Replay a journal's (base, record ring) into state-at-T."""
+
+    def __init__(
+        self,
+        node_name: str,
+        base: Dict[str, Any],
+        records: List[JournalRecord],
+        solver_flags: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.base = base
+        self.records = records
+        self.solver_flags = {
+            k: v
+            for k, v in (solver_flags or {}).items()
+            if k in _SOLVER_FLAGS
+        }
+
+    def replay(self, at: Optional[float] = None) -> ReplayResult:
+        at = resolve_ts(at)
+        folder = LsdbFolder(self.node_name)
+        base_seq = int(self.base.get("seq", 0))
+        at_seq = base_seq
+        # base: the folded key map replays as one synthetic publication
+        # per area (the CRDT-map property; module docstring)
+        for area in sorted(self.base.get("keys", {})):
+            keys = self.base["keys"][area]
+            if not keys:
+                continue
+            pub = Publication(
+                key_vals={
+                    k: serializer.from_jsonable(v) for k, v in keys.items()
+                },
+                area=area,
+            )
+            folder.apply_publication(
+                pub, base_seq, float(self.base.get("ts", 0.0))
+            )
+        rib = codec.decode_route_db(self.base.get("rib"))
+        applied = 0
+        for rec in self.records:
+            if at is not None and rec.ts > at:
+                continue  # ts may jitter vs seq order; filter, not break
+            if rec.kind == "pub":
+                folder.apply_publication(
+                    codec.decode_publication(rec.payload), rec.seq, rec.ts
+                )
+            else:
+                rib = apply_route_delta(
+                    rib, codec.decode_route_update(rec.payload)
+                )
+            applied += 1
+            at_seq = max(at_seq, rec.seq)
+        return ReplayResult(
+            folder=folder,
+            rib=rib,
+            at_ts=at,
+            at_seq=at_seq,
+            applied=applied,
+            base_seq=base_seq,
+            fold_errors=folder.errors,
+        )
+
+    # ------------------------------------------------------------------
+    # standing correctness audit
+    # ------------------------------------------------------------------
+
+    def verify(self, at: Optional[float] = None) -> Dict[str, Any]:
+        """Re-derive routes through the CPU oracle over the reconstructed
+        LSDB and diff against the journaled RIB."""
+        result = self.replay(at)
+        solver = SpfSolver(self.node_name, **self.solver_flags)
+        oracle = solver.build_route_db(
+            self.node_name, result.folder.area_link_states,
+            result.folder.prefix_state,
+        )
+        mismatches: List[Dict[str, Any]] = []
+        oracle_unicast = oracle.unicast_entries if oracle else {}
+        oracle_mpls = oracle.mpls_entries if oracle else {}
+        for prefix, entry in oracle_unicast.items():
+            got = result.rib.unicast_entries.get(prefix)
+            if got is None:
+                mismatches.append({"prefix": str(prefix), "why": "missing"})
+            elif got != entry:
+                mismatches.append({"prefix": str(prefix), "why": "differs"})
+        for prefix in result.rib.unicast_entries:
+            if prefix not in oracle_unicast:
+                mismatches.append({"prefix": str(prefix), "why": "extra"})
+        for label, entry in oracle_mpls.items():
+            got = result.rib.mpls_entries.get(label)
+            if got is None:
+                mismatches.append({"label": label, "why": "missing"})
+            elif got != entry:
+                mismatches.append({"label": label, "why": "differs"})
+        for label in result.rib.mpls_entries:
+            if label not in oracle_mpls:
+                mismatches.append({"label": label, "why": "extra"})
+        return {
+            "at_ts": result.at_ts,
+            "at_seq": result.at_seq,
+            "applied": result.applied,
+            "fold_errors": result.fold_errors,
+            "routes": len(result.rib.unicast_entries),
+            "oracle_routes": len(oracle_unicast),
+            "mismatches": mismatches,
+            "match": not mismatches,
+        }
+
+    # ------------------------------------------------------------------
+    # provenance queries
+    # ------------------------------------------------------------------
+
+    def explain_route(
+        self, prefix: str, at: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """route → contributing prefix/adjacency keys → originating
+        publication. The SolveTrace link is attached ctrl-side (the
+        flight recorder lives in Decision, not the journal)."""
+        result = self.replay(at)
+        pfx = IpPrefix(prefix)
+        out: Dict[str, Any] = {
+            "prefix": str(pfx),
+            "at_ts": result.at_ts,
+            "at_seq": result.at_seq,
+            "found": False,
+            "prefix_keys": [],
+            "adjacency_keys": [],
+            "complete": False,
+        }
+        entry = result.rib.unicast_entries.get(pfx)
+        if entry is None:
+            return out
+        out["found"] = True
+        out["route"] = codec.encode_unicast_entry(entry)
+
+        def key_info(
+            area: str, key: str, seq: Optional[int] = None
+        ) -> Dict[str, Any]:
+            pub = result.folder.key_last_applied.get((area, key))
+            if seq is None:
+                seq = pub["seq"] if pub is not None else 0
+            info = {"area": area, "key": key, "seq": seq}
+            if pub is not None:
+                info["publication"] = dict(pub)
+            return info
+
+        for (area, key), seq in sorted(
+            result.folder.prefix_sources.get(str(pfx), {}).items()
+        ):
+            out["prefix_keys"].append(key_info(area, key, seq))
+
+        # adjacency attribution: my own adj db plus the neighbor behind
+        # each nexthop (matched by neighbor_node when stamped, else by
+        # the adjacency's nexthop address)
+        unattributed = set()
+        adj_keys: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for area, link_state in result.folder.area_link_states.items():
+            dbs = link_state.get_adjacency_databases()
+            my_db = dbs.get(self.node_name)
+            if my_db is None:
+                continue
+            me_key = (area, ADJ_DB_MARKER + self.node_name)
+            adj_keys.setdefault(me_key, key_info(*me_key))
+            for nh in entry.nexthops:
+                neighbor = nh.neighbor_node
+                if neighbor is None:
+                    for adj in my_db.adjacencies:
+                        if nh.address in (adj.nexthop_v4, adj.nexthop_v6):
+                            neighbor = adj.other_node_name
+                            break
+                if neighbor is None or neighbor not in dbs:
+                    unattributed.add(nh.address)
+                    continue
+                unattributed.discard(nh.address)
+                nbr_key = (area, ADJ_DB_MARKER + neighbor)
+                adj_keys.setdefault(nbr_key, key_info(*nbr_key))
+        out["adjacency_keys"] = [
+            adj_keys[k] for k in sorted(adj_keys)
+        ]
+        out["complete"] = bool(out["prefix_keys"]) and (
+            not entry.nexthops or not unattributed
+        )
+        return out
+
+    def rib_diff(
+        self, from_ts: Optional[float], to_ts: Optional[float]
+    ) -> Dict[str, Any]:
+        r_from = self.replay(from_ts)
+        r_to = self.replay(to_ts)
+        delta = get_route_delta(r_to.rib, r_from.rib)
+        return {
+            "from": {
+                "at_ts": r_from.at_ts,
+                "at_seq": r_from.at_seq,
+                "routes": len(r_from.rib.unicast_entries),
+            },
+            "to": {
+                "at_ts": r_to.at_ts,
+                "at_seq": r_to.at_seq,
+                "routes": len(r_to.rib.unicast_entries),
+            },
+            "changed": not delta.empty(),
+            "delta": codec.encode_route_update(delta),
+        }

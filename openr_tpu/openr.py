@@ -386,6 +386,34 @@ class OpenrDaemon:
             )
         )
 
+        # --- state journal (docs/Journal.md) ---------------------------
+        from openr_tpu.journal import JournalConfig, StateJournal
+
+        jc = c.journal_config
+        self.journal = StateJournal(
+            node,
+            JournalConfig(
+                enabled=jc.enabled,
+                ring_size=jc.ring_size,
+                key_history=jc.key_history,
+                sample_every=jc.sample_every,
+                path=jc.path,
+                flush_interval_s=jc.flush_interval_s,
+                min_compact_bytes=jc.min_compact_bytes,
+            ),
+            kvstore_updates=self.kvstore.updates_queue,
+            route_updates=self.route_updates_queue,
+            # replay re-derives routes through the CPU oracle with the
+            # same flags Decision solves under
+            solver_flags={
+                "enable_v4": c.enable_v4,
+                "compute_lfa_paths": dc.compute_lfa_paths,
+                "enable_ordered_fib": c.enable_ordered_fib_programming,
+                "bgp_use_igp_metric": c.bgp_use_igp_metric,
+            },
+            loop=loop,
+        )
+
         # --- ctrl server ----------------------------------------------
         self.ctrl_server = CtrlServer(
             node,
@@ -402,6 +430,7 @@ class OpenrDaemon:
             config=config,
             stream_manager=self.stream_manager,
             admission=self.admission,
+            journal=self.journal,
             loop=loop,
             ssl_context=self._server_ssl,
             tls_acceptable_peers=c.tls_acceptable_peers or None,
@@ -418,6 +447,7 @@ class OpenrDaemon:
             # ctrl.stream.* / ctrl.admission.* ride every scrape
             ("ctrl_stream", self.stream_manager),
             ("ctrl_admission", self.admission),
+            ("journal", self.journal),
         ):
             self.monitor.register_module(name, module)
 
@@ -445,6 +475,7 @@ class OpenrDaemon:
         # fan-out dispatch must drain before the ctrl server can accept
         # subscribers (its readers consume the module queues continuously)
         self.stream_manager.start()
+        self.journal.start()
         port = await self.ctrl_server.start()
         if self.config.config.enable_bgp_peering:
             # extension seam (Main.cpp:589-595, plugin/Plugin.h:24-34);
@@ -489,6 +520,7 @@ class OpenrDaemon:
 
             plugin_stop()
         await self.ctrl_server.stop()
+        self.journal.stop()  # flushes the pending durable-log batch
         self.stream_manager.stop()
         self.fib.stop()
         self.decision.stop()

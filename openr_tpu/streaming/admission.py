@@ -55,6 +55,11 @@ DEFAULT_COSTS: Dict[str, int] = {
     # an on-demand profiling window perturbs every dispatch it covers:
     # admission-bounded like the other expensive calls
     "startProfile": 1,
+    # journal replays re-fold the LSDB and re-run the CPU oracle
+    # (docs/Journal.md): expensive like a computed-route-db request
+    "explainRoute": 1,
+    "getRibDiff": 1,
+    "verifyJournalReplay": 1,
 }
 
 

@@ -569,6 +569,7 @@ def run_bench_convergence(
     churn_keys: int = 0,
     churn_value_bytes: int = 4096,
     debounce_ms: Optional[Tuple[float, float]] = None,
+    journal: bool = False,
 ) -> dict:
     """Hello-to-programmed-route percentiles from an emulator flap run —
     bench.py's second metric line (ROADMAP "relight the benchmark").
@@ -619,7 +620,15 @@ def run_bench_convergence(
     adjacency deltas — both A/B legs get the identical enriched
     batch; `debounce_ms=(min, max)` pins the SPF debounce window so
     A/B fan-out legs don't eat 10–250 ms of per-wave timer jitter in
-    their events/s denominators."""
+    their events/s denominators.
+
+    With `journal=True` every node records the flap batch into its
+    state journal (openr_tpu/journal, in-memory ring) — bench.py's
+    `journal_record_us` line: the summary gains journal_{records,
+    record_us,evicted,replay_verified} so the per-record overhead and
+    its convergence-p95 cost are measured on one run, and the final
+    state is replay-verified against the CPU oracle on every node
+    (docs/Journal.md)."""
     from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
 
     n = max(3, nodes)
@@ -633,15 +642,18 @@ def run_bench_convergence(
         if debounce_ms is not None:
             decision_overrides["debounce_min_ms"] = debounce_ms[0]
             decision_overrides["debounce_max_ms"] = debounce_ms[1]
+        overrides: dict = {
+            "decision_config": decision_overrides,
+            "stream_config": stream_overrides,
+        }
+        if journal:
+            overrides["journal_config"] = {"enabled": True}
         net = VirtualNetwork()
         for i in range(n):
             net.add_node(
                 f"n{i}",
                 loopback_prefix=f"10.{i}.0.0/24",
-                config_overrides={
-                    "decision_config": decision_overrides,
-                    "stream_config": stream_overrides,
-                },
+                config_overrides=overrides,
             )
         await net.start_all()
         for i in range(n - 1):
@@ -983,6 +995,34 @@ def run_bench_convergence(
                     encode_stats["stream_stalled_kinds"] = sorted(
                         set(stalled_kinds)
                     )
+            journal_stats = {}
+            if journal:
+                j_records = j_evicted = j_verified = 0
+                rec_sum = 0.0
+                rec_count = 0
+                for wrapper in net.wrappers.values():
+                    jr = wrapper.daemon.journal
+                    j_records += jr.counters.get("journal.records", 0)
+                    j_evicted += jr.counters.get("journal.evicted", 0)
+                    hist = jr.histograms.get("journal.record_ms")
+                    if hist is not None:
+                        rec_sum += hist.sum
+                        rec_count += hist.count
+                    if jr.verify_replay().get("match"):
+                        j_verified += 1
+                journal_stats = {
+                    "journal_records": j_records,
+                    "journal_evicted": j_evicted,
+                    # sampled guard: record_ms holds every sample_every-th
+                    # record's cost, so the avg IS the per-record estimate
+                    "journal_record_us": (
+                        round(rec_sum / rec_count * 1e3, 3)
+                        if rec_count
+                        else 0.0
+                    ),
+                    "journal_replay_verified": j_verified,
+                    "journal_nodes": len(net.wrappers),
+                }
             fleet_stats = {}
             if observer is not None:
                 await observer.stop()
@@ -1058,6 +1098,7 @@ def run_bench_convergence(
             **exporter_stats,
             **stream_stats,
             **fleet_stats,
+            **journal_stats,
         }
 
     loop = asyncio.new_event_loop()

@@ -83,7 +83,23 @@ async def _build_line(net, n: int, store_dir: str) -> None:
         net.add_node(
             f"n{i}",
             loopback_prefix=f"10.{i}.0.0/24",
-            config_overrides=_node_overrides(),
+            # the state journal rides the restart differential: per-node
+            # durable logs next to the configstore files, so the respawn
+            # reloads its pre-crash history (replay parity is asserted
+            # against the never-restarted oracle below)
+            config_overrides=_node_overrides(
+                {
+                    "journal_config": {
+                        "enabled": True,
+                        "path": os.path.join(
+                            store_dir, f"n{i}.journal.bin"
+                        ),
+                        # flush every append: the restart gap must not
+                        # lose the tail to a pending batch timer
+                        "flush_interval_s": 0.0,
+                    }
+                }
+            ),
             config_store_path=os.path.join(store_dir, f"n{i}.bin"),
         )
     await net.start_all()
@@ -177,6 +193,7 @@ def run_restart_smoke() -> Dict[str, Any]:
                     await asyncio.sleep(0.01)
 
             watcher = asyncio.get_event_loop().create_task(watch())
+            pre_restart_seq = old_daemon.journal.stats()["last_seq"]
             t_restart = time.monotonic()
             respawn = await net.restart_node(mid_name)
             try:
@@ -243,6 +260,36 @@ def run_restart_smoke() -> Dict[str, Any]:
                 kv_counters
             )
 
+            # the journal survived the restart: the respawn reloaded the
+            # pre-crash durable log (sequence numbers continue past the
+            # crash point, with no torn-tail truncation) and kept
+            # recording through reconvergence
+            journal = respawn.daemon.journal
+            journal_stats = journal.stats()
+            assert pre_restart_seq > 0
+            assert journal_stats["last_seq"] > pre_restart_seq, (
+                f"journal did not survive the restart: respawn at seq "
+                f"{journal_stats['last_seq']} vs {pre_restart_seq} "
+                f"pre-crash"
+            )
+            assert (
+                journal_stats["counters"].get("journal.load_truncations", 0)
+                == 0
+            ), journal_stats["counters"]
+            # replay determinism across the restart: every node's
+            # reconstructed RIB re-derives through the CPU oracle
+            journal_verified = 0
+            for name, wrapper in net.wrappers.items():
+                verdict = wrapper.daemon.journal.verify_replay()
+                assert verdict["match"], (name, verdict["mismatches"])
+                journal_verified += 1
+            replayed_mid_rib = {
+                str(prefix): entry
+                for prefix, entry in (
+                    journal.replay_at().rib.unicast_entries.items()
+                )
+            }
+
             restarted_tables = _programmed_tables(net)
         finally:
             await net.stop_all()
@@ -256,8 +303,25 @@ def run_restart_smoke() -> Dict[str, Any]:
         try:
             await wait_until(_converged(oracle_net, n), timeout=30.0)
             oracle_tables = _programmed_tables(oracle_net)
+            # replay parity across the restart: the restarted node's
+            # journal, reloaded from disk through the crash, must replay
+            # to the SAME RIB the never-restarted oracle's journal
+            # replays to (RibUnicastEntry equality: prefix + nexthops +
+            # metrics, best_area excluded)
+            oracle_mid_rib = {
+                str(prefix): entry
+                for prefix, entry in (
+                    oracle_net.wrappers[mid_name]
+                    .daemon.journal.replay_at()
+                    .rib.unicast_entries.items()
+                )
+            }
         finally:
             await oracle_net.stop_all()
+        assert replayed_mid_rib == oracle_mid_rib, (
+            f"replay divergence across restart: "
+            f"{sorted(set(replayed_mid_rib) ^ set(oracle_mid_rib))}"
+        )
         if restarted_tables != oracle_tables:
             # report through the same forensics seam operators would read
             diverged = {
@@ -284,6 +348,11 @@ def run_restart_smoke() -> Dict[str, Any]:
                 "kvstore.restart_syncs", 0
             ),
             "oracle_parity": True,
+            "journal_survived_restart": True,
+            "journal_pre_restart_seq": pre_restart_seq,
+            "journal_last_seq": journal_stats["last_seq"],
+            "journal_verified_nodes": journal_verified,
+            "journal_replay_parity": True,
         }
 
     loop = asyncio.new_event_loop()

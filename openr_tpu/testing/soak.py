@@ -408,7 +408,14 @@ def _judge(
             )
         ),
     )
+    from openr_tpu.utils.build_info import (
+        ARTIFACT_SCHEMA_VERSION,
+        build_fingerprint,
+    )
+
     return {
+        "schema_version": ARTIFACT_SCHEMA_VERSION,
+        "build": build_fingerprint(),
         "windows": windows,
         "trend": trend,
         "attribution": {
@@ -1021,7 +1028,14 @@ def run_soak_round(
     )
     fanout_scale["backpressure_attributed"] = not unattributed
 
+    from openr_tpu.utils.build_info import (
+        ARTIFACT_SCHEMA_VERSION,
+        build_fingerprint,
+    )
+
     artifact = {
+        "schema_version": ARTIFACT_SCHEMA_VERSION,
+        "build": build_fingerprint(),
         "round": round_index,
         "kind": "SOAK",
         "config": asdict(cfg),

@@ -15,6 +15,37 @@ from typing import Dict
 VERSION = "1.0.0"  # single source of truth; breeze derives its banner from it
 PACKAGE = "openr-tpu"
 
+# SOAK_r*/BENCH_r* artifact field contract: bump when the shape of the
+# judged report / bench line changes, so offline renderers (`breeze perf
+# soak-report`, `breeze fleet report`) can warn instead of misreading
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+def build_fingerprint() -> str:
+    """`git describe --always --dirty` of the source tree, degrading to
+    the package VERSION outside a checkout — stamped next to
+    ARTIFACT_SCHEMA_VERSION in every soak/bench artifact so a report
+    line is always traceable to the exact code that produced it."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        probe = subprocess.run(
+            ["git", "describe", "--always", "--dirty"],
+            capture_output=True,
+            cwd=root,
+            timeout=10,
+        )
+        desc = probe.stdout.decode(errors="replace").strip()
+        if probe.returncode == 0 and desc:
+            return desc
+    except Exception:
+        pass
+    return VERSION
+
 
 def get_build_info() -> Dict[str, str]:
     info = {

@@ -117,6 +117,26 @@ def _decode(obj: Any) -> Any:
     return obj
 
 
+def register_type(cls: Type) -> Type:
+    """Make a wire-type dataclass decodable (journal payloads register
+    KvStore Value this way). Idempotent; returns the class so it can be
+    used as a decorator."""
+    _TYPE_REGISTRY.setdefault(cls.__name__, cls)
+    return cls
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Encode to the tagged plain-JSON form without stringifying — for
+    callers that embed wire objects inside larger JSON documents (the
+    state journal's record payloads)."""
+    return _encode(obj)
+
+
+def from_jsonable(obj: Any) -> Any:
+    """Inverse of to_jsonable."""
+    return _decode(obj)
+
+
 def dumps(obj: Any) -> bytes:
     return json.dumps(
         _encode(obj), sort_keys=True, separators=(",", ":")

@@ -1,0 +1,585 @@
+"""State journal tests (ISSUE 17, docs/Journal.md): codec round-trips,
+bounded-ring accounting with lossless eviction folds, durable-log crash
+consistency (the PR 14 truncate-at-every-byte fuzz, re-aimed at the
+journal's RecordLog), and deterministic replay + provenance on a live
+emulated network — replay(T) must element-equal the live RIB at T, and
+explain-route must resolve a complete provenance chain for every route
+in the final RIB."""
+
+import asyncio
+import os
+import time
+
+from openr_tpu.journal import (
+    JournalConfig,
+    LsdbFolder,
+    StateJournal,
+    codec,
+    resolve_ts,
+)
+from openr_tpu.solver.routes import (
+    DecisionRouteUpdate,
+    RibUnicastEntry,
+)
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    IpPrefix,
+    NextHop,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+from openr_tpu.utils import serializer
+
+
+def run(coro, timeout=60.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()
+
+
+def make_publication(
+    adj_dbs=(), prefix_dbs=(), expired=(), area="0", version=1
+):
+    pub = Publication(area=area)
+    for db in adj_dbs:
+        pub.key_vals[adj_key(db.this_node_name)] = Value(
+            version, db.this_node_name, serializer.dumps(db)
+        )
+    for db in prefix_dbs:
+        pub.key_vals[prefix_key(db.this_node_name)] = Value(
+            version, db.this_node_name, serializer.dumps(db)
+        )
+    pub.expired_keys.extend(expired)
+    return pub
+
+
+def make_rib_update(prefix="10.7.0.0/24", address="fe80::7", delete=()):
+    entry = RibUnicastEntry(
+        prefix=IpPrefix(prefix),
+        nexthops={
+            NextHop(address=address, iface="if7"),
+            NextHop(address="fe80::8", iface="if8"),
+        },
+        best_prefix_entry=PrefixEntry(prefix=IpPrefix(prefix)),
+        best_area="0",
+    )
+    return DecisionRouteUpdate(
+        unicast_routes_to_update=[entry],
+        unicast_routes_to_delete=[IpPrefix(p) for p in delete],
+    )
+
+
+class TestCodec:
+    def test_publication_roundtrip(self):
+        adj = AdjacencyDatabase(this_node_name="a", area="0")
+        pdb = PrefixDatabase(
+            "a", [PrefixEntry(prefix=IpPrefix("10.1.0.0/24"))]
+        )
+        pub = make_publication(
+            adj_dbs=[adj], prefix_dbs=[pdb], expired=["adj:gone"],
+            version=3,
+        )
+        decoded = codec.decode_publication(codec.encode_publication(pub))
+        assert decoded.area == pub.area
+        assert decoded.expired_keys == ["adj:gone"]
+        assert set(decoded.key_vals) == set(pub.key_vals)
+        for key, val in pub.key_vals.items():
+            got = decoded.key_vals[key]
+            assert got.version == val.version
+            assert got.originator_id == val.originator_id
+            assert got.value == val.value  # bytes survive the hex hop
+            assert serializer.loads(got.value) == serializer.loads(
+                val.value
+            )
+
+    def test_route_update_roundtrip(self):
+        update = make_rib_update(delete=["10.66.0.0/24"])
+        decoded = codec.decode_route_update(
+            codec.encode_route_update(update)
+        )
+        assert (
+            decoded.unicast_routes_to_update
+            == update.unicast_routes_to_update
+        )
+        assert decoded.unicast_routes_to_delete == [
+            IpPrefix("10.66.0.0/24")
+        ]
+        # nexthop sets re-assemble from the sorted wire lists
+        assert decoded.unicast_routes_to_update[0].nexthops == {
+            NextHop(address="fe80::7", iface="if7"),
+            NextHop(address="fe80::8", iface="if8"),
+        }
+
+    def test_host_local_fields_dropped(self):
+        pub = make_publication(
+            prefix_dbs=[
+                PrefixDatabase(
+                    "a", [PrefixEntry(prefix=IpPrefix("10.1.0.0/24"))]
+                )
+            ]
+        )
+        pub.ts_monotonic = 123.4
+        payload = codec.encode_publication(pub)
+        assert "ts_monotonic" not in payload
+        assert "span_stages" not in payload
+
+    def test_resolve_ts(self):
+        assert resolve_ts(None) is None
+        assert resolve_ts(1234.5) == 1234.5
+        # negative = relative to now
+        assert abs(resolve_ts(-10.0) - (time.time() - 10.0)) < 1.0
+
+
+class TestRingAccounting:
+    def _feed(self, journal, n=10):
+        for i in range(1, n + 1):
+            adj = AdjacencyDatabase(this_node_name="b", area="0")
+            journal.record_publication(
+                make_publication(adj_dbs=[adj], version=i)
+            )
+        journal.record_publication(
+            make_publication(
+                prefix_dbs=[
+                    PrefixDatabase(
+                        "b", [PrefixEntry(prefix=IpPrefix("10.2.0.0/24"))]
+                    )
+                ],
+                version=1,
+            )
+        )
+        journal.record_route_update(make_rib_update())
+
+    def test_records_equals_retained_plus_evicted(self):
+        journal = StateJournal(
+            "me", JournalConfig(enabled=True, ring_size=3)
+        )
+        self._feed(journal)
+        stats = journal.stats()
+        counters = stats["counters"]
+        assert counters["journal.evicted"] > 0
+        assert (
+            counters["journal.records"]
+            == stats["retained"] + counters["journal.evicted"]
+        )
+        assert stats["retained"] <= 3
+
+    def test_eviction_fold_is_lossless_for_replay(self):
+        """CRDT fold: a tiny ring that evicted most of its history must
+        replay to the SAME LSDB and RIB as an unbounded ring fed the
+        identical record sequence."""
+        big = StateJournal(
+            "me", JournalConfig(enabled=True, ring_size=4096)
+        )
+        small = StateJournal(
+            "me", JournalConfig(enabled=True, ring_size=2)
+        )
+        for journal in (big, small):
+            self._feed(journal)
+        r_big, r_small = big.replay_at(), small.replay_at()
+        assert r_big.rib.unicast_entries == r_small.rib.unicast_entries
+        for area, ls in r_big.folder.area_link_states.items():
+            other = r_small.folder.area_link_states[area]
+            assert (
+                ls.get_adjacency_databases()
+                == other.get_adjacency_databases()
+            )
+        assert r_big.fold_errors == 0 and r_small.fold_errors == 0
+
+    def test_empty_route_updates_not_recorded(self):
+        journal = StateJournal("me", JournalConfig(enabled=True))
+        journal.record_route_update(DecisionRouteUpdate())
+        assert (
+            journal.stats()["counters"].get("journal.records", 0) == 0
+        )
+
+    def test_key_history_bounded_and_ordered(self):
+        journal = StateJournal(
+            "me", JournalConfig(enabled=True, key_history=4)
+        )
+        for i in range(1, 9):
+            adj = AdjacencyDatabase(this_node_name="b", area="0")
+            journal.record_publication(
+                make_publication(adj_dbs=[adj], version=i)
+            )
+        journal.record_publication(
+            make_publication(expired=[adj_key("b")])
+        )
+        hist = journal.key_history(adj_key("b"))
+        assert len(hist) == 4  # bounded
+        assert [e["seq"] for e in hist] == sorted(
+            e["seq"] for e in hist
+        )
+        assert hist[-1]["deleted"] is True
+        assert hist[-2]["version"] == 8
+        # area filter
+        assert journal.key_history(adj_key("b"), area="other") == []
+
+    def test_ttl_refresh_skipped_by_fold(self):
+        folder = LsdbFolder("me")
+        pub = Publication(area="0")
+        pub.key_vals[adj_key("b")] = Value(2, "b", None)  # ttl refresh
+        folder.apply_publication(pub, 1, time.time())
+        assert folder.errors == 0
+        assert (
+            folder.area_link_states["0"].get_adjacency_databases() == {}
+        )
+
+
+class TestDurability:
+    def _journaled(self, path, n=6):
+        """A journal whose file holds one snapshot + n-1 separate
+        appends (no event loop: every record flushes synchronously)."""
+        journal = StateJournal(
+            "me",
+            JournalConfig(enabled=True, path=path, ring_size=64),
+        )
+        for i in range(1, n + 1):
+            adj = AdjacencyDatabase(this_node_name="b", area="0")
+            journal.record_publication(
+                make_publication(adj_dbs=[adj], version=i)
+            )
+        assert journal.stats()["counters"]["journal.appends"] >= 1
+        return journal
+
+    def test_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "journal.bin")
+        journal = self._journaled(path)
+        before = journal.replay_at()
+
+        reopened = StateJournal(
+            "me",
+            JournalConfig(enabled=True, path=path, ring_size=64),
+        )
+        stats = reopened.stats()
+        assert stats["last_seq"] == 6
+        assert stats["counters"].get("journal.load_truncations", 0) == 0
+        after = reopened.replay_at()
+        assert (
+            before.folder.area_link_states["0"].get_adjacency_databases()
+            == after.folder.area_link_states[
+                "0"
+            ].get_adjacency_databases()
+        )
+        # key history rebuilt from disk
+        hist = reopened.key_history(adj_key("b"))
+        assert hist and hist[-1]["version"] == 6
+
+    def test_compaction_when_tail_outgrows(self, tmp_path):
+        path = str(tmp_path / "journal.bin")
+        journal = StateJournal(
+            "me",
+            JournalConfig(
+                enabled=True,
+                path=path,
+                ring_size=4,
+                min_compact_bytes=256,
+            ),
+        )
+        for i in range(1, 40):
+            adj = AdjacencyDatabase(this_node_name="b", area="0")
+            journal.record_publication(
+                make_publication(adj_dbs=[adj], version=i)
+            )
+        counters = journal.stats()["counters"]
+        assert counters["journal.snapshots"] >= 2  # compacted at least once
+        # the compacted file reopens to the same tip
+        reopened = StateJournal(
+            "me", JournalConfig(enabled=True, path=path, ring_size=4)
+        )
+        assert reopened.stats()["last_seq"] == 39
+        assert reopened.replay_at().fold_errors == 0
+
+    def test_truncate_at_every_byte_recovers_prefix(self, tmp_path):
+        """Fuzz: truncate the durable log at EVERY byte offset. Load must
+        never crash and must always recover a prefix of the recorded
+        sequence — the last durable state, never garbage."""
+        path = str(tmp_path / "journal.bin")
+        self._journaled(path, n=6)
+        raw = open(path, "rb").read()
+        cfg = dict(enabled=True, path=path, ring_size=64)
+        for cut in range(len(raw)):
+            with open(path, "wb") as fh:
+                fh.write(raw[:cut])
+            reopened = StateJournal("me", JournalConfig(**cfg))
+            stats = reopened.stats()
+            assert 0 <= stats["last_seq"] <= 6, (cut, stats)
+            if stats["last_seq"]:
+                # the recovered history is a PREFIX: the newest surviving
+                # version equals the newest surviving seq (pub i carried
+                # version i), and replay folds it cleanly
+                hist = reopened.key_history(adj_key("b"))
+                assert hist[-1]["version"] == stats["last_seq"], cut
+                assert reopened.replay_at().fold_errors == 0
+
+        # a truncated load marks the file suspect: the next flush
+        # compacts (never appends after garbage) and a fresh reopen
+        # reads cleanly
+        with open(path, "wb") as fh:
+            fh.write(raw[: len(raw) - 3])
+        survivor = StateJournal("me", JournalConfig(**cfg))
+        counters = survivor.stats()["counters"]
+        assert counters["journal.load_truncations"] == 1
+        adj = AdjacencyDatabase(this_node_name="b", area="0")
+        survivor.record_publication(
+            make_publication(adj_dbs=[adj], version=99)
+        )
+        survivor.flush()
+        assert survivor.stats()["counters"]["journal.snapshots"] >= 1
+        final = StateJournal("me", JournalConfig(**cfg))
+        assert (
+            final.stats()["counters"].get("journal.load_truncations", 0)
+            == 0
+        )
+        hist = final.key_history(adj_key("b"))
+        assert hist[-1]["version"] == 99
+
+    def test_write_failure_keeps_pending_and_retries(self, tmp_path):
+        journal = self._journaled(str(tmp_path / "journal.bin"))
+        # break the log under the journal: the flush must bump
+        # journal.write_failures and keep the batch pending, not raise
+        class _Broken:
+            def exists(self):
+                return True
+
+            def append(self, blob):
+                raise OSError("disk full")
+
+            def rewrite(self, blob):
+                raise OSError("disk full")
+
+        journal._log = _Broken()
+        adj = AdjacencyDatabase(this_node_name="b", area="0")
+        journal.record_publication(
+            make_publication(adj_dbs=[adj], version=7)
+        )
+        journal.flush()
+        counters = journal.stats()["counters"]
+        assert counters["journal.write_failures"] >= 1
+        assert journal._pending  # batch survives for the retry
+
+
+class TestLiveReplay:
+    """Replay determinism + provenance on a live emulated network with a
+    randomized-enough flap wave (fail + restore the middle link): the
+    ISSUE 17 acceptance criteria."""
+
+    def _network(self, n=4):
+        from openr_tpu.testing.wrapper import VirtualNetwork
+
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(
+                f"n{i}",
+                loopback_prefix=f"10.{i}.0.0/24",
+                config_overrides={"journal_config": {"enabled": True}},
+            )
+        return net
+
+    def test_replay_matches_live_rib_after_flaps(self):
+        from openr_tpu.testing.wrapper import wait_until
+
+        n = 4
+        mid = n // 2
+
+        async def body():
+            net = self._network(n)
+            await net.start_all()
+            for i in range(n - 1):
+                net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+            def converged():
+                for i in range(n):
+                    got = set(
+                        net.wrappers[f"n{i}"].programmed_prefixes()
+                    )
+                    want = {
+                        f"10.{j}.0.0/24" for j in range(n) if j != i
+                    }
+                    if not want.issubset(got):
+                        return False
+                return True
+
+            try:
+                await wait_until(converged, timeout=30.0)
+                t_before_flap = time.time()
+                await asyncio.sleep(0.05)
+                # flap wave: partition and heal the middle link
+                net.fail_link(
+                    f"n{mid - 1}", f"if{mid - 1}r", f"n{mid}", f"if{mid}l"
+                )
+                await wait_until(
+                    lambda: f"10.{n - 1}.0.0/24"
+                    not in net.wrappers["n0"].programmed_prefixes(),
+                    timeout=30.0,
+                )
+                t_partition = time.time()
+                await asyncio.sleep(0.05)
+                net.restore_link(
+                    f"n{mid - 1}", f"if{mid - 1}r", f"n{mid}", f"if{mid}l"
+                )
+                await wait_until(converged, timeout=30.0)
+                await asyncio.sleep(0.5)  # quiesce
+
+                for i in range(n):
+                    name = f"n{i}"
+                    daemon = net.wrappers[name].daemon
+                    journal = daemon.journal
+
+                    # replay(T=now) element-equals the live RIB
+                    live = daemon.decision.get_decision_route_db()
+                    replayed = journal.replay_at().rib
+                    assert (
+                        replayed.unicast_entries == live.unicast_entries
+                    ), name
+
+                    # the CPU-oracle audit agrees at quiescence
+                    verdict = journal.verify_replay()
+                    assert verdict["match"], (name, verdict["mismatches"])
+
+                    # explain-route resolves a COMPLETE provenance chain
+                    # for every route in the final RIB
+                    for prefix in live.unicast_entries:
+                        explained = journal.explain_route(str(prefix))
+                        assert explained["found"], (name, str(prefix))
+                        assert explained["complete"], (
+                            name,
+                            str(prefix),
+                            explained,
+                        )
+                        assert explained["prefix_keys"], explained
+
+                # time travel: during the partition n0 had no route to
+                # the far end; the rib-diff across heal shows it return
+                j0 = net.wrappers["n0"].daemon.journal
+                partitioned = j0.replay_at(t_partition).rib
+                far = IpPrefix(f"10.{n - 1}.0.0/24")
+                assert far not in partitioned.unicast_entries
+                diff = j0.rib_diff(t_partition, None)
+                assert diff["changed"] is True
+                restored = {
+                    e["prefix"]
+                    for e in diff["delta"]["unicast_update"]
+                }
+                assert str(far) in restored
+                # ... and across the whole wave the RIB returned to its
+                # pre-flap state
+                steady = j0.rib_diff(t_before_flap, None)
+                assert steady["changed"] is False
+            finally:
+                await net.stop_all()
+
+        run(body())
+
+    def test_journal_disabled_by_default(self):
+        from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+        async def body():
+            net = VirtualNetwork()
+            net.add_node("n0", loopback_prefix="10.0.0.0/24")
+            net.add_node("n1", loopback_prefix="10.1.0.0/24")
+            await net.start_all()
+            net.connect("n0", "if0r", "n1", "if1l")
+            try:
+                await wait_until(
+                    lambda: "10.1.0.0/24"
+                    in net.wrappers["n0"].programmed_prefixes(),
+                    timeout=30.0,
+                )
+                journal = net.wrappers["n0"].daemon.journal
+                assert journal.stats()["enabled"] is False
+                assert (
+                    journal.stats()["counters"].get("journal.records", 0)
+                    == 0
+                )
+            finally:
+                await net.stop_all()
+
+        run(body())
+
+    def test_ctrl_rpcs_roundtrip(self):
+        from openr_tpu.ctrl import CtrlClient
+        from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+        async def body():
+            net = self._network(3)
+            await net.start_all()
+            for i in range(2):
+                net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+            try:
+                await wait_until(
+                    lambda: {"10.1.0.0/24", "10.2.0.0/24"}
+                    <= set(net.wrappers["n0"].programmed_prefixes()),
+                    timeout=30.0,
+                )
+                await asyncio.sleep(0.3)
+                client = await CtrlClient(
+                    "127.0.0.1", net.wrappers["n0"].ctrl_port
+                ).connect()
+                try:
+                    stats = await client.call("getJournalStats")
+                    assert stats["enabled"] is True
+                    assert stats["counters"]["journal.records"] > 0
+
+                    tail = await client.call("getJournalTail", last_n=4)
+                    assert tail["enabled"] and len(tail["records"]) <= 4
+
+                    hist = await client.call(
+                        "getKvStoreKeyHistory", key=adj_key("n1")
+                    )
+                    assert hist["history"], hist
+
+                    explained = await client.call(
+                        "explainRoute", prefix="10.2.0.0/24"
+                    )
+                    assert explained["found"] and explained["complete"]
+                    assert explained["prefix_keys"]
+                    assert explained["adjacency_keys"]
+
+                    verdict = await client.call("verifyJournalReplay")
+                    assert verdict["match"] is True
+
+                    diff = await client.call(
+                        "getRibDiff", from_ts=time.time() - 120, to_ts=None
+                    )
+                    assert diff["changed"] is True  # from empty pre-boot
+                finally:
+                    await client.close()
+            finally:
+                await net.stop_all()
+
+        run(body())
+
+    def test_rpcs_report_disabled_without_journal(self):
+        from openr_tpu.ctrl import CtrlClient
+        from openr_tpu.testing.wrapper import VirtualNetwork
+
+        async def body():
+            net = VirtualNetwork()
+            net.add_node("n0", loopback_prefix="10.0.0.0/24")
+            await net.start_all()
+            try:
+                client = await CtrlClient(
+                    "127.0.0.1", net.wrappers["n0"].ctrl_port
+                ).connect()
+                try:
+                    stats = await client.call("getJournalStats")
+                    assert stats["enabled"] is False
+                    explained = await client.call(
+                        "explainRoute", prefix="10.1.0.0/24"
+                    )
+                    assert explained["enabled"] is False
+                finally:
+                    await client.close()
+            finally:
+                await net.stop_all()
+
+        run(body())

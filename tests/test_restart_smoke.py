@@ -38,6 +38,14 @@ class TestRestartSmoke:
         assert report["kvstore_restart_syncs"] >= 1
         assert report["restart_e2e_ms"]["count"] == 1
         assert report["restart_e2e_ms"]["max"] > 0
+        # ISSUE 17: the state journal's durable log survives the daemon
+        # gap (sequence continues past the crash point) and the replayed
+        # RIB matches both the CPU oracle on every node and the
+        # never-restarted oracle network's replay
+        assert report["journal_survived_restart"] is True
+        assert report["journal_last_seq"] > report["journal_pre_restart_seq"]
+        assert report["journal_verified_nodes"] == report["nodes"]
+        assert report["journal_replay_parity"] is True
 
     def test_stale_deadline_force_flush(self):
         report = run_stale_deadline_drill()
