@@ -6,7 +6,7 @@ talking to the ctrl server (kvstore / decision / fib / lm / prefixmgr /
 monitor / openr). argparse instead of click (no extra deps in this image);
 same command vocabulary:
 
-  breeze kvstore keys|keyvals|peers|areas|history KEY [--area A]
+  breeze kvstore keys|keyvals|peers|peer-health|areas|history KEY [--area A]
   breeze decision adj|prefixes|routes|rib-policy|solver-health|
                   solve-traces [--json]|profile [--seconds N] [--out DIR]|
                   profile-status|
@@ -111,6 +111,33 @@ def cmd_kvstore(client: BlockingCtrlClient, args) -> None:
         _print_table(
             ["Peer", "Address"],
             [[name, spec["peer_addr"]] for name, spec in sorted(peers.items())],
+        )
+    elif args.cmd == "peer-health":
+        health = client.call("getKvStorePeerHealth", area=args.area)
+        _print_table(
+            [
+                "Peer",
+                "State",
+                "Health",
+                "Failures",
+                "Probes",
+                "Streak",
+                "FloodsSkipped",
+                "Quarantined(ms)",
+            ],
+            [
+                [
+                    name,
+                    h["state"],
+                    h["health"],
+                    h["failures"],
+                    h["probes"],
+                    h["probe_streak"],
+                    h["floods_skipped"],
+                    h["quarantined_ms"],
+                ]
+                for name, h in sorted(health.items())
+            ],
         )
     elif args.cmd == "areas":
         _print_json(client.call("getAreasConfig"))
@@ -1160,6 +1187,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("keys", nargs="+")
     p.add_argument("--area", default="0")
     p = kv.add_parser("peers")
+    p.add_argument("--area", default="0")
+    p = kv.add_parser("peer-health")
     p.add_argument("--area", default="0")
     kv.add_parser("areas")
     p = kv.add_parser("snoop")

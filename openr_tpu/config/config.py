@@ -41,6 +41,25 @@ class KvstoreConfig:
     # keep the key->Value table + CRDT merge in the native C++ engine
     # (native/kvstore) when the library is available
     enable_native_store: bool = True
+    # flood-storm damping: per-(key, originator) exponential penalty with a
+    # hold-down (docs/Robustness.md "Hostile-network hardening")
+    damping_enabled: bool = True
+    damping_half_life_s: float = 8.0
+    damping_max_hold_s: float = 30.0
+    damping_suppress_limit: float = 8000.0
+    damping_reuse_limit: float = 2000.0
+    # peer-health quarantine ladder (healthy → suspect → quarantined →
+    # probing) with probe-driven recovery hysteresis
+    quarantine_enabled: bool = True
+    peer_suspect_failures: int = 3
+    peer_quarantine_failures: int = 6
+    peer_probe_min_backoff_s: float = 0.1
+    peer_probe_max_backoff_s: float = 2.0
+    peer_probe_successes: int = 2
+    # adaptive anti-entropy: `sync_interval_s` rounds arm only when flood
+    # health (duplicate ratio / failures / wire rejects) is off budget
+    anti_entropy_enabled: bool = True
+    flood_duplicate_budget: float = 0.5
 
 
 @dataclass

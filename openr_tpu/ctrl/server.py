@@ -735,6 +735,13 @@ class CtrlServer:
             for name, spec in self.kvstore.db(area).get_peers().items()
         }
 
+    def m_getKvStorePeerHealth(self, params) -> Dict[str, Any]:
+        """Peer-health quarantine ladder snapshot (docs/Runbook.md:
+        `breeze kvstore peer-health`)."""
+        assert self.kvstore is not None
+        area = params.get("area", "0")
+        return self.kvstore.db(area).get_peer_health()
+
     def m_getAreasConfig(self, params) -> Dict[str, Any]:
         assert self.kvstore is not None
         return {"areas": sorted(self.kvstore.dbs.keys())}

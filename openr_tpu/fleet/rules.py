@@ -24,6 +24,8 @@ breach time. Each rule here evaluates one standing check against the
   | admission_rejections | typed server-busy rejections + timeouts
   | restart_health    | warm-boot reconciliation: stale-deadline
   |                   | flushes, stuck stale routes, GR hold expiries
+  | flood_health      | dissemination plane: quarantine trips, typed
+  |                   | wire rejects, flood duplicate ratio
 
 Interval values are computed by the collector (epoch-aware counter
 deltas + cumulative-histogram diffs, `monitor/exporter.py`
@@ -73,6 +75,10 @@ RATE_COUNTERS = (
     "fib.stale_deadline_flushes",
     "fib.thrift.failure.add_del_route",
     "spark.gr_hold_expiries",
+    "kvstore.flood.received",
+    "kvstore.flood.duplicates",
+    "kvstore.quarantine.trips",
+    "kvstore.wire.rejected_total",
 )
 
 # gauges sampled verbatim
@@ -102,6 +108,15 @@ class SloConfig:
     admission_reject_budget: float = 0.0
     # restart_health: ticks a node may hold stale routes before breach
     stale_route_ticks: int = 8
+    # flood_health: dissemination-plane hostility budgets. The duplicate
+    # ratio (duplicates/received per interval) breaches above this; <0
+    # disables the ratio check entirely
+    flood_duplicate_budget: float = -1.0
+    # minimum interval flood receives before the ratio is judged
+    flood_min_received: int = 8
+    # quarantine trips + typed wire rejects per interval; any excess
+    # breaches (these should be zero on a healthy fabric)
+    flood_quarantine_budget: float = 0.0
     # per-stage attribution: a stage is named when its interval avg is
     # at least this multiple of the fleet-wide cumulative stage avg
     attribution_min_ratio: float = 2.0
@@ -386,6 +401,62 @@ def eval_restart_health(
         )
 
 
+def eval_flood_health(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    """Dissemination-plane health: quarantine trips, typed wire rejects
+    and the flood duplicate ratio — the live counterpart of the chaos
+    smoke's hostile-network evidence (docs/Robustness.md)."""
+    for node in store.nodes():
+        trips = (
+            store.last(node, RATE_PREFIX + "kvstore.quarantine.trips") or 0
+        )
+        rejects = (
+            store.last(node, RATE_PREFIX + "kvstore.wire.rejected_total")
+            or 0
+        )
+        received = (
+            store.last(node, RATE_PREFIX + "kvstore.flood.received") or 0
+        )
+        duplicates = (
+            store.last(node, RATE_PREFIX + "kvstore.flood.duplicates") or 0
+        )
+        ratio = duplicates / received if received > 0 else 0.0
+        ratio_breach = (
+            cfg.flood_duplicate_budget >= 0
+            and received >= cfg.flood_min_received
+            and ratio > cfg.flood_duplicate_budget
+        )
+        hard_breach = (trips + rejects) > cfg.flood_quarantine_budget
+        if not ratio_breach and not hard_breach:
+            continue
+        reasons = []
+        if trips:
+            reasons.append(f"{int(trips)} quarantine trip(s)")
+        if rejects:
+            reasons.append(f"{int(rejects)} typed wire reject(s)")
+        if ratio_breach:
+            reasons.append(
+                f"duplicate ratio {ratio:.2f} over "
+                f"{int(received)} receive(s)"
+            )
+        yield Finding(
+            kind="flood_health",
+            node=node,
+            detail=f"dissemination plane unhealthy on {node}: "
+            + ", ".join(reasons),
+            value=float(trips + rejects) or ratio,
+            budget=cfg.flood_quarantine_budget,
+            evidence={
+                "quarantine_trips": trips,
+                "wire_rejects": rejects,
+                "flood_received": received,
+                "flood_duplicates": duplicates,
+                "duplicate_ratio": round(ratio, 4),
+            },
+        )
+
+
 RULES = (
     ("convergence_p95", eval_convergence_p95),
     ("convergence_trend", eval_convergence_trend),
@@ -393,6 +464,7 @@ RULES = (
     ("stream_backpressure", eval_stream_backpressure),
     ("admission_rejections", eval_admission_rejections),
     ("restart_health", eval_restart_health),
+    ("flood_health", eval_flood_health),
 )
 
 

@@ -13,6 +13,7 @@ from openr_tpu.kvstore.store import (
     KvStoreDb,
     KvStoreFilters,
     KvStoreParams,
+    PeerHealth,
     PeerSpec,
     PeerState,
     compare_values,
@@ -20,6 +21,7 @@ from openr_tpu.kvstore.store import (
 )
 from openr_tpu.kvstore.transport import InProcessTransport, KvStoreTransport
 from openr_tpu.kvstore.tcp import KvStoreTcpServer, TcpTransport
+from openr_tpu.kvstore.wire import WireDecodeError
 from openr_tpu.kvstore.client import KvStoreClient
 
 __all__ = [
@@ -28,6 +30,7 @@ __all__ = [
     "KvStoreDb",
     "KvStoreFilters",
     "KvStoreParams",
+    "PeerHealth",
     "PeerSpec",
     "PeerState",
     "compare_values",
@@ -36,4 +39,5 @@ __all__ = [
     "KvStoreTransport",
     "KvStoreTcpServer",
     "TcpTransport",
+    "WireDecodeError",
 ]

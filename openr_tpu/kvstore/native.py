@@ -116,37 +116,86 @@ def _pack_records(key_vals: KeyVals) -> bytes:
     return struct.pack("<I", len(key_vals)) + body
 
 
+# hard ceilings on decoded record fields: a truncated or bit-flipped
+# buffer must fail typed, not blind-slice garbage into the table
+_MAX_KEY_BYTES = 8192
+_MAX_VALUE_BYTES = 16 * 1024 * 1024
+_MAX_RECORD_COUNT = 4 * 1024 * 1024
+
+
+class NativeDecodeError(ValueError):
+    """Typed rejection of a corrupt native record buffer.
+
+    kind ∈ {"oversized", "truncated", "malformed"} — same counter mapping
+    as wire.WireDecodeError (kvstore.wire.rejected.{kind})."""
+
+    def __init__(self, kind: str, detail: str = "") -> None:
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
+
+
+def _take(buf: bytes, off: int, n: int) -> int:
+    """Bounds-check a read of n bytes at off; return the new offset."""
+    if n < 0 or off + n > len(buf):
+        raise NativeDecodeError(
+            "truncated", f"need {n}B at offset {off}, have {len(buf)}B total"
+        )
+    return off + n
+
+
 def _unpack_records(buf: bytes) -> KeyVals:
+    end = _take(buf, 0, 4)
     (count,) = struct.unpack_from("<I", buf, 0)
-    off = 4
+    if count > _MAX_RECORD_COUNT:
+        raise NativeDecodeError("oversized", f"{count} records")
+    off = end
     out: KeyVals = {}
     for _ in range(count):
+        end = _take(buf, off, 4)
         (klen,) = struct.unpack_from("<I", buf, off)
-        off += 4
-        key = buf[off:off + klen].decode()
-        off += klen
+        if klen > _MAX_KEY_BYTES:
+            raise NativeDecodeError("oversized", f"key {klen}B")
+        off = _take(buf, end, klen)
+        try:
+            key = buf[end:off].decode()
+        except UnicodeDecodeError as exc:
+            raise NativeDecodeError("malformed", "key not utf-8") from exc
+        end = _take(buf, off, 8)
         (version,) = struct.unpack_from("<q", buf, off)
-        off += 8
-        (olen,) = struct.unpack_from("<I", buf, off)
-        off += 4
-        orig = buf[off:off + olen].decode()
-        off += olen
-        has_value = buf[off]
-        off += 1
+        off = _take(buf, end, 4)
+        (olen,) = struct.unpack_from("<I", buf, end)
+        if olen > _MAX_KEY_BYTES:
+            raise NativeDecodeError("oversized", f"originator {olen}B")
+        end = _take(buf, off, olen)
+        try:
+            orig = buf[off:end].decode()
+        except UnicodeDecodeError as exc:
+            raise NativeDecodeError(
+                "malformed", "originator not utf-8"
+            ) from exc
+        off = _take(buf, end, 1)
+        has_value = buf[end]
+        if has_value not in (0, 1):
+            raise NativeDecodeError("malformed", "bad value-present flag")
         value = None
         if has_value:
+            end = _take(buf, off, 4)
             (vlen,) = struct.unpack_from("<I", buf, off)
-            off += 4
-            value = bytes(buf[off:off + vlen])
-            off += vlen
+            if vlen > _MAX_VALUE_BYTES:
+                raise NativeDecodeError("oversized", f"value {vlen}B")
+            off = _take(buf, end, vlen)
+            value = bytes(buf[end:off])
+        end = _take(buf, off, 16)
         ttl, ttl_version = struct.unpack_from("<qq", buf, off)
-        off += 16
-        has_hash = buf[off]
-        off += 1
+        off = _take(buf, end, 1)
+        has_hash = buf[end]
+        if has_hash not in (0, 1):
+            raise NativeDecodeError("malformed", "bad hash-present flag")
         hash_ = None
         if has_hash:
+            end = _take(buf, off, 8)
             (hash_,) = struct.unpack_from("<q", buf, off)
-            off += 8
+            off = end
         out[key] = Value(version, orig, value, ttl, ttl_version, hash_)
     return out
 

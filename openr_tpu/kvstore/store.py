@@ -34,7 +34,9 @@ from __future__ import annotations
 import asyncio
 import enum
 import heapq
+import random
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -61,6 +63,11 @@ FLOOD_RECEIVED_EVENT = "KVSTORE_FLOOD_RECEIVED"
 # one LogSample per received flooded publication (docs/Monitoring.md
 # event catalog): hop count, per-hop + origin-to-here latency, duplicate flag
 FLOOD_TRACE_EVENT = "FLOOD_TRACE"
+# peer-health quarantine ladder events (docs/Monitoring.md event catalog):
+# one sample when a peer trips into quarantine (with the forensics dump id)
+# and one when the probe path recovers it
+PEER_QUARANTINED_EVENT = "KVSTORE_PEER_QUARANTINED"
+PEER_RECOVERED_EVENT = "KVSTORE_PEER_RECOVERED"
 # hop-trace length bound: the origin stamp plus the most recent hops. On
 # large-diameter topologies (a 256-node emulated ring) an unbounded trace
 # is O(diameter) per-copy per-forward — O(diameter²) allocations per
@@ -137,11 +144,14 @@ def merge_key_values(
                     new_value.version, new_value.originator_id, new_value.value
                 )
             store[key] = new_value
+            # flood the hash-filled copy (the reference fills the hash at
+            # the originator before storing/flooding) so every forwarded
+            # frame is integrity-checkable end to end
+            updates[key] = new_value
         elif update_ttl:
             existing.ttl = value.ttl
             existing.ttl_version = value.ttl_version
-
-        updates[key] = value
+            updates[key] = value
     return updates
 
 
@@ -228,6 +238,19 @@ _PEER_FSM: Dict[Tuple[PeerState, PeerEvent], PeerState] = {
 }
 
 
+class PeerHealth(enum.Enum):
+    """Per-peer scoring ladder (mirror of the solver breaker FSM):
+    consecutive transport failures walk HEALTHY → SUSPECT → QUARANTINED;
+    a quarantined peer receives no floods, only probe-driven full syncs
+    (QUARANTINED ⇄ PROBING), and recovers with hysteresis after
+    `peer_probe_successes` consecutive probe successes."""
+
+    HEALTHY = "HEALTHY"
+    SUSPECT = "SUSPECT"
+    QUARANTINED = "QUARANTINED"
+    PROBING = "PROBING"
+
+
 @dataclass(frozen=True)
 class PeerSpec:
     """Addressing info for one peer (thrift::PeerSpec equivalent)."""
@@ -241,6 +264,28 @@ class _Peer:
     spec: PeerSpec
     backoff: ExponentialBackoff
     state: PeerState = PeerState.IDLE
+    health: PeerHealth = PeerHealth.HEALTHY
+    failures: int = 0  # consecutive transport failures
+    probes: int = 0
+    probe_streak: int = 0  # consecutive probe successes (hysteresis)
+    floods_skipped: int = 0
+    quarantined_at: float = 0.0
+    probe_backoff: Optional[ExponentialBackoff] = None
+
+
+@dataclass
+class _DampingEntry:
+    """Flood-storm damping state for one (key, originator): an exponential
+    penalty (decayed with `damping_half_life_s`) accrued on every
+    value-bearing accepted update; crossing `damping_suppress_limit` puts
+    the key behind a hold-down until the penalty decays below
+    `damping_reuse_limit` (or `damping_max_hold_s` elapses), at which point
+    the CURRENT store value is flooded — latest always wins on release."""
+
+    penalty: float = 0.0
+    last_decay: float = 0.0  # monotonic ts of the last decay application
+    held: bool = False
+    held_since: float = 0.0
 
 
 @dataclass
@@ -296,6 +341,34 @@ class KvStoreParams:
     # (native/kvstore); falls back to the Python dict if the library is
     # unavailable
     use_native_store: bool = False
+    # deterministic seed for jittered backoffs / anti-entropy peer choice;
+    # None derives a per-node seed from the node id (still deterministic)
+    jitter_seed: Optional[int] = None
+    # flood-storm damping (per-(key, originator) exponential penalty)
+    damping_enabled: bool = True
+    damping_penalty: float = 1000.0  # accrued per value-bearing update
+    damping_suppress_limit: float = 8000.0  # hold-down trip threshold
+    damping_reuse_limit: float = 2000.0  # release threshold after decay
+    damping_half_life_s: float = 8.0
+    damping_max_hold_s: float = 30.0  # hard cap on any hold-down
+    damping_sweep_s: float = 0.5  # decay/release sweep cadence
+    # adjacency withdrawals must propagate immediately; TTL expiry is
+    # structurally exempt (expired_keys never pass through damping)
+    damping_exempt_prefixes: Tuple[str, ...] = ("adj:",)
+    # peer-health quarantine ladder
+    quarantine_enabled: bool = True
+    peer_suspect_failures: int = 3  # consecutive failures → SUSPECT
+    peer_quarantine_failures: int = 6  # consecutive failures → QUARANTINED
+    peer_probe_min_backoff: float = 0.1
+    peer_probe_max_backoff: float = 2.0
+    peer_probe_successes: int = 2  # hysteresis before recovery
+    # adaptive anti-entropy: periodic rounds arm only when flood health is
+    # off budget (duplicate ratio, sync/flood failures, wire rejects)
+    anti_entropy_enabled: bool = True
+    anti_entropy_interval_s: float = 60.0
+    flood_duplicate_budget: float = 0.5  # duplicates/received per interval
+    # directory for quarantine forensics artifacts (None = in-memory only)
+    forensics_dir: Optional[str] = None
 
 
 @owned_by("kvstore-loop")
@@ -357,6 +430,26 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
         self._retry_pending: Set[str] = set()
         self._sync_tasks: Set[asyncio.Task] = set()
         self.counters: Dict[str, int] = {}
+        # deterministic per-node rng: decorrelated-jitter backoffs and
+        # anti-entropy peer choice replay identically under a fixed seed
+        seed = (
+            params.jitter_seed
+            if params.jitter_seed is not None
+            else zlib.crc32(f"{params.node_id}/{area}".encode())
+        )
+        self._rng = random.Random(seed)
+        # monotonic expiry deadline per finite-ttl key: the authoritative
+        # remaining-lifetime record (stored Value.ttl is the ORIGINAL ttl)
+        self._ttl_expiry: Dict[str, float] = {}
+        # flood-storm damping state + lazy decay/release sweep timer
+        self._damping: Dict[Tuple[str, str], _DampingEntry] = {}
+        self._damping_timer: Optional[asyncio.TimerHandle] = None
+        # adaptive anti-entropy: lazy timer + counter snapshot from the
+        # previous tick (flood-health deltas are per-interval)
+        self._ae_timer: Optional[asyncio.TimerHandle] = None
+        self._ae_last: Dict[str, int] = {}
+        # quarantine forensics recorder (lazy, PR 13 flight-recorder flow)
+        self._forensics = None
         # DUAL flood-topology optimization (KvStore.h:193 inherits DualNode;
         # composed here): SPT per flood-root, flood only to SPT peers
         self.dual: Optional["_KvDualNode"] = None
@@ -449,13 +542,15 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
         self._update_ttl_countdown(updates)
         if updates:
             self._bump("kvstore.updated_key_vals", len(updates))
-            self.flood_publication(
-                Publication(
-                    key_vals=updates,
-                    area=self.area,
-                    span_stages=span_stages,
+            flood = self._damp_updates(updates)
+            if flood:
+                self.flood_publication(
+                    Publication(
+                        key_vals=flood,
+                        area=self.area,
+                        span_stages=span_stages,
+                    )
                 )
-            )
         return updates
 
     def handle_set_key_vals(
@@ -495,7 +590,8 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
         self._emit_flood_trace(
             node_ids, hop_count, len(key_vals), len(updates), hop_ms, e2e_ms
         )
-        if updates:
+        flood = self._damp_updates(updates) if updates else updates
+        if flood:
             traced = perf_events.copy() if perf_events is not None else None
             if traced is not None:
                 traced.add_fine(self.params.node_id, FLOOD_RECEIVED_EVENT)
@@ -505,7 +601,7 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
                     ]
             self.flood_publication(
                 Publication(
-                    key_vals=updates,
+                    key_vals=flood,
                     area=self.area,
                     node_ids=list(node_ids or []),
                     perf_events=traced,
@@ -551,6 +647,107 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
         # span seeded from this object never starts from a missing stamp
         pub.ts_monotonic = time.monotonic()
         return pub
+
+    # -- flood-storm damping -----------------------------------------------
+
+    def _damp_updates(self, updates: KeyVals) -> KeyVals:
+        """Filter accepted updates through the per-(key, originator)
+        damping penalty. Held keys stay merged in the store (the CRDT is
+        untouched) but are withheld from flooding AND from the local
+        updates queue, bounding Decision/journal/stream churn during event
+        storms. TTL refreshes (no value body) never accrue penalty and
+        always pass; exempt prefixes (adjacency keys) always pass."""
+        if not self.params.damping_enabled:
+            return updates
+        now = time.monotonic()
+        half_life = self.params.damping_half_life_s
+        flood: KeyVals = {}
+        for key, value in updates.items():
+            if value.value is None or key.startswith(
+                self.params.damping_exempt_prefixes
+            ):
+                flood[key] = value
+                continue
+            slot = (key, value.originator_id)
+            entry = self._damping.get(slot)
+            if entry is None:
+                entry = _DampingEntry(last_decay=now)
+                self._damping[slot] = entry
+            else:
+                entry.penalty *= 0.5 ** ((now - entry.last_decay) / half_life)
+                entry.last_decay = now
+            entry.penalty += self.params.damping_penalty
+            if entry.held:
+                self._bump("kvstore.damping.suppressed")
+            elif entry.penalty >= self.params.damping_suppress_limit:
+                entry.held = True
+                entry.held_since = now
+                self._bump("kvstore.damping.holds")
+                self._bump("kvstore.damping.suppressed")
+            else:
+                flood[key] = value
+        if self._damping:
+            self._set_damping_gauge()
+            self._arm_damping_sweep()
+        return flood
+
+    def _arm_damping_sweep(self) -> None:
+        if self._damping_timer is not None:
+            return
+        try:
+            loop = self.loop()
+        except RuntimeError:
+            # no event loop (synchronous unit-test context): decay state
+            # is tracked per-entry, so the sweep arms on the next damped
+            # update that happens inside a loop — nothing is lost
+            return
+        self._damping_timer = loop.call_later(
+            self.params.damping_sweep_s, self._damping_sweep
+        )
+
+    def _set_damping_gauge(self) -> None:
+        self.counters["kvstore.damping.active_last"] = sum(
+            1 for e in self._damping.values() if e.held
+        )
+
+    def _damping_sweep(self) -> None:
+        """Decay penalties; release hold-downs whose penalty fell below the
+        reuse limit (or that hit the hard hold cap) by flooding the CURRENT
+        store value — the latest accepted write always wins on release."""
+        self._damping_timer = None
+        now = time.monotonic()
+        half_life = self.params.damping_half_life_s
+        release_keys: Set[str] = set()
+        for slot, entry in list(self._damping.items()):
+            entry.penalty *= 0.5 ** ((now - entry.last_decay) / half_life)
+            entry.last_decay = now
+            if entry.held and (
+                entry.penalty <= self.params.damping_reuse_limit
+                or now - entry.held_since >= self.params.damping_max_hold_s
+            ):
+                entry.held = False
+                entry.penalty = min(
+                    entry.penalty, self.params.damping_reuse_limit
+                )
+                self._observe(
+                    "kvstore.damping.hold_ms",
+                    (now - entry.held_since) * 1e3,
+                )
+                self._bump("kvstore.damping.released")
+                release_keys.add(slot[0])
+            if not entry.held and entry.penalty < 1.0:
+                del self._damping[slot]
+        self._set_damping_gauge()
+        if release_keys:
+            pub = Publication(area=self.area)
+            for key in sorted(release_keys):
+                value = self.store.get(key)
+                if value is not None:
+                    pub.key_vals[key] = value
+            if pub.key_vals:
+                self.flood_publication(pub, rate_limit=False)
+        if self._damping:
+            self._arm_damping_sweep()
 
     # -- flooding ----------------------------------------------------------
 
@@ -612,6 +809,12 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
             if sender_id is not None and sender_id == peer_name:
                 continue  # never flood back to the sender
             if peer.state == PeerState.IDLE:
+                continue
+            if peer.health in (PeerHealth.QUARANTINED, PeerHealth.PROBING):
+                # quarantined peers get no floods — only the probe-driven
+                # full syncs the quarantine loop issues
+                peer.floods_skipped += 1
+                self._bump("kvstore.quarantine.floods_skipped")
                 continue
             self._spawn(
                 self._send_key_vals(
@@ -701,8 +904,10 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
                 perf_events=perf_events,
             )
             self._bump("kvstore.thrift.num_flood_pub")
+            self._note_peer_success(peer_name)
         except Exception:
             self._bump("kvstore.thrift.num_flood_pub_failure")
+            self._note_peer_failure(peer_name)
             self._peer_event(peer_name, PeerEvent.API_ERROR)
 
     # -- peers + full sync -------------------------------------------------
@@ -714,14 +919,28 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
                 continue
             self.peers[name] = _Peer(
                 spec=spec,
+                # decorrelated jitter (the Fib resync pattern): concurrent
+                # sync failures across peers/nodes retry decorrelated
+                # instead of thundering back in lockstep
                 backoff=ExponentialBackoff(
-                    0.064, self.params.sync_max_backoff
+                    0.064,
+                    self.params.sync_max_backoff,
+                    jitter=True,
+                    rng=self._rng,
                 ),
             )
             self._peer_event(name, PeerEvent.PEER_ADD)
             if self.dual is not None:
                 self.dual.peer_up(name, 1)  # KvStore peers at unit metric
             self._spawn(self._full_sync(name))
+        if (
+            self.params.anti_entropy_enabled
+            and self._ae_timer is None
+            and self.peers
+        ):
+            self._ae_timer = self.loop().call_later(
+                self.params.anti_entropy_interval_s, self._anti_entropy_tick
+            )
 
     def del_peers(self, names: List[str]) -> None:
         for name in names:
@@ -746,6 +965,8 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
             peer.state = next_state
         if event == PeerEvent.API_ERROR:
             peer.backoff.report_error()
+            if peer.health in (PeerHealth.QUARANTINED, PeerHealth.PROBING):
+                return  # the probe loop owns recovery
             if name not in self._retry_pending:
                 self._retry_pending.add(name)
                 self._spawn(self._retry_sync(name))
@@ -755,9 +976,16 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
             peer = self.peers.get(name)
             if peer is None:
                 return
-            await asyncio.sleep(peer.backoff.get_time_remaining_until_retry())
+            wait = peer.backoff.get_time_remaining_until_retry()
+            self._observe("kvstore.full_sync_backoff_ms", wait * 1e3)
+            await asyncio.sleep(wait)
             peer = self.peers.get(name)
-            if peer is not None and peer.state == PeerState.IDLE:
+            if (
+                peer is not None
+                and peer.state == PeerState.IDLE
+                and peer.health
+                not in (PeerHealth.QUARANTINED, PeerHealth.PROBING)
+            ):
                 peer.state = PeerState.SYNCING
                 self._retry_pending.discard(name)
                 await self._full_sync(name)
@@ -779,9 +1007,11 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
             )
         except Exception:
             self._bump("kvstore.full_sync_failure")
+            self._note_peer_failure(peer_name)
             self._peer_event(peer_name, PeerEvent.API_ERROR)
             return
         peer.backoff.report_success()
+        self._note_peer_success(peer_name)
         self._bump("kvstore.thrift.num_full_sync")
         # merge their better keys and flood resulting updates onward
         self.handle_set_key_vals(pub.key_vals, [peer_name])
@@ -814,7 +1044,288 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
                 [self.params.node_id],
             )
         except Exception:
+            self._note_peer_failure(peer_name)
             self._peer_event(peer_name, PeerEvent.API_ERROR)
+
+    # -- peer-health quarantine --------------------------------------------
+
+    def _note_peer_failure(self, name: str) -> None:
+        """Score one transport failure toward this peer: consecutive
+        failures walk the HEALTHY → SUSPECT → QUARANTINED ladder."""
+        peer = self.peers.get(name)
+        if peer is None or not self.params.quarantine_enabled:
+            return
+        if peer.health in (PeerHealth.QUARANTINED, PeerHealth.PROBING):
+            return  # probe-loop failures are scored by the probe loop
+        peer.failures += 1
+        if peer.failures >= self.params.peer_quarantine_failures:
+            self._quarantine_peer(name)
+        elif (
+            peer.failures >= self.params.peer_suspect_failures
+            and peer.health == PeerHealth.HEALTHY
+        ):
+            peer.health = PeerHealth.SUSPECT
+            self._bump("kvstore.quarantine.suspects")
+
+    def _note_peer_success(self, name: str) -> None:
+        peer = self.peers.get(name)
+        if peer is None:
+            return
+        if peer.health in (PeerHealth.QUARANTINED, PeerHealth.PROBING):
+            return  # only probe hysteresis recovers a quarantined peer
+        peer.failures = 0
+        if peer.health == PeerHealth.SUSPECT:
+            peer.health = PeerHealth.HEALTHY
+
+    def _set_quarantine_gauge(self) -> None:
+        self.counters["kvstore.quarantine.active_last"] = sum(
+            1
+            for p in self.peers.values()
+            if p.health in (PeerHealth.QUARANTINED, PeerHealth.PROBING)
+        )
+
+    def _quarantine_peer(self, name: str) -> None:
+        peer = self.peers.get(name)
+        if peer is None or peer.health == PeerHealth.QUARANTINED:
+            return
+        peer.health = PeerHealth.QUARANTINED
+        peer.quarantined_at = time.monotonic()
+        peer.probe_streak = 0
+        peer.probe_backoff = ExponentialBackoff(
+            self.params.peer_probe_min_backoff,
+            self.params.peer_probe_max_backoff,
+            jitter=True,
+            rng=self._rng,
+        )
+        self._bump("kvstore.quarantine.trips")
+        self._set_quarantine_gauge()
+        self._dump_quarantine_forensics(name, peer)
+        self._spawn(self._probe_quarantined(name))
+
+    def _dump_quarantine_forensics(self, name: str, peer: _Peer) -> None:
+        """Snapshot a quarantine-trip forensics artifact through the PR 13
+        flight-recorder dump path and emit one KVSTORE_PEER_QUARANTINED
+        LogSample carrying the dump id."""
+        forensics_id = ""
+        try:
+            from openr_tpu.solver.flight_recorder import FlightRecorder
+
+            if self._forensics is None:
+                self._forensics = FlightRecorder(
+                    node=self.params.node_id,
+                    forensics_dir=self.params.forensics_dir,
+                )
+            dump = self._forensics.dump(
+                "kvstore_peer_quarantined",
+                counters=dict(self.counters),
+                extra={
+                    "peer": name,
+                    "area": self.area,
+                    "failures": peer.failures,
+                    "peer_state": peer.state.value,
+                    "peer_health": dict(self.get_peer_health()),
+                },
+            )
+            forensics_id = dump["id"]
+            self._bump("kvstore.forensics_dumps")
+        except Exception:
+            pass  # forensics must never break the store loop
+        if self._log_sample_fn is not None:
+            sample = LogSample()
+            sample.add_string("event", PEER_QUARANTINED_EVENT)
+            sample.add_string("area", self.area)
+            sample.add_string("peer", name)
+            sample.add_int("failures", peer.failures)
+            sample.add_string("forensics_id", forensics_id)
+            try:
+                self._log_sample_fn(sample)
+            except Exception:
+                pass  # a closed monitor queue must never break the loop
+
+    async def _probe_quarantined(self, name: str) -> None:
+        """Recovery loop for one quarantined peer: jittered-backoff probes
+        through the full-sync dump path; `peer_probe_successes` consecutive
+        successes recover the peer (hysteresis against flapping links)."""
+        while True:
+            peer = self.peers.get(name)
+            if peer is None or peer.health not in (
+                PeerHealth.QUARANTINED,
+                PeerHealth.PROBING,
+            ):
+                return
+            peer.probe_backoff.report_error()
+            await asyncio.sleep(
+                peer.probe_backoff.get_time_remaining_until_retry()
+            )
+            peer = self.peers.get(name)
+            if peer is None or peer.health not in (
+                PeerHealth.QUARANTINED,
+                PeerHealth.PROBING,
+            ):
+                return
+            peer.health = PeerHealth.PROBING
+            peer.probes += 1
+            self._bump("kvstore.quarantine.probes")
+            my_hashes = self.dump_hashes().key_vals
+            try:
+                # named fault seam: an injected probe failure keeps the
+                # peer quarantined through another backoff round
+                fault_point("kvstore.quarantine_probe", name)
+                pub = await self.transport.dump_key_vals(
+                    peer.spec.peer_addr, self.area, my_hashes
+                )
+            except Exception:
+                self._bump("kvstore.quarantine.probe_failures")
+                peer.probe_streak = 0
+                peer.health = PeerHealth.QUARANTINED
+                continue
+            peer.probe_streak += 1
+            if peer.probe_streak >= self.params.peer_probe_successes:
+                self._recover_peer(name, pub)
+                return
+            peer.health = PeerHealth.QUARANTINED
+
+    def _recover_peer(self, name: str, pub: Publication) -> None:
+        """Probe hysteresis satisfied: merge the probe's full-sync dump,
+        restore the peer FSM, and resume flooding toward the peer."""
+        peer = self.peers.get(name)
+        if peer is None:
+            return
+        peer.health = PeerHealth.HEALTHY
+        peer.failures = 0
+        peer.probe_streak = 0
+        peer.backoff.report_success()
+        if peer.state == PeerState.IDLE:
+            peer.state = PeerState.SYNCING
+        held_ms = (time.monotonic() - peer.quarantined_at) * 1e3
+        self._observe("kvstore.quarantine.duration_ms", held_ms)
+        self._bump("kvstore.quarantine.recoveries")
+        self._set_quarantine_gauge()
+        self._bump("kvstore.thrift.num_full_sync")
+        self.handle_set_key_vals(pub.key_vals, [name])
+        self._peer_event(name, PeerEvent.SYNC_RESP_RCVD)
+        if pub.tobe_updated_keys:
+            self._spawn(
+                self._finalize_full_sync(pub.tobe_updated_keys, name)
+            )
+        if self._log_sample_fn is not None:
+            sample = LogSample()
+            sample.add_string("event", PEER_RECOVERED_EVENT)
+            sample.add_string("area", self.area)
+            sample.add_string("peer", name)
+            sample.add_int("probes", peer.probes)
+            sample.add_double("quarantined_ms", held_ms)
+            try:
+                self._log_sample_fn(sample)
+            except Exception:
+                pass  # a closed monitor queue must never break the loop
+
+    def get_peer_health(self) -> Dict[str, Dict]:
+        """Per-peer quarantine-ladder snapshot (ctrl getKvStorePeerHealth /
+        `breeze kvstore peer-health`)."""
+        now = time.monotonic()
+        out: Dict[str, Dict] = {}
+        for name, peer in self.peers.items():
+            quarantined = peer.health in (
+                PeerHealth.QUARANTINED,
+                PeerHealth.PROBING,
+            )
+            out[name] = {
+                "state": peer.state.value,
+                "health": peer.health.value,
+                "failures": peer.failures,
+                "probes": peer.probes,
+                "probe_streak": peer.probe_streak,
+                "floods_skipped": peer.floods_skipped,
+                "quarantined_ms": (
+                    round((now - peer.quarantined_at) * 1e3, 1)
+                    if quarantined
+                    else 0.0
+                ),
+            }
+        return out
+
+    # -- adaptive anti-entropy ---------------------------------------------
+
+    def _flood_health_degraded(self) -> bool:
+        """Per-interval flood-health check: any sync/flood failure or wire
+        reject, or a duplicate/received ratio off budget, counts as
+        degraded and arms an anti-entropy round."""
+        watched = (
+            "kvstore.flood.received",
+            "kvstore.flood.duplicates",
+            "kvstore.full_sync_failure",
+            "kvstore.thrift.num_flood_pub_failure",
+            "kvstore.wire.rejected_total",
+        )
+        deltas: Dict[str, int] = {}
+        for counter in watched:
+            current = self.counters.get(counter, 0)
+            deltas[counter] = current - self._ae_last.get(counter, 0)
+            self._ae_last[counter] = current
+        if (
+            deltas["kvstore.full_sync_failure"] > 0
+            or deltas["kvstore.thrift.num_flood_pub_failure"] > 0
+            or deltas["kvstore.wire.rejected_total"] > 0
+        ):
+            return True
+        received = deltas["kvstore.flood.received"]
+        return (
+            received >= 4
+            and deltas["kvstore.flood.duplicates"] / received
+            > self.params.flood_duplicate_budget
+        )
+
+    def _anti_entropy_tick(self) -> None:
+        self._ae_timer = None
+        if not self.peers:
+            return  # re-armed by the next add_peers
+        degraded = self._flood_health_degraded()
+        self.counters["kvstore.anti_entropy.armed_last"] = int(degraded)
+        if degraded:
+            candidates = [
+                name
+                for name, peer in self.peers.items()
+                if peer.health
+                not in (PeerHealth.QUARANTINED, PeerHealth.PROBING)
+            ]
+            if candidates:
+                peer_name = candidates[self._rng.randrange(len(candidates))]
+                self._spawn(self._anti_entropy_round(peer_name))
+        self._ae_timer = self.loop().call_later(
+            self.params.anti_entropy_interval_s, self._anti_entropy_tick
+        )
+
+    async def _anti_entropy_round(self, peer_name: str) -> None:
+        """One 3-way repair round against a healthy peer: the hash dump
+        ships only divergent keys in either direction."""
+        peer = self.peers.get(peer_name)
+        if peer is None:
+            return
+        t0 = time.monotonic()
+        my_hashes = self.dump_hashes().key_vals
+        try:
+            # named fault seam: a failed repair round scores the peer and
+            # re-arms on the next degraded interval
+            fault_point("kvstore.anti_entropy", peer_name)
+            pub = await self.transport.dump_key_vals(
+                peer.spec.peer_addr, self.area, my_hashes
+            )
+        except Exception:
+            self._bump("kvstore.anti_entropy.round_failures")
+            self._note_peer_failure(peer_name)
+            self._peer_event(peer_name, PeerEvent.API_ERROR)
+            return
+        self._bump("kvstore.anti_entropy.rounds")
+        self._note_peer_success(peer_name)
+        if pub.key_vals:
+            self._bump("kvstore.anti_entropy.keys_repaired", len(pub.key_vals))
+            self.handle_set_key_vals(pub.key_vals, [peer_name])
+        if pub.tobe_updated_keys:
+            await self._finalize_full_sync(pub.tobe_updated_keys, peer_name)
+        self._observe(
+            "kvstore.anti_entropy.round_ms", (time.monotonic() - t0) * 1e3
+        )
 
     # -- TTL ---------------------------------------------------------------
 
@@ -828,7 +1339,9 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
             epoch = self._ttl_epochs.get(key, 0) + 1
             self._ttl_epochs[key] = epoch
             if value.ttl == TTL_INFINITY:
+                self._ttl_expiry.pop(key, None)
                 continue
+            self._ttl_expiry[key] = now + value.ttl / 1000.0
             entry = _TtlEntry(
                 expiry=now + value.ttl / 1000.0, key=key, epoch=epoch
             )
@@ -859,6 +1372,7 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
                 expired.append(top.key)
                 del self.store[top.key]
                 del self._ttl_epochs[top.key]
+                self._ttl_expiry.pop(top.key, None)
                 self._bump("kvstore.expired_key_vals")
         if self._ttl_heap:
             self._schedule_ttl_timer(self._ttl_heap[0].expiry - now)
@@ -870,19 +1384,35 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
     def _update_publication_ttl(
         self, publication: Publication, decrement: bool = False
     ) -> None:
-        """Drop about-to-expire keys; decrement forwarded TTLs
-        (KvStore.cpp:2038 updatePublicationTtl)."""
+        """Serve the REMAINING ttl (countdown deadline minus now), drop
+        about-to-expire keys, decrement forwarded TTLs
+        (KvStore.cpp:2038 updatePublicationTtl).
+
+        Stored Values keep their ORIGINAL ttl; serving that here would
+        re-arm a dead originator's keys to full lifetime on every full
+        sync / dump — with refreshes lost on a hostile network, such keys
+        would never age out anywhere (the immortal-key bug). Publications
+        always carry a copy so the stored Value is never mutated."""
         dec = self.params.ttl_decrement_ms
+        now = time.monotonic()
         for key in list(publication.key_vals.keys()):
             value = publication.key_vals[key]
             if value.ttl == TTL_INFINITY:
                 continue
-            if value.ttl - dec <= 0:
+            expiry = self._ttl_expiry.get(key)
+            remaining = (
+                int((expiry - now) * 1000.0)
+                if expiry is not None
+                else value.ttl
+            )
+            if decrement:
+                remaining -= dec
+            if remaining <= 0:
                 del publication.key_vals[key]
                 continue
-            if decrement:
+            if remaining != value.ttl:
                 new_value = value.copy()
-                new_value.ttl = value.ttl - dec
+                new_value.ttl = remaining
                 publication.key_vals[key] = new_value
 
     # -- misc --------------------------------------------------------------
@@ -897,6 +1427,12 @@ class KvStoreDb(CountersMixin, HistogramsMixin):
         if self._ttl_timer is not None:
             self._ttl_timer.cancel()
             self._ttl_timer = None
+        if self._damping_timer is not None:
+            self._damping_timer.cancel()
+            self._damping_timer = None
+        if self._ae_timer is not None:
+            self._ae_timer.cancel()
+            self._ae_timer = None
         self._buffer_flush.cancel()
         for task in list(self._sync_tasks):
             task.cancel()
@@ -1082,6 +1618,20 @@ class KvStore:
 
     def db(self, area: str = "0") -> KvStoreDb:
         return self.dbs[area]
+
+    def note_wire_reject(self, kind: str) -> None:
+        """Typed wire-decode rejection (oversized / truncated / malformed /
+        hash_mismatch) observed by a transport serving this store. Counters
+        live on the per-area dbs; route through the first db so the
+        kvstore.wire.* namespace reaches getCounters."""
+        db = next(iter(self.dbs.values()), None)
+        if db is None:
+            return
+        db._bump("kvstore.wire.rejected_total")
+        db._bump(f"kvstore.wire.rejected.{kind}")
+
+    def get_peer_health(self, area: str = "0") -> Dict[str, Dict]:
+        return self.dbs[area].get_peer_health()
 
     @property
     def counters(self) -> Dict[str, int]:

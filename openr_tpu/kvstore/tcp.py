@@ -61,6 +61,14 @@ class KvStoreTcpServer:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def _note_reject(self, kind: str) -> None:
+        """Record one typed wire rejection on the store's counters
+        (kvstore.wire.rejected.{kind}); tolerate store stand-ins without
+        the hook (unit-test doubles)."""
+        note = getattr(self._store, "note_wire_reject", None)
+        if note is not None:
+            note(kind)
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._serve_conn,
@@ -101,6 +109,7 @@ class KvStoreTcpServer:
                 try:
                     req = json.loads(line)
                 except ValueError:
+                    self._note_reject("malformed")
                     req = {}
                 req_id = req.get("id") if isinstance(req, dict) else None
                 try:
@@ -113,6 +122,13 @@ class KvStoreTcpServer:
                         ),
                     }
                 except Exception as exc:  # malformed request or handler error
+                    # typed decode rejections (wire.WireDecodeError /
+                    # native.NativeDecodeError) carry a .kind; count them
+                    # and keep serving — a hostile frame must never take
+                    # down the connection loop, let alone the store
+                    kind = getattr(exc, "kind", None)
+                    if kind is not None:
+                        self._note_reject(kind)
                     reply = {
                         "id": req_id,
                         "error": f"{type(exc).__name__}: {exc}",
@@ -124,6 +140,7 @@ class KvStoreTcpServer:
         except ValueError as exc:
             # readline() raises when a frame exceeds the stream limit; make
             # the failure diagnosable instead of an unretrieved-task mystery
+            self._note_reject("oversized")
             log.error("kvstore tcp: dropping connection, %s", exc)
         finally:
             self._writers.discard(writer)

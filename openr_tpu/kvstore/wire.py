@@ -21,7 +21,27 @@ from openr_tpu.types import (
     PerfEvents,
     Publication,
     Value,
+    generate_hash,
 )
+
+
+# hard ceilings on decoded frames: a hostile or corrupted peer must not
+# be able to balloon memory (or smuggle garbage into the CRDT) through a
+# single decoded field
+MAX_VALUE_BYTES = 16 * 1024 * 1024
+MAX_KEY_CHARS = 8192
+
+
+class WireDecodeError(ValueError):
+    """Typed rejection of a hostile/corrupt wire frame.
+
+    kind ∈ {"oversized", "truncated", "malformed", "hash_mismatch"} — the
+    transport layer maps it onto `kvstore.wire.rejected.{kind}` counters
+    (KvStore.note_wire_reject) and never lets it crash the store loop."""
+
+    def __init__(self, kind: str, detail: str = "") -> None:
+        super().__init__(f"{kind}: {detail}" if detail else kind)
+        self.kind = kind
 
 
 def _b64(data: Optional[bytes]) -> Optional[str]:
@@ -43,14 +63,58 @@ def value_to_json(v: Value) -> Dict[str, Any]:
     }
 
 
+def _int_field(d: Dict[str, Any], name: str, default: int) -> int:
+    got = d.get(name, default)
+    # bool is an int subclass; a corrupted frame decoding `true` must not
+    # masquerade as a version/ttl
+    if not isinstance(got, int) or isinstance(got, bool):
+        raise WireDecodeError("malformed", f"{name} must be an int")
+    return got
+
+
 def value_from_json(d: Dict[str, Any]) -> Value:
+    if not isinstance(d, dict):
+        raise WireDecodeError("malformed", "value frame is not an object")
+    if "version" not in d or "originator_id" not in d:
+        raise WireDecodeError(
+            "truncated", "value frame missing version/originator_id"
+        )
+    version = _int_field(d, "version", 0)
+    originator_id = d["originator_id"]
+    if not isinstance(originator_id, str):
+        raise WireDecodeError("malformed", "originator_id must be a str")
+    ttl = _int_field(d, "ttl", TTL_INFINITY)
+    ttl_version = _int_field(d, "ttl_version", 0)
+    vhash = d.get("hash")
+    if vhash is not None and (
+        not isinstance(vhash, int) or isinstance(vhash, bool)
+    ):
+        raise WireDecodeError("malformed", "hash must be an int")
+    raw = d.get("value")
+    if raw is not None and not isinstance(raw, str):
+        raise WireDecodeError("malformed", "value must be base64 text")
+    try:
+        value = _unb64(raw)
+    except (ValueError, TypeError) as exc:  # binascii.Error is a ValueError
+        raise WireDecodeError("malformed", "bad base64 value body") from exc
+    if value is not None and len(value) > MAX_VALUE_BYTES:
+        raise WireDecodeError(
+            "oversized", f"value body {len(value)}B > {MAX_VALUE_BYTES}B"
+        )
+    if value is not None and vhash is not None:
+        # end-to-end integrity: the advertised hash must match the body
+        # (a bit-flipped frame that still base64-decodes lands here)
+        if generate_hash(version, originator_id, value) != vhash:
+            raise WireDecodeError(
+                "hash_mismatch", "value bytes do not match advertised hash"
+            )
     return Value(
-        version=d["version"],
-        originator_id=d["originator_id"],
-        value=_unb64(d.get("value")),
-        ttl=d.get("ttl", TTL_INFINITY),
-        ttl_version=d.get("ttl_version", 0),
-        hash=d.get("hash"),
+        version=version,
+        originator_id=originator_id,
+        value=value,
+        ttl=ttl,
+        ttl_version=ttl_version,
+        hash=vhash,
     )
 
 
@@ -61,7 +125,18 @@ def key_vals_to_json(kv: KeyVals) -> Dict[str, Any]:
 def key_vals_from_json(d: Optional[Dict[str, Any]]) -> KeyVals:
     if not d:
         return {}
-    return {k: value_from_json(v) for k, v in d.items()}
+    if not isinstance(d, dict):
+        raise WireDecodeError("malformed", "key_vals is not an object")
+    out: KeyVals = {}
+    for k, v in d.items():
+        if not isinstance(k, str):
+            raise WireDecodeError("malformed", "key must be a str")
+        if len(k) > MAX_KEY_CHARS:
+            raise WireDecodeError(
+                "oversized", f"key {len(k)} chars > {MAX_KEY_CHARS}"
+            )
+        out[k] = value_from_json(v)
+    return out
 
 
 def perf_events_to_json(
@@ -81,9 +156,14 @@ def perf_events_from_json(
 ) -> Optional[PerfEvents]:
     if data is None:
         return None
-    return PerfEvents(
-        [PerfEvent(str(n), str(d), ts) for n, d, ts in data]
-    )
+    try:
+        return PerfEvents(
+            [PerfEvent(str(n), str(d), float(ts)) for n, d, ts in data]
+        )
+    except (TypeError, ValueError) as exc:
+        raise WireDecodeError(
+            "malformed", "perf_events must be [node, event, ts] triples"
+        ) from exc
 
 
 def publication_to_json(pub: Publication) -> Dict[str, Any]:
@@ -99,12 +179,25 @@ def publication_to_json(pub: Publication) -> Dict[str, Any]:
     }
 
 
+def _str_list(d: Dict[str, Any], name: str) -> Optional[List[str]]:
+    got = d.get(name)
+    if got is None:
+        return None
+    if not isinstance(got, list) or not all(
+        isinstance(item, str) for item in got
+    ):
+        raise WireDecodeError("malformed", f"{name} must be a list of str")
+    return got
+
+
 def publication_from_json(d: Dict[str, Any]) -> Publication:
+    if not isinstance(d, dict):
+        raise WireDecodeError("malformed", "publication is not an object")
     return Publication(
         key_vals=key_vals_from_json(d.get("key_vals")),
-        expired_keys=list(d.get("expired_keys") or []),
-        node_ids=d.get("node_ids"),
-        tobe_updated_keys=d.get("tobe_updated_keys"),
+        expired_keys=list(_str_list(d, "expired_keys") or []),
+        node_ids=_str_list(d, "node_ids"),
+        tobe_updated_keys=_str_list(d, "tobe_updated_keys"),
         area=d.get("area", "0"),
         perf_events=perf_events_from_json(d.get("perf_events")),
     )
@@ -121,14 +214,19 @@ def dual_messages_to_json(msgs: DualMessages) -> Dict[str, Any]:
 
 
 def dual_messages_from_json(d: Dict[str, Any]) -> DualMessages:
-    return DualMessages(
-        src_id=d.get("src_id", ""),
-        messages=[
-            DualMessage(
-                dst_id=m["dst_id"],
-                distance=m["distance"],
-                type=DualMessageType[m["type"]],
-            )
-            for m in d.get("messages") or []
-        ],
-    )
+    if not isinstance(d, dict):
+        raise WireDecodeError("malformed", "dual_messages is not an object")
+    try:
+        return DualMessages(
+            src_id=d.get("src_id", ""),
+            messages=[
+                DualMessage(
+                    dst_id=m["dst_id"],
+                    distance=m["distance"],
+                    type=DualMessageType[m["type"]],
+                )
+                for m in d.get("messages") or []
+            ],
+        )
+    except (KeyError, TypeError) as exc:
+        raise WireDecodeError("malformed", "bad dual message") from exc

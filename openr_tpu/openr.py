@@ -136,6 +136,37 @@ class OpenrDaemon:
                 ),
                 is_flood_root=c.kvstore_config.is_flood_root,
                 use_native_store=c.kvstore_config.enable_native_store,
+                damping_enabled=c.kvstore_config.damping_enabled,
+                damping_half_life_s=c.kvstore_config.damping_half_life_s,
+                damping_max_hold_s=c.kvstore_config.damping_max_hold_s,
+                damping_suppress_limit=(
+                    c.kvstore_config.damping_suppress_limit
+                ),
+                damping_reuse_limit=c.kvstore_config.damping_reuse_limit,
+                quarantine_enabled=c.kvstore_config.quarantine_enabled,
+                peer_suspect_failures=(
+                    c.kvstore_config.peer_suspect_failures
+                ),
+                peer_quarantine_failures=(
+                    c.kvstore_config.peer_quarantine_failures
+                ),
+                peer_probe_min_backoff=(
+                    c.kvstore_config.peer_probe_min_backoff_s
+                ),
+                peer_probe_max_backoff=(
+                    c.kvstore_config.peer_probe_max_backoff_s
+                ),
+                peer_probe_successes=c.kvstore_config.peer_probe_successes,
+                anti_entropy_enabled=(
+                    c.kvstore_config.anti_entropy_enabled
+                ),
+                anti_entropy_interval_s=float(
+                    c.kvstore_config.sync_interval_s
+                ),
+                flood_duplicate_budget=(
+                    c.kvstore_config.flood_duplicate_budget
+                ),
+                forensics_dir=c.decision_config.solver_forensics_dir,
             ),
             loop=loop,
             # flood-trace samples (FLOOD_TRACE) drain into the monitor's

@@ -305,6 +305,10 @@ class MockIoNetwork:
         self._links: Dict[Tuple[str, str], List[Tuple[Tuple[str, str], float]]] = {}
         self._receivers: Dict[str, object] = {}
         self._partitioned: set = set()
+        # optional chaos overlay (testing/chaos.ChaosMesh): seeded
+        # per-direction loss / duplication / extra delay / partition
+        # applied to every delivery on top of the base link latency
+        self.chaos = None
 
     def connect(
         self,
@@ -353,17 +357,24 @@ class MockIoNetwork:
             callback = self._receivers.get(dst_instance)
             if callback is None:
                 continue
-            loop.call_later(
-                latency,
-                callback,
-                ReceivedPacket(
-                    if_name=dst_iface,
-                    packet=packet,
-                    recv_ts_us=int(
-                        (time.monotonic() + latency) * 1_000_000
+            copies, extra = 1, 0.0
+            if self.chaos is not None:
+                verdict = self.chaos.packet_verdict(src[0], dst_instance)
+                if verdict is None:
+                    continue  # dropped by the chaos schedule
+                copies, extra = verdict
+            for _ in range(copies):
+                loop.call_later(
+                    latency + extra,
+                    callback,
+                    ReceivedPacket(
+                        if_name=dst_iface,
+                        packet=packet,
+                        recv_ts_us=int(
+                            (time.monotonic() + latency + extra) * 1_000_000
+                        ),
                     ),
-                ),
-            )
+                )
         return now_us
 
 

@@ -1,0 +1,558 @@
+"""Seeded chaos transport mesh (CHAOS_SMOKE).
+
+Hostile-network hardening needs a hostile network. `ChaosMesh` is a
+deterministic, per-direction fault schedule over the whole emulated
+fabric — Spark datagrams (via `MockIoNetwork.chaos`) and KvStore RPCs
+(via `ChaosKvTransport`) both consult it:
+
+  - **loss**: the RPC raises `KvStoreTransportError` / the datagram is
+    silently dropped — exactly what a congested or lossy path does;
+  - **duplication**: the frame is delivered twice (feeds the flood
+    duplicate ratio that arms adaptive anti-entropy);
+  - **reorder/delay**: bounded extra latency, drawn per frame, so
+    frames overtake each other on the fabric;
+  - **corruption**: the key_vals payload is round-tripped through the
+    JSON wire codec with one flipped byte — the *receiver* counts the
+    typed reject (`kvstore.wire.rejected.*`) and the *sender* sees a
+    transport error, mirroring what `KvStoreTcpServer` does when a
+    corrupted frame arrives over a real socket;
+  - **partition**: one *direction* blackholed (`spec.partition` for
+    KvStore RPCs, `spec.spark_partition` for hellos) — asymmetric
+    partitions are the nasty case the peer-quarantine ladder exists
+    for.
+
+Everything draws from one seeded `random.Random`, so a failing schedule
+replays byte-for-byte.
+
+`run_chaos_smoke` is the tier-1 proof: a 5-node line converges clean,
+proves flood-storm damping end to end (a flapping key is held at the
+originator and the *latest* value is served on release), survives a
+seeded loss+delay+corruption storm (adaptive anti-entropy repairs the
+divergence), trips peer quarantine under an asymmetric partition,
+recovers through the probe path after heal, and ends oracle-equal: all
+stores pairwise-identical and every node's programmed routes matching a
+never-chaosed oracle network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from openr_tpu.kvstore import wire
+from openr_tpu.kvstore.transport import (
+    InProcessTransport,
+    KvStoreTransportError,
+)
+from openr_tpu.types import KeyVals, PerfEvents, Publication
+
+_B64_ALPHABET = (
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/"
+)
+
+
+@dataclass(frozen=True)
+class ChaosLinkSpec:
+    """Per-direction fault schedule for one src→dst edge."""
+
+    loss: float = 0.0  # P(KvStore RPC raises transport error)
+    dup: float = 0.0  # P(frame delivered twice)
+    reorder: float = 0.0  # P(extra reorder delay on top of delay_ms)
+    delay_ms: Tuple[float, float] = (0.0, 0.0)  # uniform extra latency
+    corrupt: float = 0.0  # P(kv.set payload corrupted in flight)
+    partition: bool = False  # KvStore RPCs blackholed
+    spark_loss: Optional[float] = None  # None → follow `loss`
+    spark_partition: bool = False  # Spark datagrams blackholed
+
+
+class ChaosMesh:
+    """Seeded per-direction fault schedules for the whole fabric.
+
+    `set_default` applies to every directed pair without an explicit
+    `set_link` entry; `clear()` heals everything. Stats are mesh-local
+    bookkeeping for test reports (node-side evidence lives in the
+    per-store counters)."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.rng = random.Random(seed)
+        self._default = ChaosLinkSpec()
+        self._links: Dict[Tuple[str, str], ChaosLinkSpec] = {}
+        self.stats: Dict[str, int] = {}
+
+    def note(self, what: str) -> None:
+        self.stats[what] = self.stats.get(what, 0) + 1
+
+    def set_default(self, spec: ChaosLinkSpec) -> None:
+        self._default = spec
+
+    def set_link(self, src: str, dst: str, spec: ChaosLinkSpec) -> None:
+        self._links[(src, dst)] = spec
+
+    def clear_link(self, src: str, dst: str) -> None:
+        self._links.pop((src, dst), None)
+
+    def clear(self) -> None:
+        """Heal the fabric: drop every schedule, default included."""
+        self._default = ChaosLinkSpec()
+        self._links.clear()
+
+    def spec(self, src: str, dst: str) -> ChaosLinkSpec:
+        return self._links.get((src, dst), self._default)
+
+    def extra_delay(self, spec: ChaosLinkSpec) -> float:
+        lo, hi = spec.delay_ms
+        extra = self.rng.uniform(lo, hi) / 1000.0 if hi > 0 else 0.0
+        if spec.reorder and self.rng.random() < spec.reorder:
+            # enough on top of the base draw for frames to overtake
+            extra += self.rng.uniform(0.0, 4.0 * max(hi, 1.0)) / 1000.0
+        return extra
+
+    def packet_verdict(
+        self, src: str, dst: str
+    ) -> Optional[Tuple[int, float]]:
+        """Spark-datagram gate (consulted by `MockIoNetwork._send`).
+
+        Returns None to drop, else (copies, extra_delay_s)."""
+        spec = self.spec(src, dst)
+        if spec.spark_partition:
+            self.note("spark_dropped")
+            return None
+        loss = spec.spark_loss if spec.spark_loss is not None else spec.loss
+        if loss and self.rng.random() < loss:
+            self.note("spark_dropped")
+            return None
+        copies = 1
+        if spec.dup and self.rng.random() < spec.dup:
+            copies = 2
+            self.note("spark_duplicated")
+        return copies, self.extra_delay(spec)
+
+
+class ChaosKvTransport(InProcessTransport):
+    """InProcessTransport with the mesh's schedule on every RPC.
+
+    Must subclass `InProcessTransport` — the KvStore container only
+    self-registers on transports of that type. Request and response
+    directions are gated independently (an asymmetric partition fails
+    dumps whose *reply* path is dead, even though the request landed)."""
+
+    def __init__(self, mesh: ChaosMesh, delay: float = 0.0) -> None:
+        super().__init__(delay)
+        self.mesh = mesh
+
+    async def _gate(
+        self, src: str, dst: str, what: str
+    ) -> ChaosLinkSpec:
+        spec = self.mesh.spec(src, dst)
+        if spec.partition:
+            self.mesh.note("kv_partitioned")
+            raise KvStoreTransportError(
+                f"chaos partition: {src} -> {dst} ({what})"
+            )
+        if spec.loss and self.mesh.rng.random() < spec.loss:
+            self.mesh.note("kv_dropped")
+            raise KvStoreTransportError(
+                f"chaos loss: {src} -> {dst} ({what})"
+            )
+        extra = self.mesh.extra_delay(spec)
+        if extra > 0.0:
+            self.mesh.note("kv_delayed")
+            await asyncio.sleep(extra)
+        return spec
+
+    def _corrupt_kind(self, key_vals: KeyVals) -> str:
+        """Flip one byte of the frame through the real wire codec and
+        return the typed reject kind the receiver would count."""
+        frame = wire.key_vals_to_json(key_vals)
+        victims = [k for k, v in frame.items() if v.get("value")]
+        if victims:
+            key = victims[self.mesh.rng.randrange(len(victims))]
+            text = frame[key]["value"]
+            pos = self.mesh.rng.randrange(len(text))
+            repl = self.mesh.rng.choice(
+                [c for c in _B64_ALPHABET if c != text[pos]]
+            )
+            frame[key] = dict(frame[key])
+            frame[key]["value"] = text[:pos] + repl + text[pos + 1 :]
+        elif frame:
+            # refresh-only frame (no value bodies): smash a version field
+            key = next(iter(frame))
+            frame[key] = dict(frame[key])
+            frame[key]["version"] = "garbage"
+        else:
+            return "malformed"
+        try:
+            wire.key_vals_from_json(frame)
+        except wire.WireDecodeError as exc:
+            return exc.kind
+        # the flip landed somewhere the codec tolerates (e.g. base64
+        # padding aliasing) — a real receiver would still merge garbage
+        # bytes, but for the emulated reject path count it as malformed
+        return "malformed"
+
+    async def call_set(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        key_vals: KeyVals,
+        node_ids: Optional[list],
+        perf_events: Optional[PerfEvents] = None,
+    ) -> None:
+        spec = await self._gate(caller, peer_addr, "kv.set")
+        if spec.corrupt and self.mesh.rng.random() < spec.corrupt:
+            kind = self._corrupt_kind(key_vals)
+            target = self._stores.get(peer_addr)
+            note = getattr(target, "note_wire_reject", None)
+            if note is not None:
+                note(kind)
+            self.mesh.note("kv_corrupted")
+            raise KvStoreTransportError(
+                f"chaos corruption ({kind}): {caller} -> {peer_addr}"
+            )
+        await super().call_set(
+            caller, peer_addr, area, key_vals, node_ids, perf_events
+        )
+        if spec.dup and self.mesh.rng.random() < spec.dup:
+            self.mesh.note("kv_duplicated")
+            await super().call_set(
+                caller,
+                peer_addr,
+                area,
+                dict(key_vals),
+                list(node_ids) if node_ids is not None else None,
+                perf_events,
+            )
+
+    async def call_dump(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        key_val_hashes: Optional[KeyVals],
+    ) -> Publication:
+        await self._gate(caller, peer_addr, "kv.dump")
+        pub = await super().call_dump(
+            caller, peer_addr, area, key_val_hashes
+        )
+        await self._gate(peer_addr, caller, "kv.dump-reply")
+        return pub
+
+    async def call_dual(
+        self, caller: str, peer_addr: str, area: str, msgs
+    ) -> None:
+        await self._gate(caller, peer_addr, "kv.dual")
+        await super().call_dual(caller, peer_addr, area, msgs)
+
+    async def call_flood_topo_set(
+        self,
+        caller: str,
+        peer_addr: str,
+        area: str,
+        root_id: str,
+        src_id: str,
+        set_child: bool,
+        all_roots: bool,
+    ) -> None:
+        await self._gate(caller, peer_addr, "kv.floodTopoSet")
+        await super().call_flood_topo_set(
+            caller, peer_addr, area, root_id, src_id, set_child, all_roots
+        )
+
+
+# ---------------------------------------------------------------------------
+# CHAOS_SMOKE harness
+# ---------------------------------------------------------------------------
+
+# fast knobs so the hardening machinery is observable inside a tier-1
+# budget: 1 s anti-entropy ticks, sub-second damping half-life, ~50 ms
+# probe backoffs, quarantine after 4 consecutive failures
+_CHAOS_OVERRIDES: Dict[str, Any] = {
+    # deterministic metrics for the oracle differential (RTT-derived
+    # metrics vary with the chaos delay draws)
+    "link_monitor_config": {"use_rtt_metric": False},
+    "kvstore_config": {
+        "sync_interval_s": 1,
+        "damping_half_life_s": 0.5,
+        "damping_max_hold_s": 2.0,
+        "peer_suspect_failures": 2,
+        "peer_quarantine_failures": 4,
+        "peer_probe_min_backoff_s": 0.05,
+        "peer_probe_max_backoff_s": 0.4,
+        "peer_probe_successes": 2,
+        "flood_duplicate_budget": 0.3,
+    },
+}
+
+
+def _programmed_tables(net) -> Dict[str, Dict[str, List[tuple]]]:
+    from openr_tpu.platform import FIB_CLIENT_OPENR
+
+    out: Dict[str, Dict[str, List[tuple]]] = {}
+    for name, wrapper in net.wrappers.items():
+        table = wrapper.fib_handler.unicast_routes.get(FIB_CLIENT_OPENR, {})
+        out[name] = {
+            str(dest): sorted((nh.address, nh.iface) for nh in r.nexthops)
+            for dest, r in table.items()
+        }
+    return out
+
+
+def _lsdb_digest(wrapper) -> Dict[str, tuple]:
+    """key -> (version, originator, value bytes); TTL fields excluded
+    (countdowns legitimately differ node to node)."""
+    pub = wrapper.daemon.kvstore.dump_all()
+    return {
+        k: (v.version, v.originator_id, v.value)
+        for k, v in pub.key_vals.items()
+    }
+
+
+def _converged(net, n: int):
+    def check() -> bool:
+        for i in range(n):
+            got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+            want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+            if not want.issubset(got):
+                return False
+        return True
+
+    return check
+
+
+def _counter(net, node: str, name: str) -> int:
+    return int(net.wrappers[node].daemon.kvstore.db().counters.get(name, 0))
+
+
+def _counter_sum(net, name: str) -> int:
+    return sum(_counter(net, node, name) for node in net.wrappers)
+
+
+async def _build_line(net, n: int, store_dir: str) -> None:
+    for i in range(n):
+        net.add_node(
+            f"n{i}",
+            loopback_prefix=f"10.{i}.0.0/24",
+            config_overrides=_CHAOS_OVERRIDES,
+            config_store_path=os.path.join(store_dir, f"n{i}.bin"),
+        )
+    await net.start_all()
+    for i in range(n - 1):
+        net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+
+async def _run_chaos_smoke(
+    store_dir: str, nodes: int, seed: int
+) -> Dict[str, Any]:
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    mesh = ChaosMesh(seed=seed)
+    net = VirtualNetwork(chaos=mesh)
+    report: Dict[str, Any] = {"nodes": nodes, "seed": seed}
+    try:
+        await _build_line(net, nodes, store_dir)
+        await wait_until(_converged(net, nodes), timeout=30.0)
+
+        # -- phase 1: flood-storm damping -------------------------------
+        # flap a (non-adjacency) key fast enough to cross the suppress
+        # limit at the originator; the held key must release with the
+        # LATEST value everywhere
+        flaps = 12
+        client = net.wrappers["n0"].daemon.kvstore_client
+        for i in range(flaps):
+            client.set_key("chaos:flap", f"flap-{i}".encode())
+            await asyncio.sleep(0.02)
+        assert _counter(net, "n0", "kvstore.damping.holds") >= 1, (
+            "flapping key never crossed the damping suppress limit"
+        )
+        assert _counter(net, "n0", "kvstore.damping.suppressed") >= 1
+        final_flap = f"flap-{flaps - 1}".encode()
+
+        def flap_settled() -> bool:
+            if _counter(net, "n0", "kvstore.damping.released") < 1:
+                return False
+            for wrapper in net.wrappers.values():
+                value = wrapper.daemon.kvstore.get_key("chaos:flap")
+                if value is None or value.value != final_flap:
+                    return False
+            return True
+
+        await wait_until(flap_settled, timeout=15.0)
+        report["damping"] = {
+            "holds": _counter(net, "n0", "kvstore.damping.holds"),
+            "suppressed": _counter(net, "n0", "kvstore.damping.suppressed"),
+            "released": _counter(net, "n0", "kvstore.damping.released"),
+        }
+
+        # -- phase 2: seeded loss/delay/reorder/dup/corruption storm ----
+        mesh.set_default(
+            ChaosLinkSpec(
+                loss=0.15,
+                dup=0.15,
+                reorder=0.2,
+                delay_ms=(0.0, 8.0),
+                corrupt=0.05,
+                spark_loss=0.05,
+            )
+        )
+        # one edge gets deterministic corruption so the typed wire-reject
+        # path is exercised regardless of the seed's draws
+        mesh.set_link(
+            "n2",
+            "n3",
+            ChaosLinkSpec(corrupt=1.0, spark_loss=0.0),
+        )
+        for i in range(4):
+            origin = net.wrappers[f"n{i % nodes}"].daemon.kvstore_client
+            origin.set_key(f"chaos:storm-{i}", f"storm-{i}".encode())
+            await asyncio.sleep(0.3)
+        await wait_until(
+            lambda: _counter_sum(net, "kvstore.wire.rejected_total") >= 1,
+            timeout=10.0,
+        )
+        mesh.clear_link("n2", "n3")
+        for i in range(4, 8):
+            origin = net.wrappers[f"n{i % nodes}"].daemon.kvstore_client
+            origin.set_key(f"chaos:storm-{i}", f"storm-{i}".encode())
+            await asyncio.sleep(0.3)
+        # the storm's failures/duplicates must arm adaptive anti-entropy
+        await wait_until(
+            lambda: (
+                _counter_sum(net, "kvstore.anti_entropy.rounds")
+                + _counter_sum(net, "kvstore.anti_entropy.round_failures")
+            )
+            >= 1,
+            timeout=15.0,
+        )
+
+        # -- phase 3: asymmetric partition → quarantine trip ------------
+        # n0's RPCs toward n1 blackhole while n1→n0 and Spark stay clean:
+        # the adjacency survives, so this is precisely the failure class
+        # only the peer-health ladder can see
+        mesh.set_link(
+            "n0",
+            "n1",
+            ChaosLinkSpec(partition=True, spark_loss=0.0),
+        )
+        # keep n0 originating so its flood/full-sync attempts toward n1
+        # keep failing (a silent node never notices a dead direction)
+        for i in range(60):
+            client.set_key(f"chaos:part-{i}", f"part-{i}".encode())
+            await asyncio.sleep(0.25)
+            if _counter(net, "n0", "kvstore.quarantine.trips") >= 1:
+                break
+        else:
+            raise AssertionError(
+                "quarantine never tripped under asymmetric partition"
+            )
+        health = net.wrappers["n0"].daemon.kvstore.get_peer_health()
+        assert health["n1"]["health"] in ("QUARANTINED", "PROBING"), health
+        assert _counter(net, "n0", "kvstore.forensics_dumps") >= 1
+
+        # -- phase 4: heal → probe-driven recovery ----------------------
+        mesh.clear()
+        await wait_until(
+            lambda: _counter(net, "n0", "kvstore.quarantine.recoveries")
+            >= 1,
+            timeout=20.0,
+        )
+
+        def all_healthy() -> bool:
+            for wrapper in net.wrappers.values():
+                for peer in wrapper.daemon.kvstore.get_peer_health().values():
+                    if peer["health"] != "HEALTHY":
+                        return False
+            return True
+
+        await wait_until(all_healthy, timeout=20.0)
+        report["quarantine"] = {
+            "trips": _counter_sum(net, "kvstore.quarantine.trips"),
+            "probes": _counter_sum(net, "kvstore.quarantine.probes"),
+            "recoveries": _counter_sum(net, "kvstore.quarantine.recoveries"),
+            "floods_skipped": _counter_sum(
+                net, "kvstore.quarantine.floods_skipped"
+            ),
+        }
+
+        # -- phase 5: post-heal flooding works end to end ---------------
+        client.set_key("chaos:final", b"after-the-storm")
+
+        def final_everywhere() -> bool:
+            for wrapper in net.wrappers.values():
+                value = wrapper.daemon.kvstore.get_key("chaos:final")
+                if value is None or value.value != b"after-the-storm":
+                    return False
+            return True
+
+        await wait_until(final_everywhere, timeout=20.0)
+
+        # -- phase 6: oracle-equal convergence --------------------------
+        digests = {
+            name: _lsdb_digest(wrapper)
+            for name, wrapper in net.wrappers.items()
+        }
+
+        def stores_identical() -> bool:
+            nonlocal digests
+            digests = {
+                name: _lsdb_digest(wrapper)
+                for name, wrapper in net.wrappers.items()
+            }
+            base = digests["n0"]
+            return all(d == base for d in digests.values())
+
+        await wait_until(stores_identical, timeout=20.0)
+        await wait_until(_converged(net, nodes), timeout=20.0)
+        report["lsdb_keys"] = len(digests["n0"])
+        report["chaos_tables"] = _programmed_tables(net)
+        report["wire_rejects"] = _counter_sum(
+            net, "kvstore.wire.rejected_total"
+        )
+        report["anti_entropy_rounds"] = _counter_sum(
+            net, "kvstore.anti_entropy.rounds"
+        )
+        report["mesh_stats"] = dict(mesh.stats)
+    finally:
+        await net.stop_all()
+
+    # oracle differential: a clean network with the same topology must
+    # program the same route tables (the chaos run may not bend routing)
+    oracle = VirtualNetwork()
+    try:
+        await _build_line(oracle, nodes, os.path.join(store_dir, "oracle"))
+        await wait_until(_converged(oracle, nodes), timeout=30.0)
+        report["oracle_tables"] = _programmed_tables(oracle)
+    finally:
+        await oracle.stop_all()
+    report["oracle_equal"] = (
+        report["chaos_tables"] == report["oracle_tables"]
+    )
+    return report
+
+
+def run_chaos_smoke(nodes: int = 5, seed: int = 1) -> Dict[str, Any]:
+    """Drive the full hostile-network differential; returns the report
+    dict CHAOS_SMOKE asserts on (and raises on any phase failure)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as store_dir:
+        os.makedirs(os.path.join(store_dir, "oracle"), exist_ok=True)
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(
+                _run_chaos_smoke(store_dir, nodes, seed)
+            )
+        finally:
+            loop.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import json
+
+    out = run_chaos_smoke()
+    out.pop("chaos_tables", None)
+    out.pop("oracle_tables", None)
+    print(json.dumps(out, indent=2, default=str))

@@ -570,6 +570,8 @@ def run_bench_convergence(
     churn_value_bytes: int = 4096,
     debounce_ms: Optional[Tuple[float, float]] = None,
     journal: bool = False,
+    chaos_loss: float = 0.0,
+    chaos_seed: int = 1,
 ) -> dict:
     """Hello-to-programmed-route percentiles from an emulator flap run —
     bench.py's second metric line (ROADMAP "relight the benchmark").
@@ -648,7 +650,19 @@ def run_bench_convergence(
         }
         if journal:
             overrides["journal_config"] = {"enabled": True}
-        net = VirtualNetwork()
+        # chaos_loss > 0: the flap batch runs over a seeded lossy fabric
+        # (KvStore RPC loss via testing/chaos.py; Spark stays clean so
+        # adjacency churn is the flaps', not the schedule's) — bench.py's
+        # `convergence_under_loss_p95_ms` line
+        mesh = None
+        if chaos_loss > 0.0:
+            from openr_tpu.testing.chaos import ChaosLinkSpec, ChaosMesh
+
+            mesh = ChaosMesh(seed=chaos_seed)
+            mesh.set_default(
+                ChaosLinkSpec(loss=chaos_loss, spark_loss=0.0)
+            )
+        net = VirtualNetwork(chaos=mesh)
         for i in range(n):
             net.add_node(
                 f"n{i}",
@@ -1087,6 +1101,13 @@ def run_bench_convergence(
                 ),
                 **encode_stats,
             }
+        chaos_stats = {}
+        if mesh is not None:
+            chaos_stats = {
+                "chaos_loss": chaos_loss,
+                "chaos_seed": chaos_seed,
+                "chaos_kv_dropped": mesh.stats.get("kv_dropped", 0),
+            }
         return {
             "nodes": n,
             "flaps": max(1, flaps),
@@ -1099,6 +1120,7 @@ def run_bench_convergence(
             **stream_stats,
             **fleet_stats,
             **journal_stats,
+            **chaos_stats,
         }
 
     loop = asyncio.new_event_loop()

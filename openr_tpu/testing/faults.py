@@ -209,6 +209,8 @@ def injected(injector: Optional[FaultInjector] = None):
 #   fib.keepalive         agent aliveSince poll, ctx=Fib (fib/fib.py)
 #   kvstore.flood_send    per-peer flood RPC, ctx=peer name (kvstore/store.py)
 #   kvstore.full_sync     3-way full-sync dump RPC, ctx=peer name
+#   kvstore.quarantine_probe  quarantined-peer probe dump RPC, ctx=peer name
+#   kvstore.anti_entropy  adaptive anti-entropy digest sync, ctx=peer name
 #   spark.packet_send     outbound datagram seam, ctx=iface (spark/spark.py)
 #   spark.packet_recv     inbound datagram seam, ctx=ReceivedPacket
 #   te.optimize           TE optimization device dispatch (te/service.py)

@@ -79,6 +79,14 @@ class SoakConfig:
     # restart + warm boot — the whole-node churn class; 0 disables).
     # Nodes get per-run configstore files and GR enabled when armed.
     restart_every: int = 0
+    # partition waves: every partition_every-th wave asymmetrically
+    # blackholes one direction of a random line edge through the chaos
+    # mesh (testing/chaos.py) for partition_hold_s, then heals — the
+    # verdict gains `partitions_recovered` (convergence returns after
+    # heal) and `flood_health_attributed` (no fleet flood_health breach
+    # outside a fault/partition interval); 0 disables
+    partition_every: int = 0
+    partition_hold_s: float = 0.5
     seed: int = 7
     # telemetry knobs pushed into every node's monitor_config
     max_event_log: int = 100
@@ -289,6 +297,7 @@ def _judge(
     spans_in_rings: int,
     waves: List[Dict[str, Any]],
     scrapes: Dict[str, Any],
+    fleet_findings: Optional[List[Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """Fold the merged rollup + wave/scrape evidence into the judged
     sections of the soak report (windows, attribution, verdict)."""
@@ -363,6 +372,32 @@ def _judge(
         all(w["converged"] for w in waves),
         f"{sum(1 for w in waves if w['converged'])}/{len(waves)} waves "
         f"converged within deadline",
+    )
+    partition_waves = [w for w in waves if w.get("partitioned")]
+    check(
+        "partitions_recovered",
+        all(w["converged"] for w in partition_waves),
+        f"{sum(1 for w in partition_waves if w['converged'])}/"
+        f"{len(partition_waves)} partition wave(s) re-converged after "
+        f"heal",
+    )
+    flood = [
+        f
+        for f in (fleet_findings or [])
+        if f.get("kind") == "flood_health"
+    ]
+    unattributed = [
+        f
+        for f in flood
+        if not _window_overlaps(
+            float(f.get("ts") or 0.0), 0.0, fault_intervals
+        )
+    ]
+    check(
+        "flood_health_attributed",
+        not unattributed,
+        f"{len(flood)} flood_health breach(es), {len(unattributed)} "
+        f"outside any fault/partition interval",
     )
     check(
         "scrape_health",
@@ -462,7 +497,12 @@ def run_soak(
     arm = arm_chaos if arm_chaos is not None else default_chaos
 
     async def body(store_dir: Optional[str]) -> Dict[str, Any]:
-        net = VirtualNetwork()
+        mesh = None
+        if cfg.partition_every > 0:
+            from openr_tpu.testing.chaos import ChaosMesh
+
+            mesh = ChaosMesh(seed=cfg.seed)
+        net = VirtualNetwork(chaos=mesh)
         overrides: Dict[str, Any] = {
             "monitor_config": {
                 "max_event_log": cfg.max_event_log,
@@ -597,6 +637,30 @@ def run_soak(
                     if chaos:
                         arm(inj, wave_i, cfg)
                         fault_t0 = time.time()
+                    # partition wave: asymmetrically blackhole one
+                    # direction of a random line edge through the chaos
+                    # mesh, hold, heal — the wave's convergence wait
+                    # below then proves recovery after heal
+                    partitioned: List[str] = []
+                    if (
+                        mesh is not None
+                        and (wave_i + 1) % cfg.partition_every == 0
+                    ):
+                        from openr_tpu.testing.chaos import ChaosLinkSpec
+
+                        edge = rng.randrange(0, n - 1)
+                        src, dst = f"n{edge}", f"n{edge + 1}"
+                        part_t0 = time.time()
+                        mesh.set_link(
+                            src,
+                            dst,
+                            ChaosLinkSpec(
+                                partition=True, spark_loss=0.0
+                            ),
+                        )
+                        partitioned.append(f"{src}->{dst}")
+                        await asyncio.sleep(cfg.partition_hold_s)
+                        mesh.clear_link(src, dst)
                     # the OCS bulk reconfiguration: remove up-chords,
                     # add down-chords, all in one batch
                     frames_before = stream_frames_total()
@@ -665,6 +729,11 @@ def run_soak(
                             )
                             inj.disarm(point)
                         fault_intervals.append((fault_t0, time.time()))
+                    if partitioned:
+                        # cover the hold AND the settle: a flood_health
+                        # breach the watchdog stamps just after heal is
+                        # still partition-attributed
+                        fault_intervals.append((part_t0, time.time()))
                     scrape_all()
                     wave_log.append(
                         {
@@ -674,6 +743,7 @@ def run_soak(
                                 f"n{a}-n{b}" for a, b in removed
                             ],
                             "restarted": restarted,
+                            "partitioned": partitioned,
                             "faulted": chaos,
                             "converged": wave_ok,
                             "converge_ms": round(converge_ms, 2),
@@ -732,6 +802,7 @@ def run_soak(
             spans_in_rings=spans_in_rings,
             waves=wave_log,
             scrapes=scrapes.summary(),
+            fleet_findings=(fleet_report or {}).get("findings"),
         )
         return {
             "config": asdict(cfg),
@@ -888,6 +959,10 @@ def run_soak_round(
             converge_timeout_s=max(120.0, 2.5 * nodes),
             fault_every=3,
             restart_every=4,
+            # partition waves ride the round too: one asymmetric
+            # line-edge split per 5th wave, healed after half a second
+            partition_every=5,
+            partition_hold_s=0.5,
             seed=11,
             window_s=8.0,
             stream_scrapes=True,
@@ -1080,6 +1155,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--settle-s", type=float, default=1.0)
     parser.add_argument("--fault-every", type=int, default=2)
     parser.add_argument("--restart-every", type=int, default=0)
+    parser.add_argument(
+        "--partition-every",
+        type=int,
+        default=0,
+        help=(
+            "every Nth wave asymmetrically partitions one line-edge "
+            "direction via the chaos mesh, then heals (0 disables)"
+        ),
+    )
+    parser.add_argument("--partition-hold-s", type=float, default=0.5)
     parser.add_argument("--seed", type=int, default=7)
     parser.add_argument("--window-s", type=float, default=1.0)
     parser.add_argument("--max-event-log", type=int, default=100)
@@ -1146,6 +1231,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         settle_s=args.settle_s,
         fault_every=args.fault_every,
         restart_every=args.restart_every,
+        partition_every=args.partition_every,
+        partition_hold_s=args.partition_hold_s,
         seed=args.seed,
         window_s=args.window_s,
         max_event_log=args.max_event_log,

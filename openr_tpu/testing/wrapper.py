@@ -25,9 +25,18 @@ class VirtualNetwork:
     the thread-ownership analyzer (docs/Analysis.md) enforces that no
     ctrl-reachable path mutates this state from outside."""
 
-    def __init__(self) -> None:
+    def __init__(self, chaos=None) -> None:
         self.io_network = MockIoNetwork()
-        self.kv_transport = InProcessTransport()
+        # with a ChaosMesh the whole fabric — Spark packets and KvStore
+        # RPCs — runs through the seeded chaos schedule (testing/chaos)
+        self.chaos = chaos
+        if chaos is not None:
+            from openr_tpu.testing.chaos import ChaosKvTransport
+
+            self.io_network.chaos = chaos
+            self.kv_transport = ChaosKvTransport(chaos)
+        else:
+            self.kv_transport = InProcessTransport()
         self.wrappers: Dict[str, "OpenrWrapper"] = {}
 
     def add_node(self, name: str, **kw) -> "OpenrWrapper":

@@ -149,6 +149,15 @@ def test_kvstore_keys(ctrl_endpoint, capsys):
     assert "cli-node" in out
 
 
+def test_kvstore_peer_health(ctrl_endpoint, capsys):
+    host, port = ctrl_endpoint
+    assert breeze(host, port, "kvstore", "peer-health") == 0
+    out = capsys.readouterr().out
+    # no peers on the fixture store: the table renders headers only
+    assert "Health" in out
+    assert "Quarantined(ms)" in out
+
+
 def test_kvstore_keys_prefix_filter(ctrl_endpoint, capsys):
     host, port = ctrl_endpoint
     assert breeze(host, port, "kvstore", "keys", "--prefix", "zzz") == 0

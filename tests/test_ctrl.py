@@ -10,7 +10,7 @@ import pytest
 from openr_tpu.ctrl import CtrlClient, CtrlServer
 from openr_tpu.ctrl.client import CtrlError, decode_obj, encode_obj
 from openr_tpu.fib import Fib, FibConfig
-from openr_tpu.kvstore import InProcessTransport, KvStore
+from openr_tpu.kvstore import InProcessTransport, KvStore, PeerSpec
 from openr_tpu.messaging import RWQueue
 from openr_tpu.monitor import LogSample, Monitor
 from openr_tpu.platform import MockFibHandler
@@ -217,6 +217,26 @@ class TestKvStoreApis:
             assert result["key_vals"]["k1"]["hash"] is not None
             await client.close()
             await server.stop()
+
+        run(body())
+
+    def test_get_kvstore_peer_health(self):
+        async def body():
+            transport = InProcessTransport()
+            a = KvStore("a", ["0"], transport)
+            b = KvStore("b", ["0"], transport)
+            a.add_peers({"b": PeerSpec("b")})
+            await asyncio.sleep(0.05)  # let the initial full sync land
+            server, client = await make_server(kvstore=a)
+            health = await client.call("getKvStorePeerHealth")
+            assert set(health) == {"b"}
+            assert health["b"]["health"] == "HEALTHY"
+            assert health["b"]["failures"] == 0
+            assert health["b"]["quarantined_ms"] == 0.0
+            await client.close()
+            await server.stop()
+            a.stop()
+            b.stop()
 
         run(body())
 

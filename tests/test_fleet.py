@@ -319,6 +319,48 @@ class TestRules:
             ("restart_health", "n1"),
         ]
 
+    def test_flood_health_quarantine_and_rejects_breach(self):
+        store = FleetStore()
+        store.record("n0", RATE_PREFIX + "kvstore.quarantine.trips",
+                     1.0, 1.0)
+        store.record("n1", RATE_PREFIX + "kvstore.wire.rejected_total",
+                     1.0, 3.0)
+        findings = evaluate(
+            store, SloConfig(convergence_p95_budget_ms=0.0,
+                             trend_min_windows=0)
+        )
+        assert sorted((f.kind, f.node) for f in findings) == [
+            ("flood_health", "n0"),
+            ("flood_health", "n1"),
+        ]
+        by_node = {f.node: f for f in findings}
+        assert "quarantine trip" in by_node["n0"].detail
+        assert by_node["n1"].evidence["wire_rejects"] == 3.0
+
+    def test_flood_health_duplicate_ratio_gated_by_floor(self):
+        cfg = SloConfig(convergence_p95_budget_ms=0.0,
+                        trend_min_windows=0,
+                        flood_duplicate_budget=0.5,
+                        flood_min_received=8)
+        # under the receive floor: ratio never judged
+        store = FleetStore()
+        store.record("n0", RATE_PREFIX + "kvstore.flood.received", 1.0, 4.0)
+        store.record("n0", RATE_PREFIX + "kvstore.flood.duplicates",
+                     1.0, 4.0)
+        assert evaluate(store, cfg) == []
+        # over the floor and over budget: breach with the ratio named
+        store.record("n0", RATE_PREFIX + "kvstore.flood.received", 2.0, 10.0)
+        store.record("n0", RATE_PREFIX + "kvstore.flood.duplicates",
+                     2.0, 8.0)
+        findings = evaluate(store, cfg)
+        assert [f.kind for f in findings] == ["flood_health"]
+        assert findings[0].evidence["duplicate_ratio"] == 0.8
+        # ratio check disabled by default (<0 budget)
+        assert evaluate(
+            store, SloConfig(convergence_p95_budget_ms=0.0,
+                             trend_min_windows=0)
+        ) == []
+
 
 # ---------------------------------------------------------------------------
 # collector: scrape folding, epochs -> gaps

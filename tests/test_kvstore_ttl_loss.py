@@ -1,0 +1,155 @@
+"""TTL expiry under lost refreshes — the immortal-key regression
+(ISSUE 18).
+
+A key with a finite TTL stays alive only while its originator keeps
+refreshing it. On a hostile network the refreshes get lost but full
+syncs keep succeeding — and a store that serves the ORIGINAL ttl out of
+a dump re-arms a dead originator's key to full lifetime on every sync,
+so the key never ages out anywhere. The fix
+(KvStoreDb._update_publication_ttl) serves the REMAINING lifetime from
+the countdown deadline, so repeated syncs only ever shorten the clock.
+
+The main test is a randomized differential run: the same seeded
+sync-storm schedule executed twice, once with the originator dead (the
+key must expire on every survivor despite continuous re-syncing) and
+once with the originator refreshing (the identical schedule must NOT
+expire the key) — proving expiry is driven by the lost refreshes, not
+by the sync machinery eating live keys.
+"""
+
+import asyncio
+import random
+
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreParams,
+    PeerSpec,
+)
+from openr_tpu.types import Value
+
+
+def run(coro, timeout=30.0):
+    async def body():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.new_event_loop().run_until_complete(body())
+
+
+def make_stores(names):
+    transport = InProcessTransport()
+    return {
+        name: KvStore(
+            name,
+            ["0"],
+            transport,
+            params=KvStoreParams(node_id=name),
+        )
+        for name in names
+    }, transport
+
+
+async def _sync_storm(stores, rng, rounds, gap_s, refresher=None):
+    """Seeded peer-to-peer sync pressure: each round, a random store
+    serves a full dump straight into another — the wire-level shape of a
+    full sync, with zero loss. With `refresher`, the originator also
+    re-advertises the key each round (the healthy-network control arm)."""
+    names = sorted(stores)
+    for i in range(rounds):
+        if refresher is not None:
+            refresher(i)
+        src, dst = rng.sample(names, 2)
+        pub = stores[src].handle_dump("0", None)
+        if pub.key_vals:
+            stores[dst].handle_set_key_vals("0", pub.key_vals, [src])
+        await asyncio.sleep(gap_s)
+
+
+class TestTtlUnderLostRefreshes:
+    def test_differential_dead_vs_refreshing_originator(self):
+        async def arm(refresh, tail_s):
+            stores, _ = make_stores(["a", "b", "c"])
+            stores["a"].add_peers({"b": PeerSpec("b")})
+            stores["b"].add_peers({"c": PeerSpec("c")})
+            await asyncio.sleep(0.05)
+            ttl_ms = 400
+            stores["a"].set_key(
+                "prefix:mortal", Value(1, "origin", b"payload", ttl_ms, 0)
+            )
+            await asyncio.sleep(0.05)
+            for s in stores.values():
+                assert s.get_key("prefix:mortal") is not None
+            refresher = None
+            if refresh:
+                # the originator survives: ttl-refresh (no value body,
+                # bumped ttl_version) re-arms the countdown every round
+                def refresher(i):
+                    stores["a"].set_key(
+                        "prefix:mortal",
+                        Value(1, "origin", None, ttl_ms, i + 1),
+                    )
+
+            # 25 rounds x 40ms = 1s of sync pressure across a 400ms ttl:
+            # every key would be re-armed ~2.5x over if dumps served the
+            # original ttl
+            rng = random.Random(1805)
+            await _sync_storm(
+                stores, rng, rounds=25, gap_s=0.04, refresher=refresher
+            )
+            await asyncio.sleep(tail_s)
+            alive = {
+                name: s.get_key("prefix:mortal") is not None
+                for name, s in stores.items()
+            }
+            expired = {
+                name: s.counters.get("kvstore.expired_key_vals", 0)
+                for name, s in stores.items()
+            }
+            for s in stores.values():
+                s.stop()
+            return alive, expired
+
+        async def body():
+            # dead originator: the same sync schedule must age the key
+            # out everywhere — any survivor still serving it has been
+            # re-armed by a full sync (the immortal-key bug); the 0.6s
+            # tail outlives the final 400ms countdown
+            alive, expired = await arm(refresh=False, tail_s=0.6)
+            assert not any(alive.values()), (
+                f"immortal key: still alive on {alive} after ttl + "
+                f"sync storm with a dead originator"
+            )
+            assert all(n >= 1 for n in expired.values()), expired
+            # refreshing originator, identical seeded schedule: the key
+            # must survive the storm — expiry above is the lost
+            # refreshes, not the sync machinery eating live keys. The
+            # check lands inside the last refresh's 400ms window (the
+            # originator stops with the storm, so a long tail would be
+            # an honest age-out, not a differential signal)
+            alive, _ = await arm(refresh=True, tail_s=0.1)
+            assert all(alive.values()), (
+                f"live key aged out under refreshes: {alive}"
+            )
+
+        run(body())
+
+    def test_dump_serves_remaining_ttl(self):
+        """The unit-level pin for the fix: a dump taken mid-countdown
+        carries the remaining lifetime, never the original."""
+
+        async def body():
+            stores, _ = make_stores(["a"])
+            stores["a"].set_key(
+                "prefix:k", Value(1, "origin", b"x", 1000, 0)
+            )
+            await asyncio.sleep(0.3)
+            pub = stores["a"].handle_dump("0", None)
+            served = pub.key_vals["prefix:k"].ttl
+            assert served < 1000, "dump re-armed the key to full ttl"
+            assert 400 <= served <= 750, served
+            # the stored value keeps the ORIGINAL ttl (the countdown is
+            # tracked separately); only the wire copy is rewritten
+            assert stores["a"].get_key("prefix:k").ttl == 1000
+            stores["a"].stop()
+
+        run(body())

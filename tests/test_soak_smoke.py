@@ -7,7 +7,7 @@ deliberately tiny LogSample ring only retains a tail (the eviction-proof
 invariant), every scrape parses as valid exposition with full registry
 coverage, and the verdict block carries every check."""
 
-from openr_tpu.testing.soak import run_soak_smoke
+from openr_tpu.testing.soak import SoakConfig, run_soak, run_soak_smoke
 
 
 def test_soak_smoke():
@@ -25,3 +25,32 @@ def test_soak_smoke():
     )
     assert report["faults"]["fired"]["fib.program"] == 1
     assert len(report["waves"]) == 1 and report["waves"][0]["converged"]
+
+
+def test_soak_partition_wave():
+    """--partition-every wave type: one asymmetric line-edge split via
+    the chaos mesh, healed after partition_hold_s — convergence must
+    recover and the verdict must carry the partition checks."""
+    report = run_soak(
+        SoakConfig(
+            nodes=3,
+            waves=1,
+            settle_s=0.3,
+            fault_every=0,
+            partition_every=1,
+            partition_hold_s=0.3,
+            seed=5,
+            window_s=0.5,
+        )
+    )
+    wave = report["waves"][0]
+    assert len(wave["partitioned"]) == 1 and "->" in wave["partitioned"][0]
+    assert wave["converged"] is True
+    checks = report["verdict"]["checks"]
+    assert checks["partitions_recovered"]["ok"] is True
+    assert "1/1 partition wave(s)" in checks["partitions_recovered"]["detail"]
+    assert checks["flood_health_attributed"]["ok"] is True
+    # the partition interval is recorded as a fault interval, so any
+    # p95 effect inside it is attributed, never a clean trend break
+    assert len(report["faults"]["intervals"]) == 1
+    assert report["verdict"]["pass"] is True

@@ -1,0 +1,313 @@
+"""Seeded fuzz of the KvStore wire-decode hardening (ISSUE 18).
+
+The dissemination plane's decode surface — the JSON peer codecs
+(openr_tpu.kvstore.wire), the native record codec
+(openr_tpu.kvstore.native._unpack_records), and the TCP peer server's
+request loop — must reject every hostile frame with a *typed* error
+(WireDecodeError / NativeDecodeError, kind in the four-kind vocabulary)
+and never let one escape as an uncaught exception. The live-server test
+then proves the property that matters operationally: a connection that
+feeds the server garbage keeps getting answers, the store loop never
+dies, and every rejection lands on the kvstore.wire.rejected.* counters.
+
+All generation is seeded (random.Random) so a failure replays exactly.
+"""
+
+import asyncio
+import base64
+import json
+import random
+
+from openr_tpu.kvstore import KvStore, KvStoreParams
+from openr_tpu.kvstore.native import NativeDecodeError, _pack_records
+from openr_tpu.kvstore.native import _unpack_records
+from openr_tpu.kvstore.tcp import KvStoreTcpServer, TcpTransport
+from openr_tpu.kvstore.wire import (
+    MAX_KEY_CHARS,
+    WireDecodeError,
+    dual_messages_from_json,
+    key_vals_from_json,
+    key_vals_to_json,
+    publication_from_json,
+    publication_to_json,
+    value_from_json,
+)
+from openr_tpu.types import TTL_INFINITY, Publication, Value, generate_hash
+
+KINDS = {"oversized", "truncated", "malformed", "hash_mismatch"}
+
+
+def _random_json(rng: random.Random, depth: int = 0):
+    """A random JSON-ish value tree — the shapes a corrupted or hostile
+    peer can actually put on the wire after json.loads succeeds."""
+    roll = rng.random()
+    if depth >= 3 or roll < 0.45:
+        return rng.choice(
+            [
+                None,
+                True,
+                False,
+                rng.randint(-(2**40), 2**40),
+                rng.random() * 1e6,
+                "",
+                "originator",
+                "not base64 !!!",
+                base64.b64encode(b"payload").decode(),
+                "x" * rng.choice([1, 64, MAX_KEY_CHARS + 1]),
+            ]
+        )
+    if roll < 0.75:
+        return {
+            rng.choice(
+                [
+                    "version",
+                    "originator_id",
+                    "value",
+                    "ttl",
+                    "ttl_version",
+                    "hash",
+                    "key_vals",
+                    "node_ids",
+                    "expired_keys",
+                    "perf_events",
+                    "messages",
+                    "src_id",
+                    "k" * rng.randint(1, 8),
+                ]
+            ): _random_json(rng, depth + 1)
+            for _ in range(rng.randint(0, 4))
+        }
+    return [_random_json(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+
+
+def _valid_publication() -> dict:
+    kv = {}
+    for i in range(4):
+        value = f"payload-{i}".encode()
+        kv[f"adj:node{i}"] = Value(
+            version=i + 1,
+            originator_id=f"node{i}",
+            value=value,
+            ttl=TTL_INFINITY,
+            ttl_version=0,
+            hash=generate_hash(i + 1, f"node{i}", value),
+        )
+    pub = Publication(
+        key_vals=kv,
+        expired_keys=[],
+        node_ids=["node0", "node1"],
+        tobe_updated_keys=None,
+        area="0",
+    )
+    return publication_to_json(pub)
+
+
+class TestJsonDecodeFuzz:
+    def test_random_trees_reject_typed_only(self):
+        """400 seeded random trees through every peer-facing decoder:
+        success or a typed WireDecodeError — nothing else escapes."""
+        rng = random.Random(1318)
+        decoders = [
+            value_from_json,
+            key_vals_from_json,
+            publication_from_json,
+            dual_messages_from_json,
+        ]
+        for i in range(400):
+            tree = _random_json(rng)
+            for decode in decoders:
+                try:
+                    decode(tree)
+                except WireDecodeError as exc:
+                    assert exc.kind in KINDS, (
+                        f"iter {i}: {decode.__name__} raised untyped "
+                        f"kind {exc.kind!r} on {tree!r}"
+                    )
+                except Exception as exc:  # the property under test
+                    raise AssertionError(
+                        f"iter {i}: {decode.__name__} leaked "
+                        f"{type(exc).__name__}: {exc} on {tree!r}"
+                    ) from exc
+
+    def test_bit_flipped_valid_frames(self):
+        """Byte-level mutation of a valid hashed publication: every
+        mutant either fails json.loads (the transport counts that as
+        malformed), decodes with a typed rejection — including
+        hash_mismatch when the flip lands inside a value body — or
+        happens to still be a valid frame. No uncaught exceptions."""
+        frame = json.dumps(_valid_publication()).encode()
+        rng = random.Random(77)
+        saw_hash_mismatch = False
+        for i in range(400):
+            buf = bytearray(frame)
+            for _ in range(rng.randint(1, 4)):
+                pos = rng.randrange(len(buf))
+                buf[pos] ^= 1 << rng.randrange(8)
+            try:
+                tree = json.loads(bytes(buf))
+            except ValueError:
+                continue  # tcp.py _serve_conn: note_reject("malformed")
+            try:
+                publication_from_json(tree)
+            except WireDecodeError as exc:
+                assert exc.kind in KINDS, f"iter {i}: kind {exc.kind!r}"
+                saw_hash_mismatch |= exc.kind == "hash_mismatch"
+            except Exception as exc:
+                raise AssertionError(
+                    f"iter {i}: leaked {type(exc).__name__}: {exc} "
+                    f"on {bytes(buf)!r}"
+                ) from exc
+        # the end-to-end integrity check must actually fire under
+        # mutation (this is the path that carries corrupted bodies past
+        # base64 — a regression here silently admits bit-rotted values)
+        assert saw_hash_mismatch
+
+    def test_oversized_key_and_value_rejected(self):
+        with_key = {"x" * (MAX_KEY_CHARS + 1): {"version": 1,
+                                                "originator_id": "a"}}
+        try:
+            key_vals_from_json(with_key)
+            raise AssertionError("oversized key admitted")
+        except WireDecodeError as exc:
+            assert exc.kind == "oversized"
+
+
+class TestNativeDecodeFuzz:
+    def _valid_buf(self) -> bytes:
+        kv = {
+            f"prefix:node{i}": Value(
+                i + 1, f"node{i}", b"v" * (i + 1), TTL_INFINITY, 0,
+                hash=i * 7,
+            )
+            for i in range(5)
+        }
+        return _pack_records(kv)
+
+    def test_every_truncation_is_typed(self):
+        """Cut the packed record stream at every byte boundary: each
+        prefix must decode or raise a typed NativeDecodeError — never an
+        IndexError/struct.error from an unguarded read."""
+        buf = self._valid_buf()
+        assert len(_unpack_records(buf)) == 5
+        for cut in range(len(buf)):
+            try:
+                _unpack_records(buf[:cut])
+            except NativeDecodeError as exc:
+                assert exc.kind in KINDS, f"cut {cut}: kind {exc.kind!r}"
+            except Exception as exc:
+                raise AssertionError(
+                    f"cut {cut}: leaked {type(exc).__name__}: {exc}"
+                ) from exc
+
+    def test_seeded_bit_flips_are_typed(self):
+        buf = self._valid_buf()
+        rng = random.Random(4242)
+        for i in range(500):
+            mut = bytearray(buf)
+            for _ in range(rng.randint(1, 6)):
+                pos = rng.randrange(len(mut))
+                mut[pos] ^= 1 << rng.randrange(8)
+            try:
+                _unpack_records(bytes(mut))
+            except NativeDecodeError as exc:
+                assert exc.kind in KINDS, f"iter {i}: kind {exc.kind!r}"
+            except Exception as exc:
+                raise AssertionError(
+                    f"iter {i}: leaked {type(exc).__name__}: {exc} "
+                    f"on flip of {bytes(mut)!r}"
+                ) from exc
+
+
+class TestTcpServerSurvivesGarbage:
+    def test_garbage_then_service(self):
+        """A live KvStoreTcpServer fed hostile frames on a raw socket:
+        every garbage line gets an error reply (the connection and the
+        store loop survive), typed rejections land on the
+        kvstore.wire.rejected.* counters, and a well-formed kv.set on
+        the same battered connection still updates the store."""
+
+        async def body():
+            store = KvStore(
+                "victim",
+                ["0"],
+                TcpTransport(),
+                params=KvStoreParams(node_id="victim"),
+            )
+            server = KvStoreTcpServer(store)
+            await server.start()
+            reader, writer = await asyncio.open_connection(
+                server.host, server.port
+            )
+
+            async def exchange(line: bytes) -> dict:
+                writer.write(line + b"\n")
+                await writer.drain()
+                return json.loads(await reader.readline())
+
+            # not JSON at all
+            reply = await exchange(b"\x00\xffnot json at all")
+            assert "error" in reply
+            # JSON, but no method
+            reply = await exchange(json.dumps({"id": 1}).encode())
+            assert "error" in reply
+            # typed decode rejections through the kv.set dispatch path
+            hostile = [
+                # oversized key
+                {"x" * (MAX_KEY_CHARS + 1): {"version": 1,
+                                             "originator_id": "a"}},
+                # truncated value frame
+                {"k": {"version": 1}},
+                # bad base64 body
+                {"k": {"version": 1, "originator_id": "a",
+                       "value": "!!! not b64"}},
+                # hash over different bytes
+                {"k": {"version": 1, "originator_id": "a",
+                       "value": base64.b64encode(b"body").decode(),
+                       "hash": 1}},
+            ]
+            for i, key_vals in enumerate(hostile):
+                reply = await exchange(
+                    json.dumps(
+                        {
+                            "id": 10 + i,
+                            "method": "kv.set",
+                            "params": {"area": "0", "key_vals": key_vals},
+                        }
+                    ).encode()
+                )
+                assert "error" in reply, f"hostile frame {i} was admitted"
+            # seeded printable garbage for good measure
+            rng = random.Random(9)
+            for _ in range(50):
+                junk = bytes(
+                    rng.randrange(32, 127) for _ in range(rng.randint(1, 80))
+                )
+                reply = await exchange(junk)
+                assert "error" in reply or "result" in reply
+            counters = store.counters
+            assert counters["kvstore.wire.rejected_total"] >= 4
+            for kind in KINDS:
+                assert counters[f"kvstore.wire.rejected.{kind}"] >= 1, kind
+            # the same connection still provides service
+            good = Value(1, "peer", b"alive", TTL_INFINITY, 0)
+            reply = await exchange(
+                json.dumps(
+                    {
+                        "id": 99,
+                        "method": "kv.set",
+                        "params": {
+                            "area": "0",
+                            "key_vals": key_vals_to_json({"ok": good}),
+                            "node_ids": ["peer"],
+                        },
+                    }
+                ).encode()
+            )
+            assert reply.get("result") == {}
+            assert store.get_key("ok").value == b"alive"
+            writer.close()
+            await server.stop()
+
+        asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(body(), 30.0)
+        )
