@@ -1,9 +1,12 @@
 """Project-specific static analysis suite (docs/Analysis.md).
 
-Seven rule families encode this repo's invariants, sharing two pieces of
+Eleven rule families encode this repo's invariants, sharing two pieces of
 interprocedural infrastructure (v2.0 — "DeepFlow"): a whole-package call
 graph (analysis/callgraph.py) and a light intraprocedural alias/escape
-dataflow engine (analysis/dataflow.py).
+dataflow engine (analysis/dataflow.py) — plus, since v3.0, the ShapeFlow
+abstract interpreter (analysis/shapeflow.py) that propagates symbolic
+shapes, dtypes, and the INF-sentinel lattice through the traced kernel
+set, seeded from @shape_contract annotations (utils/shape_contract.py).
 
   - trace-safety:    no host syncs / Python branches on traced values in
                      jax.jit-reachable code — reachability crosses module
@@ -23,8 +26,19 @@ dataflow engine (analysis/dataflow.py).
   - registry-drift:  counters/histograms, fault points, LogSample events,
                      DecisionConfigSection knobs AND the docs/Analysis.md
                      rule table match their code registries
+  - shape-mismatch:  provable broadcast/rank conflicts, shape-contract
+                     violations at call/return seams, unguarded tile
+                     splits, unreserved frontier padding slots
+  - sentinel-overflow: int32 adds of two maybe-INF values with no
+                     dominating jnp.minimum(..., INF) clamp — the (min,+)
+                     kernel hazard class
+  - dtype-promotion: silent int->float promotion, bool masks in
+                     arithmetic, int true division, float64 in traced code
+  - collective-conformance: lax.ppermute/psum axis names checked against
+                     the mesh axis vocabulary; ppermute perms must be
+                     well-formed permutations
 
-Run it:  python -m openr_tpu.analysis [paths] [--strict] [--json]
+Run it:  python -m openr_tpu.analysis [paths] [--strict] [--json|--sarif]
          python -m openr_tpu.analysis --changed   (diff-scoped fast path)
          python -m openr_tpu.analysis --update-baseline
 Tier-1:  tests/test_analysis.py self-runs the suite over openr_tpu/.
@@ -39,6 +53,7 @@ from openr_tpu.analysis.core import (  # noqa: F401
     Rule,
     build_context,
     render_json,
+    render_sarif,
     render_text,
     rule_catalog,
     run_analysis,
@@ -52,6 +67,7 @@ from openr_tpu.analysis import (  # noqa: F401  (registration side effect)
     recompile_risk,
     registry_drift,
     shard_spec,
+    shapeflow,
     thread_ownership,
     trace_safety,
 )
@@ -67,7 +83,8 @@ def get_analysis_info() -> dict:
     they were linted against, and — when an analysis ran in this process
     (the tier-1 self-run, a --changed pre-commit pass) — what it cost:
     per-rule finding counts and wall time, observable like every other
-    cost in this codebase."""
+    cost in this codebase. When the run included the ShapeFlow pass, its
+    contract/function counts ride along as analysis_contracts."""
     info = {
         "analysis_version": ANALYSIS_VERSION,
         "analysis_rules": rule_names(),
@@ -79,4 +96,6 @@ def get_analysis_info() -> dict:
             name: dict(stats)
             for name, stats in LAST_RUN_STATS["per_rule"].items()
         }
+        if "shapeflow" in LAST_RUN_STATS:
+            info["analysis_contracts"] = dict(LAST_RUN_STATS["shapeflow"])
     return info

@@ -30,6 +30,7 @@ from openr_tpu.analysis.core import (
     call_name,
     dotted_name,
     register,
+    walk_nodes,
 )
 
 _SOCKET_METHODS = {"recv", "recvfrom", "accept", "sendall", "makefile"}
@@ -69,7 +70,7 @@ _BLOCKING_MODULE_CALLS = {
 
 
 def _async_defs(tree: ast.AST) -> Iterable[ast.AsyncFunctionDef]:
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.AsyncFunctionDef):
             yield node
 
@@ -77,7 +78,7 @@ def _async_defs(tree: ast.AST) -> Iterable[ast.AsyncFunctionDef]:
 def _awaited_calls(fn) -> Set[int]:
     """id()s of Call nodes that are directly awaited (await x.recv())."""
     out: Set[int] = set()
-    for node in ast.walk(fn):
+    for node in walk_nodes(fn):
         if isinstance(node, ast.Await) and isinstance(
             node.value, ast.Call
         ):
@@ -98,7 +99,7 @@ class BlockingCallRule(Rule):
         for sf in ctx.files:
             for fn in _async_defs(sf.tree):
                 awaited = _awaited_calls(fn)
-                for node in ast.walk(fn):
+                for node in walk_nodes(fn):
                     if not isinstance(node, ast.Call):
                         continue
                     yield from self._check_call(sf, fn, node, awaited)

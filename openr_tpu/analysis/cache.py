@@ -16,6 +16,15 @@ just re-parses.
 
 Hit/miss counts surface in the `--changed` stderr note and, under
 `--json`, as the `callgraph_cache` footer of the report.
+
+The same file also persists ShapeFlow's inferred per-function summaries
+(which parameters live in the sentinel domain), keyed per file-sha under a
+`shapeflow` section. Both sections invalidate wholesale when either the
+cache schema (CACHE_VERSION) or the analysis semantics (ANALYSIS_VERSION)
+change; the shapeflow section additionally invalidates when any
+@shape_contract annotation in the analyzed set is edited (contracts are
+summary inputs — a changed contract changes every inference downstream of
+the annotated callee).
 """
 
 from __future__ import annotations
@@ -29,6 +38,42 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 CACHE_VERSION = 1
 CACHE_NAME = ".analysis-cache.json"
+
+
+def _read_payload(cache_path: Optional[Path]) -> Dict:
+    """The whole cache payload, or {} when missing, corrupt, or stale.
+    Staleness covers both the cache schema (CACHE_VERSION) and the
+    analysis semantics (ANALYSIS_VERSION): a rule-semantics bump must not
+    serve summaries computed under the old semantics."""
+    from openr_tpu.analysis.core import ANALYSIS_VERSION
+
+    if cache_path is None or not cache_path.exists():
+        return {}
+    try:
+        payload = json.loads(cache_path.read_text())
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(payload, dict):
+        return {}
+    if payload.get("version") != CACHE_VERSION:
+        return {}
+    if payload.get("analysis_version") != ANALYSIS_VERSION:
+        return {}
+    return payload
+
+
+def _write_payload(cache_path: Path, payload: Dict) -> None:
+    from openr_tpu.analysis.core import ANALYSIS_VERSION
+
+    payload = dict(payload)
+    payload["version"] = CACHE_VERSION
+    payload["analysis_version"] = ANALYSIS_VERSION
+    tmp = cache_path.with_name(cache_path.name + ".tmp")
+    try:
+        tmp.write_text(json.dumps(payload, sort_keys=True))
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # read-only checkout: next run re-parses, nothing breaks
 
 
 def _module_name_of(rel: str) -> str:
@@ -62,14 +107,8 @@ def load_import_graph(
     cache where possible. Returns ({module: {"path", "deps"}}, stats) with
     stats = {"hits", "misses", "files"}; when cache_path is set the cache
     file is rewritten with the refreshed entries (best-effort)."""
-    entries: Dict[str, Dict] = {}
-    if cache_path is not None and cache_path.exists():
-        try:
-            cached = json.loads(cache_path.read_text())
-            if cached.get("version") == CACHE_VERSION:
-                entries = cached.get("files", {})
-        except (OSError, ValueError):
-            entries = {}
+    payload = _read_payload(cache_path)
+    entries: Dict[str, Dict] = payload.get("files", {})
     graph: Dict[str, Dict] = {}
     new_entries: Dict[str, Dict] = {}
     hits = misses = 0
@@ -100,20 +139,55 @@ def load_import_graph(
         new_entries[rel] = {"hash": digest, "module": module, "deps": deps}
         graph[module] = {"path": path, "rel": rel, "deps": deps}
     if cache_path is not None:
-        _write_cache(cache_path, new_entries)
+        payload["files"] = new_entries  # other sections ride along
+        _write_payload(cache_path, payload)
     return graph, {"hits": hits, "misses": misses, "files": hits + misses}
 
 
-def _write_cache(cache_path: Path, entries: Dict[str, Dict]) -> None:
-    payload = json.dumps(
-        {"version": CACHE_VERSION, "files": entries}, sort_keys=True
-    )
-    tmp = cache_path.with_name(cache_path.name + ".tmp")
-    try:
-        tmp.write_text(payload)
-        os.replace(tmp, cache_path)
-    except OSError:
-        pass  # read-only checkout: next run re-parses, nothing breaks
+def load_shapeflow_summaries(
+    cache_path: Optional[Path],
+    analysis_version: str,
+    contracts_fp: str,
+) -> Dict[str, Dict]:
+    """Cached shapeflow inference summaries ({rel: {"hash", "functions"}}),
+    valid only when the cache carries the current ANALYSIS_VERSION and the
+    current contracts fingerprint — an edit to any @shape_contract
+    invalidates every inferred summary."""
+    payload = _read_payload(cache_path)
+    if payload.get("analysis_version") not in (None, analysis_version):
+        return {}
+    section = payload.get("shapeflow")
+    if not isinstance(section, dict):
+        return {}
+    if section.get("contracts_fp") != contracts_fp:
+        return {}
+    files = section.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def store_shapeflow_summaries(
+    cache_path: Optional[Path],
+    analysis_version: str,
+    contracts_fp: str,
+    summaries: Dict[str, Dict],
+) -> None:
+    """Merge this run's summaries into the cache (best-effort). Entries
+    from a still-valid prior section are kept — a subset run must not
+    evict summaries for files it did not analyze."""
+    if cache_path is None:
+        return
+    payload = _read_payload(cache_path)
+    merged: Dict[str, Dict] = {}
+    prior = payload.get("shapeflow")
+    if (
+        isinstance(prior, dict)
+        and prior.get("contracts_fp") == contracts_fp
+        and isinstance(prior.get("files"), dict)
+    ):
+        merged.update(prior["files"])
+    merged.update(summaries)
+    payload["shapeflow"] = {"contracts_fp": contracts_fp, "files": merged}
+    _write_payload(cache_path, payload)
 
 
 def dependents_closure(

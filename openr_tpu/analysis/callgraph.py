@@ -38,6 +38,7 @@ from openr_tpu.analysis.core import (
     SourceFile,
     call_name,
     dotted_name,
+    walk_nodes,
 )
 
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -129,11 +130,11 @@ def returned_local_defs(fn: ast.AST) -> List[ast.AST]:
     `jax.jit(factory(...), ...)` call sites."""
     nested = {
         n.name: n
-        for n in ast.walk(fn)
+        for n in walk_nodes(fn)
         if isinstance(n, _FuncDef) and n is not fn
     }
     out: List[ast.AST] = []
-    for node in ast.walk(fn):
+    for node in walk_nodes(fn):
         if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
             target = nested.get(node.value.id)
             if target is not None:
@@ -149,7 +150,7 @@ def scan_imports(
     (openr_tpu/analysis/cache.py) key their dependency edges on."""
     from_imports: Dict[str, Tuple[str, str]] = {}
     module_aliases: Dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.ImportFrom):
             if node.module and node.level == 0:
                 for a in node.names:
@@ -220,6 +221,11 @@ class CallGraph:
     def info(self, fn_node: ast.AST) -> Optional[FunctionInfo]:
         return self._fn_by_node.get(id(fn_node))
 
+    def functions(self) -> Iterable[FunctionInfo]:
+        """Every indexed function definition across the analyzed set
+        (shapeflow scans these for @shape_contract annotations)."""
+        return self._fn_by_node.values()
+
     # -- jit-artifact classification -------------------------------------
 
     def _classify_jit_artifacts(self) -> None:
@@ -254,7 +260,7 @@ class CallGraph:
                         changed = True
 
     def _returns_jit_callable(self, fn) -> bool:
-        for node in ast.walk(fn):
+        for node in walk_nodes(fn):
             if (
                 isinstance(node, ast.Return)
                 and isinstance(node.value, ast.Call)
@@ -289,7 +295,7 @@ class CallGraph:
                     return True
             return False
 
-        for node in ast.walk(fn):
+        for node in walk_nodes(fn):
             if isinstance(node, ast.Assign) and isinstance(
                 node.value, ast.Call
             ):
@@ -308,7 +314,7 @@ class CallGraph:
                             jit_locals.add(t.id)
                         elif call_is_device(node.value):
                             dev_locals.add(t.id)
-        for node in ast.walk(fn):
+        for node in walk_nodes(fn):
             if isinstance(node, ast.Return) and node.value is not None:
                 v = node.value
                 if isinstance(v, ast.Call) and call_is_device(v):

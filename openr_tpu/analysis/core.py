@@ -31,7 +31,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 # 2.0: interprocedural dataflow — whole-package call graph (cross-module
 # trace-safety reachability), alias/escape-aware thread-ownership, and the
 # device-transfer / recompile-risk / shard-spec rule families
-ANALYSIS_VERSION = "2.0.0"
+# 3.0: ShapeFlow abstract interpretation — symbolic shape/dtype/sentinel
+# propagation over the traced kernel set, @shape_contract seeding, and the
+# shape-mismatch / sentinel-overflow / dtype-promotion /
+# collective-conformance rule families
+ANALYSIS_VERSION = "3.0.0"
 
 # per-rule finding counts + wall time of the most recent run_analysis in
 # this process — surfaced through utils/build_info.get_build_info so
@@ -66,6 +70,24 @@ class Finding:
             "message": self.message,
             "severity": self.severity,
         }
+
+
+def walk_nodes(tree: ast.AST) -> tuple:
+    """`tuple(ast.walk(tree))`, memoized on the tree object itself.
+
+    Every rule sweeps the same 130 parsed modules; re-walking each tree
+    per rule is the single largest cost of a full-package run. Rules
+    never mutate trees, so the flat node tuple (same BFS order as
+    ast.walk) is safe to share — it lives exactly as long as the tree.
+    """
+    cached = getattr(tree, "_openr_all_nodes", None)
+    if cached is None:
+        cached = tuple(ast.walk(tree))
+        try:
+            tree._openr_all_nodes = cached  # type: ignore[attr-defined]
+        except AttributeError:
+            pass  # slotted node type: fall through uncached
+    return cached
 
 
 @dataclass
@@ -374,6 +396,13 @@ def run_analysis(
             "per_rule": per_rule,
         }
     )
+    try:  # shapeflow pass stats (contract/function counts) ride along
+        from openr_tpu.analysis.shapeflow import LAST_SHAPEFLOW_STATS
+
+        if LAST_SHAPEFLOW_STATS:
+            LAST_RUN_STATS["shapeflow"] = dict(LAST_SHAPEFLOW_STATS)
+    except ImportError:  # pragma: no cover - shapeflow always ships
+        pass
     return result
 
 
@@ -406,6 +435,61 @@ def render_text(result: Dict) -> str:
 def render_json(result: Dict) -> str:
     payload = dict(result)
     payload["findings"] = [f.to_dict() for f in result["findings"]]
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def render_sarif(result: Dict) -> str:
+    """SARIF 2.1.0 rendering of a run, so findings annotate diffs in CI.
+
+    Only the reporting format changes: the finding set, severities, and
+    the exit-code contract are exactly those of --json / text output.
+    Advisory findings map to SARIF "warning", errors to "error"."""
+    rules = [
+        {
+            "id": r["name"],
+            "shortDescription": {"text": r["description"]},
+            "defaultConfiguration": {
+                "level": "error" if r["severity"] == "error" else "warning",
+            },
+        }
+        for r in rule_catalog()
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f"[{f.check}] {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in result["findings"]
+    ]
+    payload = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "openr-tpu-analysis",
+                        "version": ANALYSIS_VERSION,
+                        "informationUri": "docs/Analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
     return json.dumps(payload, indent=2, sort_keys=True)
 
 

@@ -505,6 +505,11 @@ def _expr_desc(node: ast.AST, depth: int = 0) -> str:
     return "<expr>"
 
 
+# public alias: shapeflow builds its finding messages with the same
+# renderer so sentinel/dtype findings read like the dataflow ones
+expr_desc = _expr_desc
+
+
 def alias_chain_text(alias: Alias) -> str:
     """'self.x via d = self.x (line 12)' rendering for finding messages."""
     base = (

@@ -54,6 +54,7 @@ from openr_tpu.analysis.core import (
     call_name,
     dotted_name,
     register,
+    walk_nodes,
 )
 from openr_tpu.analysis.dataflow import AliasTracker, alias_chain_text
 from openr_tpu.analysis.trace_safety import (
@@ -70,7 +71,7 @@ def _accounts_transfer(fn) -> bool:
     (`self.d2h_bytes += xfer` — the 'sanctioned seam' contract the
     DeltaPath extraction established; free functions hand a `d2h_bytes`
     local to their caller's counters instead)."""
-    for node in ast.walk(fn):
+    for node in walk_nodes(fn):
         target = None
         if isinstance(node, ast.AugAssign):
             target = node.target
@@ -209,7 +210,7 @@ class DeviceTransferRule(Rule):
             # whose returns carry device values (the past-function-
             # boundary extension); methods map onto their class env
             method_env: dict = {}
-            for cls in ast.walk(mod.sf.tree):
+            for cls in walk_nodes(mod.sf.tree):
                 if isinstance(cls, ast.ClassDef):
                     env = _class_device_env(cls, classify, np_aliases)
                     for node in cls.body:

@@ -40,6 +40,7 @@ from openr_tpu.analysis.core import (
     Rule,
     call_name,
     register,
+    walk_nodes,
 )
 
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -60,7 +61,7 @@ class _Boundedness:
     def __init__(self, enclosing) -> None:
         self.assignments: Dict[str, List[ast.AST]] = {}
         if enclosing is not None:
-            for node in ast.walk(enclosing):
+            for node in walk_nodes(enclosing):
                 if isinstance(node, ast.Assign):
                     for t in node.targets:
                         if isinstance(t, ast.Name):
@@ -126,12 +127,12 @@ class RecompileRiskRule(Rule):
         for mod in cg.modules.values():
             # enclosing-function map for local-assignment resolution
             enclosing_of: Dict[int, ast.AST] = {}
-            for fn in ast.walk(mod.sf.tree):
+            for fn in walk_nodes(mod.sf.tree):
                 if isinstance(fn, _FuncDef):
-                    for sub in ast.walk(fn):
+                    for sub in walk_nodes(fn):
                         if isinstance(sub, ast.Call):
                             enclosing_of.setdefault(id(sub), fn)
-            for node in ast.walk(mod.sf.tree):
+            for node in walk_nodes(mod.sf.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 callee = None

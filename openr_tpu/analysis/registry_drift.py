@@ -47,6 +47,7 @@ from openr_tpu.analysis.core import (
     Rule,
     SourceFile,
     register,
+    walk_nodes,
 )
 
 MIXINS = {"CountersMixin", "HistogramsMixin"}
@@ -110,7 +111,7 @@ def _mixin_classes(ctx: AnalysisContext) -> Set[str]:
     """Names of classes inheriting a mixin, transitively by simple name."""
     bases: Dict[str, Set[str]] = {}
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if isinstance(node, ast.ClassDef):
                 bases[node.name] = set(_base_names(node))
     users = set(MIXINS)
@@ -145,12 +146,12 @@ def collect_emitted_names(
     mixin_users = _mixin_classes(ctx)
     found: List[Tuple[str, SourceFile, int]] = []
     for sf in ctx.files:
-        for cls in ast.walk(sf.tree):
+        for cls in walk_nodes(sf.tree):
             if not (
                 isinstance(cls, ast.ClassDef) and cls.name in mixin_users
             ):
                 continue
-            for node in ast.walk(cls):
+            for node in walk_nodes(cls):
                 name = None
                 if (
                     isinstance(node, ast.Call)
@@ -179,7 +180,7 @@ def collect_histogram_names(
     """Literal first args of _observe/_timer anywhere in scope."""
     found = []
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -201,7 +202,7 @@ def _string_universe(ctx: AnalysisContext) -> Tuple[Set[str], Set[str]]:
     exact: Set[str] = set()
     prefixes: Set[str] = set()
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if isinstance(node, ast.Constant) and isinstance(
                 node.value, str
             ):
@@ -306,7 +307,7 @@ def collect_log_events(
                 and isinstance(node.value.value, str)
             ):
                 consts[node.targets[0].id] = node.value.value
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -394,7 +395,7 @@ def collect_fault_points(
     for sf in ctx.files:
         if sf.rel.endswith("testing/faults.py"):
             continue  # the harness itself, not a declaration site
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if (
                 isinstance(node, ast.Call)
                 and (
@@ -420,7 +421,7 @@ def _decision_config_fields(
 ) -> List[Tuple[str, SourceFile, int]]:
     fields = []
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if (
                 isinstance(node, ast.ClassDef)
                 and node.name == "DecisionConfigSection"

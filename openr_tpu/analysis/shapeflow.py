@@ -1,0 +1,1814 @@
+"""ShapeFlow: abstract shape/dtype/sentinel interpretation of traced kernels.
+
+The trace-safety rule knows WHICH functions are jit-reachable; nothing so
+far checks WHAT flows through them. This module is the missing layer: an
+abstract interpreter that walks every traced (and every @shape_contract-
+annotated) function propagating three fact domains per value —
+
+  symbolic shapes   dims named from function params, contract symbols, and
+                    module constants (``s_pad``, ``n_pad``, ``B``, ``h``);
+                    unified with a union-find (DimEnv), so ``[B, B]`` from
+                    one operand and ``[128, B]`` from another either agree
+                    or produce a finding;
+  dtypes            contract-declared or literal-derived, with weak-type
+                    modeling (a Python ``2`` does not promote ``int32``;
+                    a Python ``1.5`` does);
+  sentinel lattice  where a value sits relative to the repo's int32
+                    infinity (``INF = 1 << 29``, float analog ``F_INF``):
+                    ``lt-inf`` < INF, ``eq-inf`` == INF, ``maybe-inf``
+                    <= INF, and the overflow band ``>= 2*INF`` reached by
+                    adding two maybe-INF values. ``jnp.minimum(x, INF)``
+                    (and ``clip`` / scatter ``.at[..].min``) is the clamp
+                    that returns a value to ``maybe-inf``.
+
+Seeding: annotated functions (utils/shape_contract.py) seed from their
+declared specs and are verified against them; unannotated traced functions
+get inferred summaries (which params live in the sentinel domain, learned
+from INF co-occurrence) cached per file-sha in the persistent analysis
+cache (analysis/cache.py) and invalidated when any contract changes —
+contracts are summary inputs. Cross-module calls resolve on the DeepFlow
+call graph, instantiating the callee's contract with fresh dims.
+
+Four rule families ride on one shared interpretation pass (cached on the
+AnalysisContext, so the first family pays the cost and the other three
+read it):
+
+  shape-mismatch         provable broadcast/rank conflicts, contract
+                         violations at call/return seams, tile splits
+                         ``a // b`` without a divisibility guard, and
+                         frontier buckets that forget to reserve the
+                         padding slot (the GraphTiling ``h - 1`` layout)
+  sentinel-overflow      int32 addition of two maybe-INF values with no
+                         dominating clamp — the (min,+) kernel hazard
+                         class; also collective sums of sentinel operands
+  dtype-promotion        silent int->float promotion, bool masks used in
+                         arithmetic without an explicit cast, true
+                         division of ints, float64 inside traced code
+  collective-conformance lax.ppermute/psum/pmax axis names checked against
+                         the mesh axis vocabulary, ppermute permutation
+                         well-formedness
+
+Like every rule in this suite: precision over recall. Unknown shapes and
+unresolved calls stay silent; findings require proof from the facts at
+hand (docs/Analysis.md has the full semantics).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    ANALYSIS_VERSION,
+    AnalysisContext,
+    Rule,
+    SourceFile,
+    call_name,
+    dotted_name,
+    register,
+    walk_nodes,
+)
+from openr_tpu.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    build_callgraph,
+    scan_imports,
+)
+from openr_tpu.analysis.dataflow import expr_desc
+from openr_tpu.analysis.shard_spec import _const_strs, mesh_axis_vocabulary
+from openr_tpu.analysis.trace_safety import _walk_shallow, traced_function_infos
+from openr_tpu.utils.shape_contract import (
+    ArraySpec,
+    Contract,
+    ContractError,
+    parse_contract,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# most recent pass stats in this process: contract/function counts + wall
+# time, surfaced through get_analysis_info -> get_build_info -> ctrl
+# getBuildInfo / `breeze openr version` (next to the per-rule stats)
+LAST_SHAPEFLOW_STATS: Dict = {}
+
+FAM_SHAPE = "shape-mismatch"
+FAM_SENT = "sentinel-overflow"
+FAM_DTYPE = "dtype-promotion"
+FAM_COLL = "collective-conformance"
+
+# --------------------------------------------------------------------------
+# sentinel lattice
+# --------------------------------------------------------------------------
+
+INF_VALUE = 1 << 29
+
+S_NON = "none"  # not in the sentinel domain / unknown
+S_LT = "lt-inf"  # provably < INF (literals, indices, counters)
+S_EQ = "eq-inf"  # exactly the sentinel
+S_MAYBE = "maybe-inf"  # <= INF: the clamped steady state
+S_SUM = "2inf"  # may reach >= 2*INF: must be clamped before use
+
+_SENT_ORDER = {S_LT: 0, S_EQ: 1, S_MAYBE: 2, S_SUM: 3}
+
+
+def sent_join(a: str, b: str) -> str:
+    """Least upper bound (jnp.where branches, maximum)."""
+    if S_SUM in (a, b):
+        return S_SUM
+    if a == S_NON and b == S_NON:
+        return S_NON
+    if a == b:
+        return a
+    if S_NON in (a, b):
+        # unknown joined with a sentinel state: stay in the domain but at
+        # the conservative <=INF bound (a where(c, x, INF) marks x's
+        # domain even when x itself is opaque)
+        other = b if a == S_NON else a
+        return other if other in (S_MAYBE, S_SUM) else S_MAYBE
+    return S_MAYBE
+
+
+def sent_min(a: str, b: str) -> str:
+    """State of jnp.minimum(a, b): the elementwise lower bound."""
+    if S_NON in (a, b):
+        return S_NON
+    return a if _SENT_ORDER[a] <= _SENT_ORDER[b] else b
+
+
+# --------------------------------------------------------------------------
+# symbolic dims
+# --------------------------------------------------------------------------
+
+
+class DimEnv:
+    """Union-find over symbolic dimension names with optional concrete
+    values — the unification engine behind shape checks. Dims are ints,
+    strings (symbols), or None (unknown/wildcard)."""
+
+    def __init__(self, consts: Optional[Dict[str, int]] = None):
+        self._parent: Dict[str, str] = {}
+        self._value: Dict[str, int] = dict(consts or {})
+
+    def _find(self, s: str) -> str:
+        root = s
+        while self._parent.get(root, root) != root:
+            root = self._parent[root]
+        while self._parent.get(s, s) != s:
+            self._parent[s], s = root, self._parent[s]
+        return root
+
+    def concrete(self, d) -> Optional[int]:
+        if isinstance(d, int):
+            return d
+        if isinstance(d, str):
+            return self._value.get(self._find(d))
+        return None
+
+    def bind(self, s: str, v: int) -> bool:
+        root = self._find(s)
+        cur = self._value.get(root)
+        if cur is None:
+            self._value[root] = v
+            return True
+        return cur == v
+
+    def unify(self, a, b) -> bool:
+        """Exact unification (contract seams): merge symbol classes, bind
+        values; False only on a provable concrete conflict."""
+        if a is None or b is None:
+            return True
+        if isinstance(a, int) and isinstance(b, int):
+            return a == b
+        if isinstance(a, int):
+            return self.bind(b, a)
+        if isinstance(b, int):
+            return self.bind(a, b)
+        ra, rb = self._find(a), self._find(b)
+        if ra == rb:
+            return True
+        va, vb = self._value.get(ra), self._value.get(rb)
+        if va is not None and vb is not None and va != vb:
+            return False
+        self._parent[ra] = rb
+        if va is not None:
+            self._value[rb] = va
+        return True
+
+    def broadcast_pair(self, a, b) -> Tuple[object, bool]:
+        """(result dim, ok) under numpy broadcasting: 1 yields to the
+        other side; symbols are NOT merged (either could be 1 at runtime)
+        — only concrete unequal non-1 pairs conflict."""
+        if a is None:
+            return b, True
+        if b is None:
+            return a, True
+        va, vb = self.concrete(a), self.concrete(b)
+        if va == 1:
+            return b, True
+        if vb == 1:
+            return a, True
+        if va is not None and vb is not None:
+            return a, va == vb
+        # prefer the side with a concrete value for the result dim
+        return (a if va is not None else b), True
+
+
+def _dim_text(env: DimEnv, d) -> str:
+    if d is None:
+        return "?"
+    v = env.concrete(d)
+    if isinstance(d, str) and v is not None:
+        return f"{d}={v}"
+    return str(d)
+
+
+def _shape_text(env: DimEnv, shape) -> str:
+    if shape is None:
+        return "[?]"
+    return "[" + ",".join(_dim_text(env, d) for d in shape) + "]"
+
+
+# --------------------------------------------------------------------------
+# abstract values
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AbsVal:
+    """One value's abstract facts. shape is a tuple of dims (int | symbol
+    str | None) or None when the rank itself is unknown. open_sites carry
+    the AST ids of undischarged >=2*INF additions flowing through this
+    value — a clamp discharges them, function end flags the rest."""
+
+    shape: Optional[Tuple] = None
+    dtype: Optional[str] = None
+    weak: bool = False
+    sent: str = S_NON
+    open_sites: FrozenSet[int] = frozenset()
+
+
+_UNKNOWN = AbsVal()
+
+
+def _kind(dtype: Optional[str]) -> Optional[str]:
+    if dtype is None:
+        return None
+    if dtype == "bool":
+        return "b"
+    if dtype.startswith(("int", "uint")):
+        return "i"
+    if dtype.startswith(("float", "bfloat")):
+        return "f"
+    return None
+
+
+def _promote(l: AbsVal, r: AbsVal) -> Tuple[Optional[str], bool]:
+    """(dtype, weak) of a binary op result under jax promotion: known
+    beats weak, float beats int beats bool."""
+    lk, rk = _kind(l.dtype), _kind(r.dtype)
+    if lk is None and rk is None:
+        return None, False
+    if lk is None:
+        return r.dtype, r.weak
+    if rk is None:
+        return l.dtype, l.weak
+    rankof = {"b": 0, "i": 1, "f": 2}
+    if rankof[lk] != rankof[rk]:
+        hi = l if rankof[lk] > rankof[rk] else r
+        lo = r if hi is l else l
+        if hi.weak and not lo.weak:
+            # weak scalar yields its kind's default width but the array
+            # side decides nothing narrower exists: int32 + 1.5 -> float32
+            return ("float32" if _kind(hi.dtype) == "f" else hi.dtype), False
+        return hi.dtype, hi.weak and lo.weak
+    # same kind: the non-weak side wins; equal weakness keeps the left
+    if l.weak and not r.weak:
+        return r.dtype, False
+    return l.dtype, l.weak and r.weak
+
+
+# --------------------------------------------------------------------------
+# per-module view: aliases, constants, INF bindings
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ModuleView:
+    jnp: Set[str] = field(default_factory=set)  # names meaning jax.numpy
+    lax: Set[str] = field(default_factory=set)  # names meaning jax.lax
+    jaxm: Set[str] = field(default_factory=set)  # names meaning jax itself
+    np: Set[str] = field(default_factory=set)  # names meaning numpy
+    consts: Dict[str, int] = field(default_factory=dict)
+    inf_names: Set[str] = field(default_factory=set)  # INF / F_INF bindings
+    inf_dtypes: Dict[str, str] = field(default_factory=dict)
+    jaxy: bool = False  # module touches jax at all
+
+    @classmethod
+    def scan(cls, sf: SourceFile) -> "ModuleView":
+        mv = cls()
+        from_imports, module_aliases = scan_imports(sf.tree)
+        for alias, mod in module_aliases.items():
+            if mod == "jax.numpy":
+                mv.jnp.add(alias)
+            elif mod == "jax.lax":
+                mv.lax.add(alias)
+            elif mod == "jax":
+                mv.jaxm.add(alias)
+            elif mod == "numpy":
+                mv.np.add(alias)
+        for alias, (mod, name) in from_imports.items():
+            if mod == "jax" and name == "numpy":
+                mv.jnp.add(alias)
+            elif mod == "jax" and name == "lax":
+                mv.lax.add(alias)
+            elif name == "INF":
+                mv.inf_names.add(alias)
+                mv.inf_dtypes[alias] = "int32"
+            elif name == "F_INF":
+                mv.inf_names.add(alias)
+                mv.inf_dtypes[alias] = "float32"
+        for node in sf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                name = node.targets[0].id
+                val = _int_const(node.value)
+                if val is not None:
+                    mv.consts[name] = val
+                    if val >= INF_VALUE:
+                        mv.inf_names.add(name)
+                        mv.inf_dtypes[name] = "int32"
+                fval = _float_const(node.value)
+                if fval is not None and fval >= 1e8:
+                    mv.inf_names.add(name)
+                    mv.inf_dtypes[name] = "float32"
+        mv.jaxy = bool(mv.jnp or mv.lax or mv.jaxm)
+        return mv
+
+
+def _int_const(node: ast.AST) -> Optional[int]:
+    """Literal ints including the `1 << 29` sentinel spelling."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) and not isinstance(node.value, bool):
+        return node.value
+    if (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, ast.LShift)
+        and isinstance(node.left, ast.Constant)
+        and isinstance(node.right, ast.Constant)
+    ):
+        try:
+            return node.left.value << node.right.value
+        except TypeError:
+            return None
+    return None
+
+
+def _float_const(node: ast.AST) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return node.value
+    return None
+
+
+_DTYPE_NAMES = {
+    "bool", "bool_", "int8", "int16", "int32", "int64", "uint8",
+    "uint16", "uint32", "uint64", "bfloat16", "float16", "float32",
+    "float64",
+}
+
+
+def _dtype_of_node(node: Optional[ast.AST]) -> Optional[str]:
+    """'float32' for jnp.float32 / np.int32 / 'int32' literals."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+        return "bool" if node.attr == "bool_" else node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _DTYPE_NAMES else None
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# --------------------------------------------------------------------------
+# contracts from the AST (the analyzer never imports kernel modules)
+# --------------------------------------------------------------------------
+
+
+def contract_decorator(fn_node: ast.AST) -> Optional[ast.Call]:
+    for dec in getattr(fn_node, "decorator_list", ()):
+        if isinstance(dec, ast.Call):
+            base = dotted_name(dec.func) or ""
+            if base.split(".")[-1] == "shape_contract":
+                return dec
+    return None
+
+
+def parse_contract_decorator(
+    dec: ast.Call,
+) -> Tuple[Optional[Contract], Optional[str]]:
+    """(contract, error message): re-parses the runtime grammar from the
+    decorator's literal strings; non-literal args disable the contract."""
+    specs: List[str] = []
+    for arg in dec.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            specs.append(arg.value)
+        else:
+            return None, None  # dynamically built contract: out of scope
+    returns = None
+    ret_node = _kwarg(dec, "returns")
+    if ret_node is not None:
+        if isinstance(ret_node, ast.Constant) and isinstance(
+            ret_node.value, str
+        ):
+            returns = ret_node.value
+        else:
+            return None, None
+    try:
+        return parse_contract(tuple(specs), returns=returns), None
+    except ContractError as exc:
+        return None, str(exc)
+
+
+# --------------------------------------------------------------------------
+# the per-function interpreter
+# --------------------------------------------------------------------------
+
+# jnp reductions: (drops the `axis` dim from the shape, keeps sentinel
+# state for min/max — the reduced value obeys the same bound)
+_REDUCTIONS = {"min", "amin", "max", "amax"}
+_SUM_REDUCTIONS = {"sum", "mean", "prod"}
+_ELEMENTWISE_FLOAT = {"exp", "log", "sqrt", "tanh", "sigmoid", "softmax"}
+_ELEMENTWISE_KEEP = {"abs", "negative", "stop_gradient"}
+
+_AXIS_COLLECTIVES = {
+    "ppermute", "psum", "pmax", "pmin", "pmean", "all_gather",
+    "axis_index", "psum_scatter", "all_to_all",
+}
+
+
+class FnAnalysis:
+    """One statement-ordered forward pass over one function body (nested
+    defs are separate analyses, mirroring dataflow.AliasTracker)."""
+
+    def __init__(
+        self,
+        flow: "_ShapeFlowPass",
+        fi: FunctionInfo,
+        contract: Optional[Contract],
+        sentinel_params: Set[str],
+    ):
+        self.flow = flow
+        self.fi = fi
+        self.sf = fi.sf
+        self.mv = flow.views[fi.sf.rel]
+        self.mod = flow.cg.modules.get(fi.module)
+        self.contract = contract
+        self.env: Dict[str, AbsVal] = {}
+        self.dims = DimEnv(self.mv.consts)
+        # open >=2*INF additions: id(node) -> (line, description)
+        self.open: Dict[int, Tuple[int, str]] = {}
+        self._seed_params(sentinel_params)
+
+    # -- seeding -----------------------------------------------------------
+
+    def _seed_params(self, sentinel_params: Set[str]) -> None:
+        args = self.fi.node.args
+        names = [
+            a.arg
+            for a in (
+                list(getattr(args, "posonlyargs", []) or [])
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        ]
+        for name in names:
+            spec = self.contract.params.get(name) if self.contract else None
+            if spec is not None:
+                self.env[name] = AbsVal(
+                    shape=tuple(spec.dims),
+                    dtype=spec.dtype,
+                    sent=S_MAYBE if spec.inf else S_NON,
+                )
+            elif name in sentinel_params:
+                self.env[name] = AbsVal(sent=S_MAYBE)
+            else:
+                self.env[name] = _UNKNOWN
+
+    # -- findings ----------------------------------------------------------
+
+    def emit(self, family: str, check: str, line: int, msg: str) -> None:
+        self.flow.emit(family, check, self.sf, line, msg)
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._exec_block(self.fi.node.body)
+        for line, desc in sorted(self.open.values()):
+            self.emit(
+                FAM_SENT,
+                "unclamped-add",
+                line,
+                f"sentinel add without a dominating clamp: {desc} can "
+                f"reach the >=2*INF band (INF = 1 << 29 stays int32-safe "
+                f"only under jnp.minimum(..., INF))",
+            )
+
+    # -- statements --------------------------------------------------------
+
+    def _exec_block(self, body: Iterable[ast.AST]) -> None:
+        for stmt in body:
+            self._exec_stmt(stmt)
+
+    def _exec_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, _FuncDef) or isinstance(stmt, ast.ClassDef):
+            return  # nested scopes are separate analyses
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for target in stmt.targets:
+                self._assign(target, val, stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self.eval(stmt.value), stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            cur = (
+                self.env.get(stmt.target.id, _UNKNOWN)
+                if isinstance(stmt.target, ast.Name)
+                else _UNKNOWN
+            )
+            val = self._binop_val(stmt, cur, self.eval(stmt.value), stmt.op)
+            if isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = val
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                val = self.eval(stmt.value)
+                self._check_return_contract(stmt, val)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval(stmt.iter)
+            if isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = AbsVal(
+                    dtype="int32", weak=True, sent=S_LT
+                )
+            self._exec_block(stmt.body)
+            self._exec_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.eval(stmt.test)
+            self._exec_block(stmt.body)
+            self._exec_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr)
+            self._exec_block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body)
+            for handler in stmt.handlers:
+                self._exec_block(handler.body)
+            self._exec_block(stmt.orelse)
+            self._exec_block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Expr, ast.Assert)):
+            self.eval(stmt.value if isinstance(stmt, ast.Expr) else stmt.test)
+            return
+
+    def _assign(self, target: ast.AST, val: AbsVal, value_node: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value_node, (ast.Tuple, ast.List)) and len(
+                value_node.elts
+            ) == len(target.elts):
+                for t, v in zip(target.elts, value_node.elts):
+                    self._assign(t, self.eval(v), v)
+                return
+            for t in target.elts:
+                if isinstance(t, ast.Name):
+                    # unpacking an opaque producer: facts don't split, but
+                    # open overflow sites must keep flowing
+                    self.env[t.id] = AbsVal(open_sites=val.open_sites)
+
+    def _check_return_contract(self, stmt: ast.Return, val: AbsVal) -> None:
+        spec = self.contract.returns if self.contract else None
+        if spec is None:
+            return
+        if val.shape is not None:
+            if len(val.shape) != spec.rank:
+                self.emit(
+                    FAM_SHAPE,
+                    "return-contract",
+                    stmt.lineno,
+                    f"return shape {_shape_text(self.dims, val.shape)} "
+                    f"conflicts with declared returns "
+                    f"{_shape_text(self.dims, tuple(spec.dims))} "
+                    f"(rank {len(val.shape)} != {spec.rank})",
+                )
+                return
+            for got, want in zip(val.shape, spec.dims):
+                if not self.dims.unify(got, want):
+                    self.emit(
+                        FAM_SHAPE,
+                        "return-contract",
+                        stmt.lineno,
+                        f"return dim {_dim_text(self.dims, got)} conflicts "
+                        f"with declared {_dim_text(self.dims, want)} in "
+                        f"returns {_shape_text(self.dims, tuple(spec.dims))}",
+                    )
+        if (
+            val.dtype is not None
+            and not val.weak
+            and val.dtype != spec.dtype
+        ):
+            self.emit(
+                FAM_SHAPE,
+                "return-contract",
+                stmt.lineno,
+                f"return dtype {val.dtype} conflicts with declared "
+                f"{spec.dtype}",
+            )
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node: ast.AST) -> AbsVal:
+        if isinstance(node, ast.Constant):
+            return self._eval_const(node)
+        if isinstance(node, ast.Name):
+            if node.id in self.mv.inf_names:
+                return AbsVal(
+                    shape=(),
+                    dtype=self.mv.inf_dtypes.get(node.id, "int32"),
+                    sent=S_EQ,
+                )
+            if node.id in self.mv.consts:
+                return AbsVal(
+                    shape=(),
+                    dtype="int32",
+                    weak=True,
+                    sent=S_LT
+                    if self.mv.consts[node.id] < INF_VALUE
+                    else S_EQ,
+                )
+            return self.env.get(node.id, _UNKNOWN)
+        if isinstance(node, ast.BinOp):
+            l, r = self.eval(node.left), self.eval(node.right)
+            return self._binop_val(node, l, r, node.op)
+        if isinstance(node, ast.UnaryOp):
+            inner = self.eval(node.operand)
+            if isinstance(node.op, ast.Not):
+                return AbsVal(shape=inner.shape, dtype="bool")
+            return replace(inner, sent=S_NON)
+        if isinstance(node, ast.Compare):
+            vals = [self.eval(node.left)] + [
+                self.eval(c) for c in node.comparators
+            ]
+            shape = self._broadcast(node, vals)
+            sites = frozenset().union(*(v.open_sites for v in vals))
+            return AbsVal(shape=shape, dtype="bool", open_sites=sites)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.eval(v)
+            return AbsVal(dtype="bool")
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            a, b = self.eval(node.body), self.eval(node.orelse)
+            return AbsVal(
+                shape=a.shape if a.shape == b.shape else None,
+                dtype=a.dtype if a.dtype == b.dtype else None,
+                weak=a.weak and b.weak,
+                sent=sent_join(a.sent, b.sent),
+                open_sites=a.open_sites | b.open_sites,
+            )
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value)
+            if node.attr == "T" and base.shape is not None:
+                return replace(base, shape=tuple(reversed(base.shape)))
+            if node.attr in ("shape", "size", "ndim", "dtype"):
+                return AbsVal(dtype="int32", weak=True, sent=S_LT)
+            return _UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            sites = frozenset()
+            for e in node.elts:
+                sites |= self.eval(e).open_sites
+            return AbsVal(open_sites=sites)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        # comprehensions, lambdas, f-strings, ...: host-level, opaque
+        return _UNKNOWN
+
+    def _eval_const(self, node: ast.Constant) -> AbsVal:
+        v = node.value
+        if isinstance(v, bool):
+            return AbsVal(shape=(), dtype="bool", weak=True)
+        if isinstance(v, int):
+            sent = S_LT if abs(v) < 2 ** 28 else (S_EQ if v == INF_VALUE else S_EQ)
+            return AbsVal(shape=(), dtype="int32", weak=True, sent=sent)
+        if isinstance(v, float):
+            sent = S_EQ if v >= 1e8 else S_LT
+            return AbsVal(shape=(), dtype="float32", weak=True, sent=sent)
+        return _UNKNOWN
+
+    # -- binops: broadcasting + promotion + the sentinel add ---------------
+
+    def _binop_val(
+        self, node: ast.AST, l: AbsVal, r: AbsVal, op: ast.AST
+    ) -> AbsVal:
+        shape = self._broadcast(node, [l, r])
+        dtype, weak = _promote(l, r)
+        sites = l.open_sites | r.open_sites
+        sent = S_NON
+        if isinstance(op, ast.Add):
+            sent, sites = self._sentinel_add(node, l, r, sites)
+        elif isinstance(op, (ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)):
+            sent = S_NON
+        self._check_promotion(node, l, r, op)
+        return AbsVal(
+            shape=shape, dtype=dtype, weak=weak, sent=sent, open_sites=sites
+        )
+
+    def _sentinel_add(
+        self,
+        node: ast.AST,
+        l: AbsVal,
+        r: AbsVal,
+        sites: FrozenSet[int],
+    ) -> Tuple[str, FrozenSet[int]]:
+        lk, rk = _kind(l.dtype), _kind(r.dtype)
+        if "f" in (lk, rk):
+            # the overflow band is an int32 hazard; float sentinel sums
+            # (F_INF gaps) saturate harmlessly and are compared via
+            # `>= F_INF / 2` guards instead
+            return S_NON, sites
+        hazard = (
+            l.sent == S_SUM
+            or r.sent == S_SUM
+            or (l.sent in (S_EQ, S_MAYBE) and r.sent in (S_EQ, S_MAYBE))
+        )
+        if hazard:
+            desc = f"{expr_desc(node.left)} + {expr_desc(node.right)}"
+            self.open[id(node)] = (node.lineno, desc)
+            return S_SUM, sites | {id(node)}
+        if S_MAYBE in (l.sent, r.sent) or S_EQ in (l.sent, r.sent):
+            return S_MAYBE, sites
+        if l.sent == S_LT and r.sent == S_LT:
+            return S_LT, sites
+        return S_NON, sites
+
+    def _discharge(self, *vals: AbsVal) -> None:
+        for v in vals:
+            for sid in v.open_sites:
+                self.open.pop(sid, None)
+
+    def _check_promotion(
+        self, node: ast.AST, l: AbsVal, r: AbsVal, op: ast.AST
+    ) -> None:
+        lk, rk = _kind(l.dtype), _kind(r.dtype)
+        line = getattr(node, "lineno", 0)
+        if isinstance(op, ast.Div) and lk == "i" and not l.weak:
+            self.emit(
+                FAM_DTYPE,
+                "int-true-div",
+                line,
+                f"true division of {l.dtype} value "
+                f"{expr_desc(node.left)} promotes silently to floating "
+                f"point; use // or an explicit astype",
+            )
+        if not isinstance(op, (ast.Add, ast.Sub, ast.Mult)):
+            return
+        # bool masks in arithmetic: inline comparisons or declared bools
+        for side_node, side_val in (
+            (getattr(node, "left", None), l),
+            (getattr(node, "right", None), r),
+        ):
+            is_bool = (
+                isinstance(side_node, ast.Compare)
+                or (side_val.dtype == "bool" and not side_val.weak)
+                or (
+                    isinstance(side_node, ast.Subscript)
+                    and side_val.dtype == "bool"
+                )
+            )
+            if is_bool:
+                self.emit(
+                    FAM_DTYPE,
+                    "bool-arith",
+                    line,
+                    f"bool mask {expr_desc(side_node)} promotes silently "
+                    f"inside arithmetic; make the cast explicit with "
+                    f".astype(...)",
+                )
+                return
+        if {"i", "f"} == {lk, rk}:
+            int_side = l if lk == "i" else r
+            if not int_side.weak:
+                int_node = node.left if int_side is l else node.right
+                self.emit(
+                    FAM_DTYPE,
+                    "silent-promotion",
+                    line,
+                    f"{int_side.dtype} value {expr_desc(int_node)} "
+                    f"promotes silently to floating point in this "
+                    f"expression; cast explicitly with .astype(...)",
+                )
+
+    # -- broadcasting ------------------------------------------------------
+
+    def _broadcast(
+        self, node: ast.AST, vals: List[AbsVal]
+    ) -> Optional[Tuple]:
+        if any(v.shape is None for v in vals):
+            return None  # an unknown operand defeats the check entirely
+        shapes = [v.shape for v in vals if v.shape != ()]  # scalars free
+        if not shapes:
+            return ()
+        if len(shapes) == 1:
+            return shapes[0]
+        maxr = max(len(s) for s in shapes)
+        out: List[object] = []
+        for pos in range(1, maxr + 1):
+            dims = [s[-pos] for s in shapes if len(s) >= pos]
+            d = dims[0]
+            for other in dims[1:]:
+                d, ok = self.dims.broadcast_pair(d, other)
+                if not ok:
+                    self.emit(
+                        FAM_SHAPE,
+                        "broadcast",
+                        getattr(node, "lineno", 0),
+                        f"operands cannot broadcast: dim "
+                        f"{_dim_text(self.dims, dims[0])} vs "
+                        f"{_dim_text(self.dims, other)} (axis -{pos}) in "
+                        f"{expr_desc(node)}",
+                    )
+                    return None
+            out.append(d)
+        return tuple(reversed(out))
+
+    # -- subscripts --------------------------------------------------------
+
+    def _eval_subscript(self, node: ast.Subscript) -> AbsVal:
+        base = self.eval(node.value)
+        items = (
+            list(node.slice.elts)
+            if isinstance(node.slice, ast.Tuple)
+            else [node.slice]
+        )
+        if base.shape is None:
+            for it in items:
+                if not isinstance(it, ast.Slice):
+                    self.eval(it)
+            return replace(base, shape=None)
+        consuming = [
+            it
+            for it in items
+            if not (
+                isinstance(it, ast.Constant)
+                and it.value is None
+            )
+        ]
+        has_ellipsis = any(
+            isinstance(it, ast.Constant) and it.value is Ellipsis
+            for it in items
+        )
+        if not has_ellipsis and len(consuming) > len(base.shape):
+            self.emit(
+                FAM_SHAPE,
+                "index-rank",
+                node.lineno,
+                f"{expr_desc(node)} indexes {len(consuming)} axes of a "
+                f"rank-{len(base.shape)} value "
+                f"{_shape_text(self.dims, base.shape)}",
+            )
+            return replace(base, shape=None)
+        if has_ellipsis:
+            return replace(base, shape=None)
+        out: List[object] = []
+        dim_iter = iter(base.shape)
+        for it in items:
+            if isinstance(it, ast.Constant) and it.value is None:
+                out.append(1)
+                continue
+            src_dim = next(dim_iter, None)
+            if isinstance(it, ast.Slice):
+                if it.lower is None and it.upper is None and it.step is None:
+                    out.append(src_dim)
+                else:
+                    out.append(None)  # partial slice: unknown length
+                continue
+            idx = self.eval(it)
+            if isinstance(it, ast.Constant) and isinstance(it.value, int):
+                continue  # integer index drops the dim
+            if idx.shape is not None and idx.shape != ():
+                out.extend(idx.shape)  # fancy index splices its dims
+            elif idx.shape == ():
+                continue
+            else:
+                out.append(None)
+        out.extend(dim_iter)
+        return replace(base, shape=tuple(out))
+
+    # -- calls -------------------------------------------------------------
+
+    def _api(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        chain = dotted_name(call.func)
+        if not chain or "." not in chain:
+            return None
+        parts = chain.split(".")
+        if parts[0] in self.mv.jnp and len(parts) == 2:
+            return "jnp", parts[1]
+        if parts[0] in self.mv.lax and len(parts) == 2:
+            return "lax", parts[1]
+        if parts[0] in self.mv.np and len(parts) == 2:
+            return "np", parts[1]
+        if parts[0] in self.mv.jaxm and len(parts) >= 3:
+            if parts[1] == "numpy":
+                return "jnp", parts[2]
+            if parts[1] == "lax":
+                return "lax", parts[2]
+        return None
+
+    def _is_inf_node(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.mv.inf_names
+        iv = _int_const(node)
+        if iv is not None:
+            return iv >= 2 ** 28
+        fv = _float_const(node)
+        if fv is not None:
+            return fv >= 1e8
+        chain = dotted_name(node)
+        return bool(chain) and chain.split(".")[-1] == "INF"
+
+    def _eval_call(self, call: ast.Call) -> AbsVal:
+        api = self._api(call)
+        if api is not None:
+            mod, name = api
+            if mod == "lax":
+                return self._eval_lax(call, name)
+            return self._eval_jnp(call, name)
+        if isinstance(call.func, ast.Attribute):
+            return self._eval_method(call)
+        return self._eval_plain_call(call)
+
+    def _eval_jnp(self, call: ast.Call, name: str) -> AbsVal:
+        args = [self.eval(a) for a in call.args]
+        if name in ("minimum", "fmin") and len(args) >= 2:
+            clamp = any(self._is_inf_node(a) for a in call.args)
+            if clamp:
+                self._discharge(*args)
+                shape = self._broadcast(call, args)
+                dtype, weak = _promote(args[0], args[1])
+                return AbsVal(shape=shape, dtype=dtype, weak=weak, sent=S_MAYBE)
+            sent = sent_min(args[0].sent, args[1].sent)
+            sites = args[0].open_sites | args[1].open_sites
+            if sent != S_SUM:
+                self._discharge(*args)
+                sites = frozenset()
+            shape = self._broadcast(call, args)
+            dtype, weak = _promote(args[0], args[1])
+            return AbsVal(
+                shape=shape, dtype=dtype, weak=weak, sent=sent,
+                open_sites=sites,
+            )
+        if name == "clip" and args:
+            hi = call.args[2] if len(call.args) >= 3 else _kwarg(call, "max")
+            if hi is not None and self._is_inf_node(hi):
+                self._discharge(*args)
+                return replace(args[0], sent=S_MAYBE, open_sites=frozenset())
+            return args[0]
+        if name in ("maximum", "fmax") and len(args) >= 2:
+            shape = self._broadcast(call, args)
+            dtype, weak = _promote(args[0], args[1])
+            return AbsVal(
+                shape=shape, dtype=dtype, weak=weak,
+                sent=sent_join(args[0].sent, args[1].sent),
+                open_sites=args[0].open_sites | args[1].open_sites,
+            )
+        if name == "where" and len(args) >= 3:
+            shape = self._broadcast(call, args)
+            a, b = args[1], args[2]
+            inf_branch = any(
+                self._is_inf_node(n) for n in call.args[1:3]
+            )
+            sent = sent_join(a.sent, b.sent)
+            if inf_branch and sent == S_NON:
+                sent = S_MAYBE
+            dtype, weak = _promote(a, b)
+            return AbsVal(
+                shape=shape, dtype=dtype, weak=weak, sent=sent,
+                open_sites=a.open_sites | b.open_sites,
+            )
+        if name in _REDUCTIONS and args:
+            return self._reduce(call, args[0])
+        if name in _SUM_REDUCTIONS and args:
+            self._discharge(*args)
+            v = self._reduce(call, args[0])
+            return replace(v, sent=S_NON, open_sites=frozenset())
+        if name in ("full",) and call.args:
+            shape = self._dims_of_node(call.args[0])
+            fill = args[1] if len(args) > 1 else _UNKNOWN
+            dtype = _dtype_of_node(_kwarg(call, "dtype")) or fill.dtype
+            return AbsVal(shape=shape, dtype=dtype, sent=fill.sent)
+        if name == "full_like" and len(args) >= 2:
+            dtype = _dtype_of_node(_kwarg(call, "dtype")) or args[0].dtype
+            return AbsVal(shape=args[0].shape, dtype=dtype, sent=args[1].sent)
+        if name in ("zeros", "ones", "empty") and call.args:
+            shape = self._dims_of_node(call.args[0])
+            dtype = _dtype_of_node(_kwarg(call, "dtype"))
+            return AbsVal(shape=shape, dtype=dtype, sent=S_LT)
+        if name in ("zeros_like", "ones_like") and args:
+            return replace(
+                args[0], sent=S_LT, open_sites=frozenset()
+            )
+        if name == "arange":
+            dtype = _dtype_of_node(_kwarg(call, "dtype")) or "int32"
+            return AbsVal(dtype=dtype, sent=S_LT)
+        if name == "eye" and call.args:
+            d = self._dim_of_node(call.args[0])
+            dtype = _dtype_of_node(_kwarg(call, "dtype"))
+            return AbsVal(shape=(d, d), dtype=dtype, sent=S_LT)
+        if name == "reshape" and len(call.args) >= 2:
+            shape = self._dims_of_node(call.args[1])
+            return replace(args[0], shape=shape)
+        if name == "transpose" and args:
+            if args[0].shape is not None and len(call.args) == 1:
+                return replace(args[0], shape=tuple(reversed(args[0].shape)))
+            return replace(args[0], shape=None)
+        if name in ("argsort", "argmin", "argmax") and args:
+            return AbsVal(dtype="int32", sent=S_LT)
+        if name in _ELEMENTWISE_FLOAT and args:
+            return AbsVal(shape=args[0].shape, dtype="float32")
+        if name in _ELEMENTWISE_KEEP and args:
+            return args[0]
+        if name in ("asarray", "array") and args:
+            dtype = _dtype_of_node(_kwarg(call, "dtype")) or (
+                _dtype_of_node(call.args[1]) if len(call.args) > 1 else None
+            )
+            return replace(args[0], dtype=dtype or args[0].dtype)
+        # unmodeled jnp call: opaque, but overflow sites passed in cannot
+        # be proven clamped OR unclamped — precision over recall, drop them
+        self._discharge(*args)
+        for kw in call.keywords:
+            self._discharge(self.eval(kw.value))
+        return _UNKNOWN
+
+    def _reduce(self, call: ast.Call, v: AbsVal) -> AbsVal:
+        axis_node = (
+            call.args[1] if len(call.args) > 1 else _kwarg(call, "axis")
+        )
+        if _kwarg(call, "keepdims") is not None:
+            return replace(v, shape=None)
+        if axis_node is None:
+            return replace(v, shape=())
+        if (
+            v.shape is not None
+            and isinstance(axis_node, ast.Constant)
+            and isinstance(axis_node.value, int)
+        ):
+            ax = axis_node.value
+            if -len(v.shape) <= ax < len(v.shape):
+                shape = list(v.shape)
+                del shape[ax]
+                return replace(v, shape=tuple(shape))
+        return replace(v, shape=None)
+
+    def _dim_of_node(self, node: ast.AST):
+        iv = _int_const(node)
+        if iv is not None:
+            return iv
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _dims_of_node(self, node: ast.AST) -> Optional[Tuple]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self._dim_of_node(e) for e in node.elts)
+        d = self._dim_of_node(node)
+        return (d,) if d is not None else None
+
+    # -- lax + collectives -------------------------------------------------
+
+    def _eval_lax(self, call: ast.Call, name: str) -> AbsVal:
+        args = [self.eval(a) for a in call.args]
+        if name in _AXIS_COLLECTIVES:
+            self.flow.check_collective(self, call, name)
+        if name == "ppermute" and args:
+            return args[0]
+        if name in ("pmax", "pmin") and args:
+            return args[0]
+        if name == "psum" and args:
+            if args[0].sent in (S_EQ, S_MAYBE, S_SUM) and _kind(
+                args[0].dtype
+            ) != "f":
+                self.emit(
+                    FAM_SENT,
+                    "psum-sentinel",
+                    call.lineno,
+                    f"lax.psum over a sentinel-domain operand "
+                    f"{expr_desc(call.args[0])}: the cross-device sum can "
+                    f"leave the INF band; reduce with pmin/pmax or clamp "
+                    f"first",
+                )
+            return replace(args[0], sent=S_NON, open_sites=frozenset())
+        if name == "axis_index":
+            return AbsVal(shape=(), dtype="int32", sent=S_LT)
+        if name == "select" and len(args) >= 3:
+            return AbsVal(
+                shape=self._broadcast(call, args[1:]),
+                sent=sent_join(args[1].sent, args[2].sent),
+                open_sites=args[1].open_sites | args[2].open_sites,
+            )
+        if name in ("dynamic_slice", "dynamic_index_in_dim") and args:
+            return replace(args[0], shape=None)
+        if name == "stop_gradient" and args:
+            return args[0]
+        self._discharge(*args)
+        return _UNKNOWN
+
+    # -- methods -----------------------------------------------------------
+
+    def _eval_method(self, call: ast.Call) -> AbsVal:
+        func = call.func
+        name = func.attr
+        # scatter through .at[idx].min/set/add(v)
+        if (
+            isinstance(func.value, ast.Subscript)
+            and isinstance(func.value.value, ast.Attribute)
+            and func.value.value.attr == "at"
+        ):
+            base = self.eval(func.value.value.value)
+            vals = [self.eval(a) for a in call.args]
+            if name == "min":
+                # scatter-min against the base: result stays below the
+                # base's bound — the clamp idiom of the halo exchange
+                self._discharge(*vals)
+                return base
+            if name in ("set", "max", "add"):
+                sites = base.open_sites
+                for v in vals:
+                    sites |= v.open_sites
+                return replace(
+                    base,
+                    sent=sent_join(
+                        base.sent, vals[0].sent if vals else S_NON
+                    ),
+                    open_sites=sites,
+                )
+            return base
+        base = self.eval(func.value)
+        if name == "astype" and call.args:
+            dtype = _dtype_of_node(call.args[0])
+            return replace(base, dtype=dtype, weak=False)
+        if name == "reshape":
+            if len(call.args) == 1:
+                return replace(base, shape=self._dims_of_node(call.args[0]))
+            return replace(
+                base,
+                shape=tuple(self._dim_of_node(a) for a in call.args),
+            )
+        if name == "transpose":
+            if base.shape is not None and len(call.args) == len(base.shape):
+                perm = [_int_const(a) for a in call.args]
+                if all(p is not None for p in perm):
+                    return replace(
+                        base, shape=tuple(base.shape[p] for p in perm)
+                    )
+            if not call.args and base.shape is not None:
+                return replace(base, shape=tuple(reversed(base.shape)))
+            return replace(base, shape=None)
+        if name in _REDUCTIONS:
+            return self._reduce(call, base)
+        if name in _SUM_REDUCTIONS or name in ("any", "all"):
+            self._discharge(base)
+            return AbsVal(
+                dtype="bool" if name in ("any", "all") else base.dtype
+            )
+        args = [self.eval(a) for a in call.args]
+        self._discharge(base, *args)
+        return _UNKNOWN
+
+    # -- resolved calls: contract verification at the seam -----------------
+
+    def _eval_plain_call(self, call: ast.Call) -> AbsVal:
+        args = [self.eval(a) for a in call.args]
+        kwargs = {
+            kw.arg: self.eval(kw.value)
+            for kw in call.keywords
+            if kw.arg is not None
+        }
+        callee = None
+        if self.mod is not None:
+            for cand in self.flow.cg.resolve_call_defs(self.mod, call):
+                if cand is not None and id(cand.node) in self.flow.contracts:
+                    callee = cand
+                    break
+        if callee is None:
+            self._discharge(*args, *kwargs.values())
+            return _UNKNOWN
+        contract = self.flow.contracts[id(callee.node)]
+        self.flow.calls_checked += 1
+        callee_mv = self.flow.views.get(callee.sf.rel)
+        rename = f"{callee.name}@{call.lineno}"
+
+        def fresh(dim):
+            if isinstance(dim, str):
+                sym = f"{rename}:{dim}"
+                cv = (callee_mv.consts.get(dim) if callee_mv else None)
+                if cv is not None:
+                    self.dims.bind(sym, cv)
+                return sym
+            return dim
+
+        params = [a.arg for a in callee.node.args.args]
+        bound = dict(zip(params, args))
+        bound.update({k: v for k, v in kwargs.items() if k in contract.params})
+        for pname, spec in contract.params.items():
+            got = bound.get(pname)
+            if got is None or got.shape is None:
+                continue
+            want = tuple(fresh(d) for d in spec.dims)
+            if len(got.shape) != len(want):
+                self.emit(
+                    FAM_SHAPE,
+                    "call-contract",
+                    call.lineno,
+                    f"argument {pname!r} of {callee.name} has shape "
+                    f"{_shape_text(self.dims, got.shape)} but the "
+                    f"contract declares "
+                    f"{_shape_text(self.dims, tuple(spec.dims))} "
+                    f"(rank {len(got.shape)} != {spec.rank})",
+                )
+                continue
+            for g, w in zip(got.shape, want):
+                if not self.dims.unify(g, w):
+                    self.emit(
+                        FAM_SHAPE,
+                        "call-contract",
+                        call.lineno,
+                        f"argument {pname!r} of {callee.name}: dim "
+                        f"{_dim_text(self.dims, g)} conflicts with "
+                        f"declared {_dim_text(self.dims, w)} in "
+                        f"{_shape_text(self.dims, tuple(spec.dims))}",
+                    )
+            if (
+                got.dtype is not None
+                and not got.weak
+                and got.dtype != spec.dtype
+            ):
+                self.emit(
+                    FAM_SHAPE,
+                    "call-contract",
+                    call.lineno,
+                    f"argument {pname!r} of {callee.name} is {got.dtype} "
+                    f"but the contract declares {spec.dtype}",
+                )
+        self._discharge(*args, *kwargs.values())
+        ret = contract.returns
+        if ret is None:
+            return _UNKNOWN
+        return AbsVal(
+            shape=tuple(fresh(d) for d in ret.dims),
+            dtype=ret.dtype,
+            sent=S_MAYBE if ret.inf else S_NON,
+        )
+
+
+# --------------------------------------------------------------------------
+# sentinel-domain inference for unannotated functions
+# --------------------------------------------------------------------------
+
+
+def infer_sentinel_params(fn: ast.AST, mv: ModuleView) -> Set[str]:
+    """Params living in the INF distance domain, learned from co-occurrence
+    with the sentinel: any name inside an expression that is clamped to,
+    compared with, or filled by INF belongs to the domain."""
+
+    def is_inf(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in mv.inf_names
+        iv = _int_const(node)
+        if iv is not None and iv >= 2 ** 28:
+            return True
+        chain = dotted_name(node)
+        return bool(chain) and chain.split(".")[-1] == "INF"
+
+    domain: Set[str] = set()
+
+    def names_in(node: ast.AST) -> Set[str]:
+        return {
+            n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+        } - mv.inf_names
+
+    for node in _walk_shallow(fn):
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname in ("minimum", "fmin", "clip") and any(
+                is_inf(a) for a in node.args
+            ):
+                for a in node.args:
+                    if not is_inf(a):
+                        domain |= names_in(a)
+            elif cname in ("where", "full_like", "select") and any(
+                is_inf(a) for a in node.args
+            ):
+                for a in node.args[1:]:
+                    if not is_inf(a):
+                        domain |= names_in(a)
+        elif isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            if any(is_inf(o) for o in operands):
+                for o in operands:
+                    if not is_inf(o):
+                        domain |= names_in(o)
+    params = {
+        a.arg
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs)
+    }
+    return domain & params
+
+
+# --------------------------------------------------------------------------
+# the shared pass
+# --------------------------------------------------------------------------
+
+
+class _ShapeFlowPass:
+    """One interpretation of the whole analyzed set, cached on the
+    AnalysisContext; the four rule families each read their bucket."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+        self.cg: CallGraph = build_callgraph(ctx)
+        self.views: Dict[str, ModuleView] = {
+            sf.rel: ModuleView.scan(sf) for sf in ctx.files
+        }
+        self.vocab: Set[str] = mesh_axis_vocabulary(ctx)
+        self.findings: Dict[str, List[Tuple[str, SourceFile, int, str]]] = {
+            FAM_SHAPE: [],
+            FAM_SENT: [],
+            FAM_DTYPE: [],
+            FAM_COLL: [],
+        }
+        self.contracts: Dict[int, Contract] = {}
+        self.calls_checked = 0
+        self.functions_seen = 0
+        self.inferred = 0
+
+    def emit(
+        self, family: str, check: str, sf: SourceFile, line: int, msg: str
+    ) -> None:
+        self.findings[family].append((check, sf, line, msg))
+
+    # -- contracts ---------------------------------------------------------
+
+    def collect_contracts(self) -> List[FunctionInfo]:
+        annotated: List[FunctionInfo] = []
+        for fi in self.cg.functions():
+            dec = contract_decorator(fi.node)
+            if dec is None:
+                continue
+            contract, err = parse_contract_decorator(dec)
+            if err is not None:
+                self.emit(
+                    FAM_SHAPE,
+                    "contract-syntax",
+                    fi.sf,
+                    dec.lineno,
+                    f"malformed @shape_contract on {fi.name}: {err}",
+                )
+                continue
+            if contract is None:
+                continue
+            params = {
+                a.arg
+                for a in list(fi.node.args.args)
+                + list(fi.node.args.kwonlyargs)
+            }
+            unknown = set(contract.params) - params
+            if unknown:
+                self.emit(
+                    FAM_SHAPE,
+                    "contract-params",
+                    fi.sf,
+                    dec.lineno,
+                    f"@shape_contract on {fi.name} names "
+                    f"{sorted(unknown)} which are not parameters",
+                )
+                continue
+            self.contracts[id(fi.node)] = contract
+            annotated.append(fi)
+        return annotated
+
+    def contracts_fingerprint(self) -> str:
+        """Hash of every contract in the analyzed set: contracts are
+        summary inputs, so any edit invalidates cached summaries."""
+        h = hashlib.sha256()
+        entries = []
+        for fi in self.cg.functions():
+            dec = contract_decorator(fi.node)
+            if dec is not None:
+                entries.append(
+                    f"{fi.module}:{fi.qname}:{ast.dump(dec)}"
+                )
+        for e in sorted(entries):
+            h.update(e.encode())
+        return h.hexdigest()
+
+    # -- the interpreter loop ----------------------------------------------
+
+    def run(self) -> None:
+        from openr_tpu.analysis.cache import (
+            CACHE_NAME,
+            load_shapeflow_summaries,
+            store_shapeflow_summaries,
+        )
+
+        annotated = self.collect_contracts()
+        traced, _direct = traced_function_infos(self.ctx)
+        targets = sorted(
+            set(traced) | set(annotated),
+            key=lambda fi: (fi.sf.rel, fi.node.lineno),
+        )
+        fingerprint = self.contracts_fingerprint()
+        cache_path = self.ctx.root / CACHE_NAME
+        cached = load_shapeflow_summaries(
+            cache_path, ANALYSIS_VERSION, fingerprint
+        )
+        file_sha: Dict[str, str] = {}
+        new_summaries: Dict[str, Dict] = {}
+        for fi in targets:
+            rel = fi.sf.rel
+            sha = file_sha.setdefault(
+                rel, hashlib.sha256(fi.sf.source.encode()).hexdigest()
+            )
+            contract = self.contracts.get(id(fi.node))
+            sentinel_params: Set[str] = set()
+            if contract is None:
+                ent = cached.get(rel)
+                fns = (
+                    ent["functions"]
+                    if ent is not None and ent.get("hash") == sha
+                    else None
+                )
+                if fns is not None and fi.qname in fns:
+                    sentinel_params = set(fns[fi.qname])
+                else:
+                    sentinel_params = infer_sentinel_params(
+                        fi.node, self.views[rel]
+                    )
+                    self.inferred += 1
+                new_summaries.setdefault(
+                    rel, {"hash": sha, "functions": {}}
+                )["functions"][fi.qname] = sorted(sentinel_params)
+            self.functions_seen += 1
+            FnAnalysis(self, fi, contract, sentinel_params).run()
+        store_shapeflow_summaries(
+            cache_path, ANALYSIS_VERSION, fingerprint, new_summaries
+        )
+        # structural per-module checks (host-side shape plumbing included)
+        for sf in self.ctx.files:
+            mv = self.views[sf.rel]
+            if mv.jaxy or mv.np:
+                self._check_divisibility(sf)
+                self._check_reserved_slot(sf)
+            if mv.jaxy:
+                self._scan_collectives(sf, mv)
+                self._scan_float64(sf, mv, traced)
+
+    # -- tile divisibility -------------------------------------------------
+
+    def _check_divisibility(self, sf: SourceFile) -> None:
+        for fn in (
+            n for n in walk_nodes(sf.tree) if isinstance(n, _FuncDef)
+        ):
+            guarded: Set[Tuple[str, str]] = set()
+            for node in _walk_shallow(fn):
+                if (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mod)
+                    and isinstance(node.left, ast.Name)
+                    and isinstance(node.right, ast.Name)
+                ):
+                    guarded.add((node.left.id, node.right.id))
+            parents: Dict[int, ast.AST] = {}
+            for node in _walk_shallow(fn):
+                for child in ast.iter_child_nodes(node):
+                    parents[id(child)] = node
+            for node in _walk_shallow(fn):
+                if not (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.FloorDiv)
+                    and isinstance(node.left, ast.Name)
+                    and isinstance(node.right, ast.Name)
+                ):
+                    continue
+                pair = (node.left.id, node.right.id)
+                if pair in guarded:
+                    continue
+                # only splits that directly produce a shape-like value:
+                # the div reached through bare tuples from a Return or an
+                # Assign (a .astype()-wrapped array div is data, not a dim)
+                cur = parents.get(id(node))
+                while isinstance(cur, (ast.Tuple, ast.List)):
+                    cur = parents.get(id(cur))
+                if isinstance(cur, (ast.Return, ast.Assign)):
+                    self.emit(
+                        FAM_SHAPE,
+                        "tile-divisibility",
+                        sf,
+                        node.lineno,
+                        f"tile split {pair[0]} // {pair[1]} without a "
+                        f"divisibility guard — assert "
+                        f"{pair[0]} % {pair[1]} == 0 first (a remainder "
+                        f"silently truncates the last tile)",
+                    )
+
+    # -- reserved padding slot ---------------------------------------------
+
+    def _check_reserved_slot(self, sf: SourceFile) -> None:
+        for fn in (
+            n for n in walk_nodes(sf.tree) if isinstance(n, _FuncDef)
+        ):
+            buckets: Dict[str, ast.Call] = {}
+            for node in _walk_shallow(fn):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and (call_name(node.value) or "").endswith(
+                        "_next_bucket"
+                    )
+                ):
+                    buckets[node.targets[0].id] = node.value
+            if not buckets:
+                continue
+            for node in _walk_shallow(fn):
+                if (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.left, ast.Name)
+                    and node.left.id in buckets
+                    and isinstance(node.right, ast.Constant)
+                    and node.right.value == 1
+                ):
+                    call = buckets[node.left.id]
+                    arg = call.args[0] if call.args else None
+                    reserves = (
+                        isinstance(arg, ast.BinOp)
+                        and isinstance(arg.op, ast.Add)
+                        and (
+                            _int_const(arg.right) == 1
+                            or _int_const(arg.left) == 1
+                        )
+                    )
+                    if not reserves:
+                        self.emit(
+                            FAM_SHAPE,
+                            "reserved-slot",
+                            sf,
+                            call.lineno,
+                            f"frontier bucket {node.left.id} uses "
+                            f"{node.left.id} - 1 as a padding slot but "
+                            f"its _next_bucket argument does not reserve "
+                            f"it (+ 1): real segment ids can collide "
+                            f"with the padding slot",
+                        )
+
+    # -- collectives -------------------------------------------------------
+
+    def check_collective(
+        self, fa: FnAnalysis, call: ast.Call, name: str
+    ) -> None:
+        # axis names: positional slot 1 (axis_index: slot 0), or the
+        # axis_name keyword; literal strings / tuples only
+        axis_node = _kwarg(call, "axis_name")
+        if axis_node is None:
+            slot = 0 if name == "axis_index" else 1
+            if len(call.args) > slot:
+                axis_node = call.args[slot]
+        if axis_node is not None and self.vocab:
+            for axis in _const_strs(axis_node):
+                if axis not in self.vocab:
+                    self.emit(
+                        FAM_COLL,
+                        "unknown-axis",
+                        fa.sf,
+                        call.lineno,
+                        f"lax.{name} names mesh axis {axis!r} which is "
+                        f"not in the mesh axis vocabulary "
+                        f"{sorted(self.vocab)}",
+                    )
+        if name == "ppermute":
+            perm_node = _kwarg(call, "perm")
+            if perm_node is None and len(call.args) > 2:
+                perm_node = call.args[2]
+            if isinstance(perm_node, (ast.List, ast.Tuple)):
+                self._check_perm_literal(fa, call, perm_node)
+
+    def _check_perm_literal(
+        self, fa: FnAnalysis, call: ast.Call, perm: ast.AST
+    ) -> None:
+        srcs: List[int] = []
+        dsts: List[int] = []
+        for pair in perm.elts:
+            if not (
+                isinstance(pair, (ast.Tuple, ast.List))
+                and len(pair.elts) == 2
+            ):
+                self.emit(
+                    FAM_COLL,
+                    "perm-malformed",
+                    fa.sf,
+                    call.lineno,
+                    "lax.ppermute perm entries must be (source, dest) "
+                    "pairs",
+                )
+                return
+            s, d = _int_const(pair.elts[0]), _int_const(pair.elts[1])
+            if s is None or d is None:
+                return  # dynamic entries: cannot prove anything
+            srcs.append(s)
+            dsts.append(d)
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            self.emit(
+                FAM_COLL,
+                "perm-malformed",
+                fa.sf,
+                call.lineno,
+                f"lax.ppermute perm is not a permutation: sources "
+                f"{srcs} / dests {dsts} contain duplicates (a device "
+                f"would receive two messages)",
+            )
+
+    # -- float64 in traced code --------------------------------------------
+
+    def _scan_float64(
+        self, sf: SourceFile, mv: ModuleView, traced: Set[FunctionInfo]
+    ) -> None:
+        traced_nodes = {fi.node for fi in traced if fi.sf.rel == sf.rel}
+        for fn in traced_nodes:
+            for node in _walk_shallow(fn):
+                hit = None
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "float64"
+                ):
+                    hit = dotted_name(node) or "float64"
+                elif (
+                    isinstance(node, ast.Constant)
+                    and node.value == "float64"
+                ):
+                    hit = "'float64'"
+                if hit is not None:
+                    self.emit(
+                        FAM_DTYPE,
+                        "weak-float64",
+                        sf,
+                        node.lineno,
+                        f"{hit} inside traced code: x64 is disabled on "
+                        f"the accelerator path, so this weakly demotes "
+                        f"(or forces a recompile under jax_enable_x64)",
+                    )
+
+    def _scan_collectives(self, sf: SourceFile, mv: ModuleView) -> None:
+        # collective sites OUTSIDE the interpreted set still get their
+        # conformance checks (the interpreter already covered traced fns,
+        # but a module-level or helper collective must not escape)
+        interpreted: Set[int] = set()
+        traced, _ = traced_function_infos(self.ctx)
+        for fi in traced:
+            if fi.sf.rel == sf.rel:
+                for node in walk_nodes(fi.node):
+                    if isinstance(node, ast.Call):
+                        interpreted.add(id(node))
+        for fi_node in walk_nodes(sf.tree):
+            if not isinstance(fi_node, ast.Call):
+                continue
+            if id(fi_node) in interpreted:
+                continue
+            chain = dotted_name(fi_node.func) or ""
+            parts = chain.split(".")
+            name = parts[-1]
+            if name not in _AXIS_COLLECTIVES:
+                continue
+            is_lax = (
+                (len(parts) == 2 and parts[0] in mv.lax)
+                or (len(parts) >= 3 and parts[-2] == "lax")
+            )
+            if not is_lax:
+                continue
+            shim = _StructuralShim(sf, self.views[sf.rel])
+            self.check_collective(shim, fi_node, name)
+
+
+class _StructuralShim:
+    """Minimal FnAnalysis stand-in for structural collective checks."""
+
+    def __init__(self, sf: SourceFile, mv: ModuleView):
+        self.sf = sf
+        self.mv = mv
+
+
+# --------------------------------------------------------------------------
+# shared-cache entry point + the four rule families
+# --------------------------------------------------------------------------
+
+
+def shapeflow_findings(
+    ctx: AnalysisContext,
+) -> Dict[str, List[Tuple[str, SourceFile, int, str]]]:
+    cached = getattr(ctx, "_shapeflow", None)
+    if cached is not None:
+        return cached
+    t0 = time.perf_counter()
+    pass_ = _ShapeFlowPass(ctx)
+    pass_.run()
+    LAST_SHAPEFLOW_STATS.clear()
+    LAST_SHAPEFLOW_STATS.update(
+        {
+            "contracts": len(pass_.contracts),
+            "functions": pass_.functions_seen,
+            "calls_checked": pass_.calls_checked,
+            "inferred": pass_.inferred,
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+    )
+    ctx._shapeflow = pass_.findings
+    return pass_.findings
+
+
+class _ShapeFlowRule(Rule):
+    family = ""
+
+    def run(self, ctx: AnalysisContext):
+        for check, sf, line, msg in shapeflow_findings(ctx).get(
+            self.family, []
+        ):
+            yield self.finding(check, sf, line, msg)
+
+
+@register
+class ShapeMismatchRule(_ShapeFlowRule):
+    name = FAM_SHAPE
+    family = FAM_SHAPE
+    description = (
+        "provable shape conflicts in traced kernels: broadcast/rank "
+        "errors, contract violations at call/return seams, unguarded "
+        "tile splits, unreserved padding slots"
+    )
+    severity = "error"
+
+
+@register
+class SentinelOverflowRule(_ShapeFlowRule):
+    name = FAM_SENT
+    family = FAM_SENT
+    description = (
+        "int32 sentinel arithmetic leaving the INF band: additions of "
+        "two maybe-INF values with no dominating jnp.minimum(..., INF) "
+        "clamp, collective sums of sentinel operands"
+    )
+    severity = "error"
+
+
+@register
+class DtypePromotionRule(_ShapeFlowRule):
+    name = FAM_DTYPE
+    family = FAM_DTYPE
+    description = (
+        "silent dtype promotion inside traced code: int->float "
+        "promotion, bool masks in arithmetic, int true division, "
+        "float64 on the accelerator path"
+    )
+    severity = "advisory"
+
+
+@register
+class CollectiveConformanceRule(_ShapeFlowRule):
+    name = FAM_COLL
+    family = FAM_COLL
+    description = (
+        "lax collective conformance: axis names must be in the mesh "
+        "axis vocabulary, ppermute perms must be well-formed "
+        "permutations"
+    )
+    severity = "error"

@@ -41,6 +41,7 @@ from openr_tpu.analysis.core import (
     call_name,
     dotted_name,
     register,
+    walk_nodes,
 )
 
 _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -53,7 +54,7 @@ _WRAPPER_CALLS = ("jit", "shard_map")
 def _partition_spec_aliases(tree: ast.AST) -> Set[str]:
     """Local names bound to jax.sharding.PartitionSpec ('P' by idiom)."""
     aliases: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.ImportFrom) and node.module and (
             node.module.endswith("sharding") or node.module == "jax"
         ):
@@ -69,7 +70,7 @@ def mesh_axis_vocabulary(ctx: AnalysisContext) -> Set[str]:
     axis_names= kwargs anywhere."""
     vocab: Set[str] = set()
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if isinstance(node, _FuncDef) and node.name == "make_mesh":
                 args = node.args
                 names = args.posonlyargs + args.args + args.kwonlyargs
@@ -126,7 +127,7 @@ def _positional_arity(fn) -> Optional[range]:
 def _return_arity(fn) -> Optional[int]:
     """Consistent tuple-return length of a def; None when mixed/opaque."""
     lengths: Set[int] = set()
-    for node in ast.walk(fn):
+    for node in walk_nodes(fn):
         if isinstance(node, _FuncDef) and node is not fn:
             continue
         if isinstance(node, ast.Return) and node.value is not None:
@@ -171,7 +172,7 @@ class ShardSpecRule(Rule):
         for mod in cg.modules.values():
             sf = mod.sf
             p_aliases = _partition_spec_aliases(sf.tree)
-            for node in ast.walk(sf.tree):
+            for node in walk_nodes(sf.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 yield from self._check_axis_names(
@@ -288,7 +289,7 @@ class ShardSpecRule(Rule):
 
 def mesh_shape_subscripts(tree: ast.AST):
     """(axis, line) of every mesh.shape['axis'] lookup in a module."""
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if (
             isinstance(node, ast.Subscript)
             and isinstance(node.value, ast.Attribute)

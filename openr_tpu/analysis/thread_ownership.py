@@ -72,6 +72,7 @@ from openr_tpu.analysis.core import (
     SourceFile,
     dotted_name,
     register,
+    walk_nodes,
 )
 from openr_tpu.analysis.dataflow import AliasTracker, alias_chain_text
 
@@ -141,7 +142,7 @@ def external_surface(ctx: AnalysisContext) -> Set[str]:
     request paths (lifecycle methods excluded)."""
     surface: Set[str] = set()
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in walk_nodes(sf.tree):
             if not (
                 isinstance(node, ast.ClassDef) and node.name == "CtrlServer"
             ):
@@ -155,7 +156,7 @@ def external_surface(ctx: AnalysisContext) -> Set[str]:
                 )
             ]
             for method in request_methods:
-                for sub in ast.walk(method):
+                for sub in walk_nodes(method):
                     if isinstance(sub, ast.Call) and isinstance(
                         sub.func, ast.Attribute
                     ):
@@ -186,7 +187,7 @@ def _marked_attrs(sf: SourceFile, cls: ast.ClassDef, marker) -> Set[str]:
     marked: Set[str] = set()
     for node in cls.body:
         if isinstance(node, _FuncDef) and node.name == "__init__":
-            for sub in ast.walk(node):
+            for sub in walk_nodes(node):
                 if isinstance(sub, (ast.Assign, ast.AnnAssign)):
                     targets = (
                         sub.targets
@@ -233,7 +234,7 @@ def _lock_spans(fn) -> List[Tuple[int, int]]:
     post-filter for alias-engine findings (the engine itself is
     context-free by design)."""
     spans: List[Tuple[int, int]] = []
-    for node in ast.walk(fn):
+    for node in walk_nodes(fn):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 name = dotted_name(item.context_expr) or ""
@@ -321,7 +322,7 @@ class ThreadOwnershipRule(Rule):
         if not surface:
             return  # no ctrl server in scope; nothing is reachable
         for sf in ctx.files:
-            for cls in ast.walk(sf.tree):
+            for cls in walk_nodes(sf.tree):
                 if not isinstance(cls, ast.ClassDef):
                     continue
                 owner = _decorator_owner(cls)

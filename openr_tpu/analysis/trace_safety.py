@@ -59,6 +59,7 @@ from openr_tpu.analysis.core import (
     call_name,
     dotted_name,
     register,
+    walk_nodes,
 )
 
 _STATIC_ATTRS = {"ndim", "shape", "dtype", "size"}
@@ -73,7 +74,7 @@ _FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
 def _jax_numpy_aliases(tree: ast.AST) -> Set[str]:
     """Module aliases whose calls are tracer-valued (jax.numpy, jax, lax)."""
     aliases: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name in ("jax", "jax.numpy", "jax.lax"):
@@ -88,7 +89,7 @@ def _jax_numpy_aliases(tree: ast.AST) -> Set[str]:
 
 def _numpy_aliases(tree: ast.AST) -> Set[str]:
     aliases: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "numpy":
@@ -123,7 +124,7 @@ def _jit_decorated(fn) -> bool:
 
 
 def _collect_defs(tree: ast.AST) -> List:
-    return [n for n in ast.walk(tree) if isinstance(n, _FuncDef)]
+    return [n for n in walk_nodes(tree) if isinstance(n, _FuncDef)]
 
 
 def _traced_functions(tree: ast.AST) -> Tuple[Set, Set]:
@@ -140,7 +141,7 @@ def _traced_functions(tree: ast.AST) -> Tuple[Set, Set]:
         by_name.setdefault(fn.name, []).append(fn)
 
     jit_arg_names: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.Call) and _is_jit_entry(node):
             for arg in node.args:
                 if isinstance(arg, ast.Name):
@@ -156,7 +157,7 @@ def _traced_functions(tree: ast.AST) -> Tuple[Set, Set]:
     while changed:
         changed = False
         for fn in list(traced):
-            for node in ast.walk(fn):
+            for node in walk_nodes(fn):
                 if node is fn:
                     continue
                 if isinstance(node, _FuncDef) and node not in traced:
@@ -252,7 +253,7 @@ def traced_function_infos(ctx: AnalysisContext):
                 direct.add(fi)
     # cross-module seeds: jit entries fed imported names or factory calls
     for mod in cg.modules.values():
-        for node in ast.walk(mod.sf.tree):
+        for node in walk_nodes(mod.sf.tree):
             if not (isinstance(node, ast.Call) and _is_jit_entry(node)):
                 continue
             for arg in node.args:
@@ -276,7 +277,7 @@ def traced_function_infos(ctx: AnalysisContext):
         mod = cg.modules.get(fi.module)
         if mod is None:
             continue
-        for node in ast.walk(fi.node):
+        for node in walk_nodes(fi.node):
             if node is fi.node:
                 continue
             if isinstance(node, _FuncDef):

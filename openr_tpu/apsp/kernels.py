@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.ops.graph import INF, CompiledGraph
+from openr_tpu.utils.shape_contract import shape_contract
 
 # MXU tile edge: blocks are B x B with B = min(128, n_pad); n_pad is a
 # power of two (ops/graph.py bucket padding), so B always divides it
@@ -81,6 +82,7 @@ def _profile_span(name: str):
 def fw_block_shape(n_pad: int) -> Tuple[int, int]:
     """(nb, bsz): block count and block edge for a padded node count."""
     bsz = min(_FW_BLOCK, n_pad)
+    assert n_pad % bsz == 0, (n_pad, bsz)  # bucket padding: power of two
     return n_pad // bsz, bsz
 
 
@@ -94,6 +96,9 @@ def _from_blocks(x4, nb: int, bsz: int):
     return x4.transpose(0, 2, 1, 3).reshape(nb * bsz, nb * bsz)
 
 
+@shape_contract(
+    "a:[B,B]:int32:inf", "b:[B,B]:int32:inf", returns="[B,B]:int32:inf"
+)
 def _mp(a, b):
     """(min,+) product of a [B, B] tile pair, INF-clamped.
 

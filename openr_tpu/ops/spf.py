@@ -35,6 +35,7 @@ import numpy as np
 
 from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 from openr_tpu.testing.faults import fault_point
+from openr_tpu.utils.shape_contract import shape_contract
 
 
 def _bf_allow(sources: jnp.ndarray, overloaded: jnp.ndarray) -> jnp.ndarray:
@@ -45,6 +46,12 @@ def _bf_allow(sources: jnp.ndarray, overloaded: jnp.ndarray) -> jnp.ndarray:
     return (~overloaded)[None, :] | (node_ids[None, :] == sources[:, None])
 
 
+@shape_contract(
+    "d0:[S,n_pad]:int32:inf",
+    "allow:[S,n_pad]:bool",
+    "src_e:[E]:int32",
+    "dst_e:[E]:int32",
+)
 def _bf_relax(d0, allow, src_e, dst_e, w_rows):
     """Edge-list min-plus relaxation from row-major initial state d0 to the
     fixpoint; returns (d [S, N], rounds). Like _sell_relax, any entrywise
@@ -632,6 +639,12 @@ _bf_solver_warm_vw = jax.jit(_bf_warm_vw_core)
 # size of a distance row ever moves.
 
 
+@shape_contract(
+    "tile:[S_l,n_tile]:int32:inf",
+    "ctr:[S_l,h]:int32:inf",
+    "cols:[h]:int32",
+    returns="[S_l,n_tile]:int32:inf",
+)
 def _tile_fold_min(tile, ctr, cols, me, n_tile):
     """Fold a frontier into the columns this device owns: cols outside
     [me*n_tile, (me+1)*n_tile) map to the out-of-range sentinel and are
@@ -655,6 +668,11 @@ def _tile_halo_min(ctr, cols, base, me, n_tile, g):
     return out
 
 
+@shape_contract(
+    "vals:[S_l,e_tile]:int32:inf",
+    "hseg:[e_tile]:int32",
+    returns="[S_l,h]:int32:inf",
+)
 def _tile_seg_min(vals, hseg, h):
     """Per-frontier-slot minima of per-edge values [S_l, e_tile] -> [S_l, h]
     (empty slots clamp to INF; hseg is per-tile dst-sorted, so the sorted
@@ -683,6 +701,14 @@ def _tile_d0_allow(sources, overloaded, me, n_tile):
     return d0, allow
 
 
+@shape_contract(
+    "d0:[S_l,n_tile]:int32:inf",
+    "allow:[S_l,n_tile]:bool",
+    "src_l:[e_tile]:int32",
+    "hseg:[e_tile]:int32",
+    "w2:[e_tile]:int32:inf",
+    "hcols:[h]:int32",
+)
 def _tile_relax(d0, allow, src_l, hseg, w2, hcols, me, *, g, n_tile, n_pad):
     """Min-plus relaxation of the local tile to the GLOBAL fixpoint.
 

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from openr_tpu.ops.graph import INF, CompiledGraph, _next_bucket
 from openr_tpu.ops.spf import _bf_fixpoint, _ecmp_dag, _sell_solver_raw
+from openr_tpu.utils.shape_contract import shape_contract
 
 
 def make_mesh(
@@ -159,6 +160,7 @@ class GraphTiling:
         tiled-solver executables (weight patches never change it)."""
         return (self.g, self.n_tile, self.e_tile, self.h)
 
+    @shape_contract("w_edges:[e_pad]:int32", returns="[g,e_tile]:int32:inf")
     def tile_weights(self, w_edges: np.ndarray) -> np.ndarray:
         """[e_pad] dst-sorted edge weights -> the [g, e_tile] tiled form
         (padding slots stay INF) — the per-event weight upload unit."""

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.ops.graph import INF, CompiledGraph
+from openr_tpu.utils.shape_contract import shape_contract
 
 # float-domain "unreachable": softmin arithmetic needs a finite sentinel
 # (exp(-INF/tau) underflows fine, but INF - INF poisons gradients)
@@ -67,6 +68,7 @@ def te_edge_arrays(graph: CompiledGraph):
     return src, dst, w0, up
 
 
+@shape_contract("seg:[E]:int32")
 def _segment_softmin(x, seg, n, tau):
     """Softmin over segments of x's leading axis (empty segments -> F_INF).
 
@@ -82,6 +84,13 @@ def _segment_softmin(x, seg, n, tau):
     return jnp.where(s > 0, jnp.minimum(out, F_INF), F_INF)
 
 
+@shape_contract(
+    "w:[E]:float32",
+    "src_e:[E]:int32",
+    "dst_e:[E]:int32",
+    "up:[E]:bool",
+    returns="[N,N]:float32:inf",
+)
 def _softmin_fixpoint_core(w, src_e, dst_e, up, tau, n, rounds):
     """Softmin distance-to-destination matrix D [N, N]: D[v, t] is the
     relaxed distance from v to t after `rounds` relaxations.
@@ -117,6 +126,15 @@ softmin_distances = jax.jit(
 )
 
 
+@shape_contract(
+    "w:[E]:float32",
+    "demands:[N,N]:float32",
+    "caps:[E]:float32",
+    "src_e:[E]:int32",
+    "dst_e:[E]:int32",
+    "up:[E]:bool",
+    returns="[E]:float32",
+)
 def _soft_utilization_core(
     w, demands, caps, src_e, dst_e, up, tau, n, rounds
 ):
@@ -135,8 +153,11 @@ def _soft_utilization_core(
     gap = we[:, None] + d[dst_e] - d[src_e]  # [E, N]
     node_t = jnp.arange(n, dtype=jnp.int32)
     score = jnp.exp(-jnp.maximum(gap, 0.0) / tau)
-    score = score * up[:, None]
-    score = score * (src_e[:, None] != node_t[None, :])  # absorb at dest
+    # explicit mask casts: both gates are bools, and a silent bool->float
+    # promotion is exactly what the dtype-promotion lint exists to catch
+    score = score * up[:, None].astype(score.dtype)
+    absorb = (src_e[:, None] != node_t[None, :]).astype(score.dtype)
+    score = score * absorb  # a destination node forwards nothing to itself
     score = jnp.where(d[dst_e] >= F_INF / 2, 0.0, score)  # dead ends
     denom = jax.ops.segment_sum(score, src_e, num_segments=n)  # [N, N]
     # double-where: the masked branch must be NaN-free in the BACKWARD

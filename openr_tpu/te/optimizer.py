@@ -32,6 +32,7 @@ from openr_tpu.te.objective import (
     _soft_utilization_core,
     hard_max_util,
 )
+from openr_tpu.utils.shape_contract import shape_contract
 
 
 @dataclass(frozen=True)
@@ -74,6 +75,15 @@ class TeOptResult:
     d2h_bytes: int = 0
 
 
+@shape_contract(
+    "w:[E]:float32",
+    "demands:[B,N,N]:float32",
+    "scen_mask:[B]:float32",
+    "caps:[E]:float32",
+    "src_e:[E]:int32",
+    "dst_e:[E]:int32",
+    "up:[E]:bool",
+)
 def _loss_core(
     w, demands, scen_mask, caps, src_e, dst_e, up, tau, tau_obj, n, rounds
 ):

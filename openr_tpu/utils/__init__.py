@@ -1,16 +1,21 @@
 """Utility substrate: backoff, debounce, throttle, step detection.
 
 Equivalents of openr/common/{ExponentialBackoff,AsyncDebounce,AsyncThrottle,
-StepDetector}.h, rebuilt on asyncio instead of folly EventBase.
+StepDetector}.h, rebuilt on asyncio instead of folly EventBase. Also home
+to the @shape_contract kernel annotation the ShapeFlow static analysis
+seeds from (utils/shape_contract.py, docs/Analysis.md).
 """
 
 from openr_tpu.utils.backoff import ExponentialBackoff
 from openr_tpu.utils.async_util import AsyncDebounce, AsyncThrottle
+from openr_tpu.utils.shape_contract import ContractError, shape_contract
 from openr_tpu.utils.step_detector import StepDetector
 
 __all__ = [
     "ExponentialBackoff",
     "AsyncDebounce",
     "AsyncThrottle",
+    "ContractError",
     "StepDetector",
+    "shape_contract",
 ]

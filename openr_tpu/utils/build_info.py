@@ -85,4 +85,13 @@ def get_analysis_build_info() -> Dict[str, str]:
                 meta["analysis_rule_stats"].items()
             )
         )
+    if "analysis_contracts" in meta:
+        # ShapeFlow pass shape: how many @shape_contract annotations were
+        # verified, how many functions were interpreted/inferred, and the
+        # pass wall time — `contracts=12,functions=41,inferred=29:83.0ms`
+        sf = meta["analysis_contracts"]
+        info["build_analysis_contracts"] = (
+            f"contracts={sf['contracts']},functions={sf['functions']},"
+            f"inferred={sf['inferred']}:{sf['wall_ms']:.1f}ms"
+        )
     return info

@@ -1,0 +1,188 @@
+"""Shape contracts: declared (shape, dtype, sentinel) intent for kernels.
+
+The ShapeFlow abstract interpreter (openr_tpu/analysis/shapeflow.py) walks
+every jit-reachable function propagating symbolic shapes, dtypes, and the
+INF-sentinel lattice. Kernel authors can *declare* what a function expects
+instead of leaving the interpreter to infer it:
+
+    @shape_contract("a:[B,B]:int32:inf", "b:[B,B]:int32:inf",
+                    returns="[B,B]:int32:inf")
+    def _mp(a, b):
+        return jnp.min(jnp.minimum(a[:, :, None] + b[None, :, :], INF),
+                       axis=1)
+
+Contract grammar (one string per parameter, in any order):
+
+    <param>:[<dim>,<dim>,...]:<dtype>[:inf]
+
+  - <param>   must name a parameter of the decorated function (checked at
+    import time, so a typo fails the test run, not a trace);
+  - <dim>     a symbolic dimension name (`n_pad`, `S`, `B` — unified by
+    name across the contract and against module constants like
+    `_FW_BLOCK = 128`), or an integer literal;
+  - <dtype>   int32 / float32 / bool / ... (jnp dtype spelling);
+  - :inf      marks the value as living in the INF-sentinel domain
+    (maybe-INF: every element is <= INF). The sentinel-overflow rule
+    seeds from this marker.
+
+`returns=` takes the same spec with the leading name optional.
+
+The decorator is a pure annotation: it parses + validates the strings and
+stores them on `fn.__shape_contract__`, then returns the *original*
+function object — zero wrapper, zero tracing overhead, safe under
+jax.jit/shard_map. The analysis side re-parses the same grammar from the
+AST (it never imports kernel modules), so this module is the single
+source of truth for the syntax.
+"""
+
+from __future__ import annotations
+
+import inspect
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+Dim = Union[int, str]
+
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+_DTYPES = {
+    "bool",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "bfloat16",
+    "float16",
+    "float32",
+    "float64",
+}
+
+
+class ContractError(ValueError):
+    """A malformed contract string (raised at import time)."""
+
+
+@dataclass(frozen=True)
+class ArraySpec:
+    """One operand's declared (shape, dtype, sentinel) triple."""
+
+    name: str  # parameter name; '' for an anonymous returns spec
+    dims: Tuple[Dim, ...]  # symbolic names and/or integer literals
+    dtype: str
+    inf: bool = False  # True: values live in the INF-sentinel domain
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def render(self) -> str:
+        dims = ",".join(str(d) for d in self.dims)
+        tail = ":inf" if self.inf else ""
+        head = f"{self.name}:" if self.name else ""
+        return f"{head}[{dims}]:{self.dtype}{tail}"
+
+
+@dataclass
+class Contract:
+    params: Dict[str, ArraySpec] = field(default_factory=dict)
+    returns: Optional[ArraySpec] = None
+
+    def specs(self) -> List[ArraySpec]:
+        out = list(self.params.values())
+        if self.returns is not None:
+            out.append(self.returns)
+        return out
+
+
+def parse_spec(text: str, anonymous_ok: bool = False) -> ArraySpec:
+    """Parse one `name:[dims]:dtype[:inf]` spec string."""
+    raw = text.strip()
+    lb = raw.find("[")
+    rb = raw.find("]")
+    if lb < 0 or rb < lb:
+        raise ContractError(f"contract spec needs a [dims] block: {text!r}")
+    name = raw[:lb].rstrip(":").strip()
+    if name and not _NAME_RE.match(name):
+        raise ContractError(f"bad operand name in contract spec: {text!r}")
+    if not name and not anonymous_ok:
+        raise ContractError(
+            f"parameter contract spec needs a leading name: {text!r}"
+        )
+    dims_text = raw[lb + 1 : rb].strip()
+    dims: List[Dim] = []
+    if dims_text:
+        for tok in dims_text.split(","):
+            tok = tok.strip()
+            if not tok:
+                raise ContractError(f"empty dim in contract spec: {text!r}")
+            if tok.lstrip("-").isdigit():
+                val = int(tok)
+                if val <= 0:
+                    raise ContractError(
+                        f"dims must be positive: {text!r}"
+                    )
+                dims.append(val)
+            elif _NAME_RE.match(tok):
+                dims.append(tok)
+            else:
+                raise ContractError(f"bad dim token {tok!r} in {text!r}")
+    tail = raw[rb + 1 :].lstrip(":")
+    parts = [p for p in tail.split(":") if p]
+    if not parts:
+        raise ContractError(f"contract spec needs a dtype: {text!r}")
+    dtype = parts[0]
+    if dtype not in _DTYPES:
+        raise ContractError(f"unknown dtype {dtype!r} in {text!r}")
+    inf = False
+    for extra in parts[1:]:
+        if extra == "inf":
+            inf = True
+        else:
+            raise ContractError(f"unknown contract marker {extra!r} in {text!r}")
+    return ArraySpec(name=name, dims=tuple(dims), dtype=dtype, inf=inf)
+
+
+def parse_contract(
+    specs: Tuple[str, ...], returns: Optional[str] = None
+) -> Contract:
+    contract = Contract()
+    for text in specs:
+        spec = parse_spec(text)
+        if spec.name in contract.params:
+            raise ContractError(f"duplicate contract for {spec.name!r}")
+        contract.params[spec.name] = spec
+    if returns is not None:
+        contract.returns = parse_spec(returns, anonymous_ok=True)
+    return contract
+
+
+def shape_contract(*specs: str, returns: Optional[str] = None):
+    """Attach a parsed shape contract to a kernel function.
+
+    Validates the grammar and the parameter names eagerly (import time),
+    then returns the original function untouched — the contract is an
+    annotation the static analyzer reads, never a runtime wrapper.
+    """
+    contract = parse_contract(specs, returns=returns)
+
+    def attach(fn):
+        try:
+            sig_params = set(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            sig_params = None
+        if sig_params is not None:
+            unknown = set(contract.params) - sig_params
+            if unknown:
+                raise ContractError(
+                    f"@shape_contract on {fn.__name__}: "
+                    f"{sorted(unknown)} are not parameters "
+                    f"(has {sorted(sig_params)})"
+                )
+        fn.__shape_contract__ = contract
+        return fn
+
+    return attach

@@ -780,6 +780,10 @@ def test_self_run_covers_all_rule_families():
         "device-transfer",
         "recompile-risk",
         "shard-spec",
+        "shape-mismatch",
+        "sentinel-overflow",
+        "dtype-promotion",
+        "collective-conformance",
     }
 
 
@@ -1625,3 +1629,888 @@ def test_trace_safety_reaches_fw_apsp_kernels():
         "build_weight_matrix",
         "build_allow_matrix",
     } & traced_names
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow (v3.0): the four abstract-interpretation families
+# ---------------------------------------------------------------------------
+
+_SF_SHAPE_BAD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:float32", returns="[N,N]:float32")
+@jax.jit
+def outer(x):
+    return x
+
+
+@jax.jit
+def mixed():
+    return jnp.zeros((4,)) + jnp.zeros((8,))
+
+
+def split(n_pad, g):
+    n_tile = n_pad // g
+    return n_tile
+'''
+
+_SF_SHAPE_GOOD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:float32", returns="[N]:float32")
+@jax.jit
+def outer(x):
+    return x * 2.0
+
+
+@jax.jit
+def mixed():
+    return jnp.zeros((4, 1)) + jnp.zeros((4, 8))
+
+
+def split(n_pad, g):
+    assert n_pad % g == 0, (n_pad, g)
+    n_tile = n_pad // g
+    return n_tile
+'''
+
+_SF_SHAPE_SUPPRESSED = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:float32", returns="[N,N]:float32")
+@jax.jit
+def outer(x):
+    return x  # analysis: ignore[shape-mismatch]
+
+
+@jax.jit
+def mixed():
+    # analysis: ignore[shape-mismatch]
+    return jnp.zeros((4,)) + jnp.zeros((8,))
+
+
+def split(n_pad, g):
+    n_tile = n_pad // g  # analysis: ignore[shape-mismatch]
+    return n_tile
+'''
+
+
+def test_shape_mismatch_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_shape.py", _SF_SHAPE_BAD)
+    found, _ = _findings([path], rule="shape-mismatch")
+    checks = sorted(f.check for f in found)
+    assert checks == [
+        "broadcast", "return-contract", "tile-divisibility",
+    ], found
+    assert all(f.severity == "error" for f in found)
+
+
+def test_shape_mismatch_negative(tmp_path):
+    path = _write(tmp_path, "good_shape.py", _SF_SHAPE_GOOD)
+    found, _ = _findings([path], rule="shape-mismatch")
+    assert found == [], found
+
+
+def test_shape_mismatch_suppression(tmp_path):
+    path = _write(tmp_path, "waived_shape.py", _SF_SHAPE_SUPPRESSED)
+    found, suppressed = _findings([path], rule="shape-mismatch")
+    assert found == [] and suppressed == 3
+
+
+def test_shape_mismatch_cli_exits_nonzero(tmp_path):
+    path = _write(tmp_path, "bad_shape.py", _SF_SHAPE_BAD)
+    assert analysis_main([str(path), "--no-baseline"]) == 1
+
+
+_SF_CONTRACT_BAD = '''
+import jax
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:float13")
+@jax.jit
+def f(x):
+    return x
+
+
+@shape_contract("y:[N]:int32")
+@jax.jit
+def g(x):
+    return x
+'''
+
+
+def test_shape_contract_decorator_findings(tmp_path):
+    """A malformed spec string and a contract naming a non-parameter are
+    findings on the decorator line, not silent no-ops."""
+    path = _write(tmp_path, "bad_contract.py", _SF_CONTRACT_BAD)
+    found, _ = _findings([path], rule="shape-mismatch")
+    checks = sorted(f.check for f in found)
+    assert checks == ["contract-params", "contract-syntax"], found
+
+
+def test_shape_contract_runtime_decorator():
+    """The runtime decorator validates eagerly, attaches the parsed
+    contract, and returns the ORIGINAL function (zero wrapper overhead:
+    jit traces the same object it would have without the annotation)."""
+    import pytest
+
+    from openr_tpu.utils.shape_contract import (
+        ContractError,
+        parse_contract,
+        shape_contract,
+    )
+
+    def mp(a, b):
+        return a
+
+    wrapped = shape_contract(
+        "a:[B,B]:int32:inf", "b:[B,B]:int32:inf",
+        returns="[B,B]:int32:inf",
+    )(mp)
+    assert wrapped is mp
+    contract = mp.__shape_contract__
+    assert set(contract.params) == {"a", "b"}
+    assert list(contract.params["a"].dims) == ["B", "B"]
+    assert contract.params["a"].dtype == "int32"
+    assert contract.params["a"].inf
+    assert contract.returns is not None and contract.returns.inf
+    with pytest.raises(ContractError):
+        shape_contract("a:[B:int32")(lambda a: a)
+    with pytest.raises(ContractError):
+        shape_contract("a:[B]:notadtype")(lambda a: a)
+    with pytest.raises(ContractError):
+        parse_contract(["a:[B]:int32"], returns="[B]:int32:bogus")
+
+
+_SF_CALL_BAD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+B = 128
+
+
+@shape_contract("a:[B,B]:int32", "b:[B,B]:int32", returns="[B,B]:int32")
+def mp(a, b):
+    return jnp.minimum(a, b)
+
+
+@jax.jit
+def sweep():
+    tile = jnp.zeros((128, 64), dtype=jnp.int32)
+    flat = jnp.zeros((128,), dtype=jnp.int32)
+    mp(tile, tile)
+    mp(flat, flat)
+    return tile
+'''
+
+_SF_CALL_GOOD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("d:[S,n_pad]:int32:inf", returns="[S,n_pad]:int32:inf")
+def relax(d):
+    return jnp.minimum(d, 1 << 29)
+
+
+@shape_contract("d0:[S,n_pad]:int32:inf")
+@jax.jit
+def drive(d0):
+    d1 = relax(d0)
+    d2 = relax(d1)
+    return d2
+'''
+
+
+def test_call_contract_checked_at_the_seam(tmp_path):
+    """Every resolved call against an annotated callee is verified: the
+    module constant B = 128 binds the contract symbol, so a 64-wide tile
+    is a dim conflict and a rank-1 operand is a rank conflict — for each
+    mis-shaped parameter."""
+    path = _write(tmp_path, "bad_call.py", _SF_CALL_BAD)
+    found, _ = _findings([path], rule="shape-mismatch")
+    assert [f.check for f in found] == ["call-contract"] * 4, found
+    msgs = " | ".join(f.message for f in found)
+    assert "B=128" in msgs  # the symbol carries its bound value
+    assert "rank 1 != 2" in msgs
+
+
+def test_call_contract_symbolic_dims_unify_across_calls(tmp_path):
+    """Symbolic dims thread through call seams without false positives:
+    the contract return of one call feeds the next call's params, each
+    with fresh-renamed symbols unified against the caller's."""
+    path = _write(tmp_path, "good_call.py", _SF_CALL_GOOD)
+    found, _ = _findings([path], rule="shape-mismatch")
+    assert found == [], found
+
+
+_SF_SENT_BAD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+INF = 1 << 29
+
+
+@shape_contract("d:[N,N]:int32:inf", "w:[N,N]:int32:inf")
+@jax.jit
+def relax(d, w):
+    return d + w
+
+
+@jax.jit
+def fold(d, w):
+    best = jnp.minimum(d + w, INF)
+    worst = d + w
+    return best, worst
+
+
+@shape_contract("d:[N,N]:int32:inf")
+@jax.jit
+def spread(d):
+    return jax.lax.psum(d, "batch")
+'''
+
+_SF_SENT_GOOD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+INF = 1 << 29
+
+
+@shape_contract(
+    "d:[N,N]:int32:inf", "w:[N,N]:int32:inf", returns="[N,N]:int32:inf"
+)
+@jax.jit
+def relax(d, w):
+    return jnp.minimum(d + w, INF)
+
+
+@shape_contract("d:[N,N]:int32:inf")
+@jax.jit
+def spread(d):
+    return jax.lax.pmin(d, "batch")
+'''
+
+_SF_SENT_SUPPRESSED = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+INF = 1 << 29
+
+
+@shape_contract("d:[N,N]:int32:inf", "w:[N,N]:int32:inf")
+@jax.jit
+def relax(d, w):
+    return d + w  # analysis: ignore[sentinel-overflow]
+'''
+
+
+def test_sentinel_overflow_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_sent.py", _SF_SENT_BAD)
+    found, _ = _findings([path], rule="sentinel-overflow")
+    checks = sorted(f.check for f in found)
+    assert checks == [
+        "psum-sentinel", "unclamped-add", "unclamped-add",
+    ], found
+    assert all(f.severity == "error" for f in found)
+
+
+def test_sentinel_overflow_negative(tmp_path):
+    path = _write(tmp_path, "good_sent.py", _SF_SENT_GOOD)
+    found, _ = _findings([path], rule="sentinel-overflow")
+    assert found == [], found
+
+
+def test_sentinel_overflow_suppression(tmp_path):
+    path = _write(tmp_path, "waived_sent.py", _SF_SENT_SUPPRESSED)
+    found, suppressed = _findings([path], rule="sentinel-overflow")
+    assert found == [] and suppressed == 1
+
+
+def test_sentinel_overflow_cli_exits_nonzero(tmp_path):
+    path = _write(tmp_path, "bad_sent.py", _SF_SENT_BAD)
+    assert analysis_main([str(path), "--no-baseline"]) == 1
+
+
+def test_sentinel_inference_summaries_persist_per_file_sha(tmp_path):
+    """Unannotated traced functions get their sentinel params INFERRED
+    (fold's clamp marks d and w), and the summary lands in the shared
+    cache keyed by file sha — the second run serves it from the cache
+    (inferred == 0) and reports identically."""
+    import json
+
+    from openr_tpu.analysis.shapeflow import LAST_SHAPEFLOW_STATS
+
+    path = _write(tmp_path, "fold.py", _SF_SENT_BAD)
+    found1, _ = _findings([path], rule="sentinel-overflow")
+    assert LAST_SHAPEFLOW_STATS["inferred"] == 1  # fold, no contract
+    cache = tmp_path / ".analysis-cache.json"
+    assert cache.exists()
+    payload = json.loads(cache.read_text())
+    entry = payload["shapeflow"]["files"]["fold.py"]
+    assert entry["functions"]["fold::fold"] == ["d", "w"]
+    found2, _ = _findings([path], rule="sentinel-overflow")
+    assert LAST_SHAPEFLOW_STATS["inferred"] == 0  # served from cache
+    assert [f.key() for f in found2] == [f.key() for f in found1]
+
+
+_SF_DTYPE_BAD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:int32", "m:[N]:bool")
+@jax.jit
+def score(x, m):
+    y = x * m
+    z = x / 4
+    w = x + 1.5
+    return y, z, w
+
+
+@jax.jit
+def demote(x):
+    return x.astype(jnp.float64)
+'''
+
+_SF_DTYPE_GOOD = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:int32", "m:[N]:bool")
+@jax.jit
+def score(x, m):
+    xf = x.astype(jnp.float32)
+    y = xf * m.astype(jnp.float32)
+    z = x // 4
+    w = xf + 1.5
+    return y, z, w
+'''
+
+_SF_DTYPE_SUPPRESSED = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+
+@shape_contract("x:[N]:int32", "m:[N]:bool")
+@jax.jit
+def score(x, m):
+    y = x * m  # analysis: ignore[dtype-promotion]
+    z = x / 4  # analysis: ignore[dtype-promotion]
+    w = x + 1.5  # analysis: ignore[dtype-promotion]
+    return y, z, w
+
+
+@jax.jit
+def demote(x):
+    return x.astype(jnp.float64)  # analysis: ignore[dtype-promotion]
+'''
+
+
+def test_dtype_promotion_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_dtype.py", _SF_DTYPE_BAD)
+    found, _ = _findings([path], rule="dtype-promotion")
+    checks = sorted(f.check for f in found)
+    assert checks == [
+        "bool-arith", "int-true-div", "silent-promotion", "weak-float64",
+    ], found
+    # the family is registered advisory; strict promoted these to error
+    assert RULES["dtype-promotion"].severity == "advisory"
+    assert all(f.severity == "error" for f in found)
+
+
+def test_dtype_promotion_negative(tmp_path):
+    path = _write(tmp_path, "good_dtype.py", _SF_DTYPE_GOOD)
+    found, _ = _findings([path], rule="dtype-promotion")
+    assert found == [], found
+
+
+def test_dtype_promotion_suppression(tmp_path):
+    path = _write(tmp_path, "waived_dtype.py", _SF_DTYPE_SUPPRESSED)
+    found, suppressed = _findings([path], rule="dtype-promotion")
+    assert found == [] and suppressed == 4
+
+
+def test_dtype_promotion_is_advisory_unless_strict(tmp_path):
+    path = _write(tmp_path, "bad_dtype.py", _SF_DTYPE_BAD)
+    assert analysis_main([str(path), "--no-baseline"]) == 0
+    assert analysis_main([str(path), "--no-baseline", "--strict"]) == 1
+
+
+_SF_COLL_BAD = '''
+import jax
+import jax.numpy as jnp
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return None
+
+
+def halo(ctr, g):
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    ctr = jax.lax.ppermute(ctr, "grahp", perm)
+    return jax.lax.ppermute(ctr, "graph", [(0, 1), (0, 0)])
+'''
+
+_SF_COLL_GOOD = '''
+import jax
+import jax.numpy as jnp
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return None
+
+
+def halo(ctr, g):
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    ctr = jax.lax.ppermute(ctr, "graph", perm)
+    return jax.lax.ppermute(ctr, "batch", [(0, 1), (1, 0)])
+'''
+
+_SF_COLL_SUPPRESSED = '''
+import jax
+import jax.numpy as jnp
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return None
+
+
+def halo(ctr, g):
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    # analysis: ignore[collective-conformance]
+    ctr = jax.lax.ppermute(ctr, "grahp", perm)
+    # analysis: ignore[collective-conformance]
+    return jax.lax.ppermute(ctr, "graph", [(0, 1), (0, 0)])
+'''
+
+
+def test_collective_conformance_fixture_violations(tmp_path):
+    path = _write(tmp_path, "bad_coll.py", _SF_COLL_BAD)
+    found, _ = _findings([path], rule="collective-conformance")
+    checks = sorted(f.check for f in found)
+    assert checks == ["perm-malformed", "unknown-axis"], found
+    msgs = " | ".join(f.message for f in found)
+    assert "'grahp'" in msgs and "duplicates" in msgs
+    assert all(f.severity == "error" for f in found)
+
+
+def test_collective_conformance_negative(tmp_path):
+    path = _write(tmp_path, "good_coll.py", _SF_COLL_GOOD)
+    found, _ = _findings([path], rule="collective-conformance")
+    assert found == [], found
+
+
+def test_collective_conformance_suppression(tmp_path):
+    path = _write(tmp_path, "waived_coll.py", _SF_COLL_SUPPRESSED)
+    found, suppressed = _findings([path], rule="collective-conformance")
+    assert found == [] and suppressed == 2
+
+
+def test_collective_axis_check_disarms_without_vocabulary(tmp_path):
+    """Like shard-spec: a module with no mesh vocabulary in scope cannot
+    be judged — the axis check disarms instead of guessing."""
+    src = (
+        "import jax\n"
+        "def halo(ctr):\n"
+        "    return jax.lax.ppermute(ctr, 'anything', [(0, 1)])\n"
+    )
+    path = _write(tmp_path, "consumer.py", src)
+    found, _ = _findings([path], rule="collective-conformance")
+    assert found == [], found
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow: the three seeded mutations from the acceptance checklist
+# ---------------------------------------------------------------------------
+
+_MUT_FW_CLAMP = '''
+import jax
+import jax.numpy as jnp
+from openr_tpu.utils.shape_contract import shape_contract
+
+INF = 1 << 29
+
+
+@shape_contract(
+    "a:[B,B]:int32:inf", "b:[B,B]:int32:inf", returns="[B,B]:int32:inf"
+)
+@jax.jit
+def _mp(a, b):
+    return jnp.min(a[:, :, None] + b[None, :, :], axis=1)
+'''
+
+_MUT_FW_OK = _MUT_FW_CLAMP.replace(
+    "jnp.min(a[:, :, None] + b[None, :, :], axis=1)",
+    "jnp.min(jnp.minimum(a[:, :, None] + b[None, :, :], INF), axis=1)",
+)
+
+_MUT_HALO = '''
+import jax
+import jax.numpy as jnp
+
+
+def make_mesh(devices=None, shape=None, axis_names=("batch", "graph")):
+    return None
+
+
+def _tile_halo_min(ctr, g):
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    return jax.lax.ppermute(ctr, "grahp", perm)
+'''
+
+_MUT_SPLIT = '''
+import jax.numpy as jnp
+
+
+def fw_block_shape(n_pad):
+    bsz = min(128, n_pad)
+    return n_pad // bsz, bsz
+'''
+
+
+def test_mutation_deleted_fw_clamp_is_exactly_one_overflow(tmp_path):
+    """ISSUE 19 acceptance: delete the INF clamp from a copy of the FW
+    block product `_mp` — exactly one error-severity sentinel-overflow
+    finding, and nothing else fires."""
+    path = _write(tmp_path, "mut_mp.py", _MUT_FW_CLAMP)
+    found, _ = _findings([path])
+    assert len(found) == 1, found
+    f = found[0]
+    assert (f.rule, f.check, f.severity) == (
+        "sentinel-overflow", "unclamped-add", "error",
+    )
+    # restoring the clamp (the shipped `_mp` body) is clean again
+    ok = _write(tmp_path, "mut_mp_ok.py", _MUT_FW_OK)
+    found, _ = _findings([ok])
+    assert found == [], found
+
+
+def test_mutation_swapped_ppermute_axis_is_exactly_one_conformance(
+    tmp_path,
+):
+    """ISSUE 19 acceptance: swap the halo exchange's ppermute axis name
+    for a typo — exactly one error-severity collective-conformance
+    finding against the declared mesh vocabulary."""
+    path = _write(tmp_path, "mut_halo.py", _MUT_HALO)
+    found, _ = _findings([path])
+    assert len(found) == 1, found
+    f = found[0]
+    assert (f.rule, f.check, f.severity) == (
+        "collective-conformance", "unknown-axis", "error",
+    )
+    assert "'grahp'" in f.message and "batch" in f.message
+
+
+def test_mutation_dropped_divisibility_guard_is_exactly_one_shape(
+    tmp_path,
+):
+    """ISSUE 19 acceptance: drop fw_block_shape's divisibility assert —
+    exactly one error-severity shape-mismatch finding; putting the
+    guard back silences it."""
+    path = _write(tmp_path, "mut_split.py", _MUT_SPLIT)
+    found, _ = _findings([path])
+    assert len(found) == 1, found
+    f = found[0]
+    assert (f.rule, f.check, f.severity) == (
+        "shape-mismatch", "tile-divisibility", "error",
+    )
+    guarded = _MUT_SPLIT.replace(
+        "    return n_pad // bsz, bsz",
+        "    assert n_pad % bsz == 0, (n_pad, bsz)\n"
+        "    return n_pad // bsz, bsz",
+    )
+    ok = _write(tmp_path, "mut_split_ok.py", guarded)
+    found, _ = _findings([ok])
+    assert found == [], found
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow: lattice + unification unit coverage
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_lattice_join_and_min():
+    from openr_tpu.analysis.shapeflow import (
+        S_EQ,
+        S_LT,
+        S_MAYBE,
+        S_NON,
+        S_SUM,
+        sent_join,
+        sent_min,
+    )
+
+    assert sent_join(S_LT, S_EQ) == S_MAYBE
+    assert sent_join(S_NON, S_NON) == S_NON
+    assert sent_join(S_NON, S_EQ) == S_MAYBE  # opaque branch: stay <=INF
+    assert sent_join(S_SUM, S_LT) == S_SUM  # overflow is sticky
+    assert sent_join(S_MAYBE, S_MAYBE) == S_MAYBE
+    assert sent_min(S_SUM, S_EQ) == S_EQ
+    assert sent_min(S_NON, S_EQ) == S_NON  # unknown side wins a minimum
+    assert sent_min(S_MAYBE, S_LT) == S_LT
+
+
+def test_dimenv_unification():
+    from openr_tpu.analysis.shapeflow import DimEnv
+
+    env = DimEnv({"B": 128})
+    assert env.unify("B", 128)
+    assert not env.unify("B", 64)  # concrete conflict
+    assert env.unify("N", "M")  # symbols merge into one class
+    assert env.unify("M", 32)  # binding one binds the class
+    assert env.concrete("N") == 32
+    assert not env.unify("N", 64)
+    assert env.unify(None, 7)  # wildcard unifies with anything
+
+
+def test_dimenv_broadcast_is_lenient():
+    from openr_tpu.analysis.shapeflow import DimEnv
+
+    env = DimEnv()
+    d, ok = env.broadcast_pair(1, 7)
+    assert ok and d == 7
+    _, ok = env.broadcast_pair(4, 8)
+    assert not ok
+    # symbols never merge under broadcast: either side could be 1
+    _, ok = env.broadcast_pair("N", "M")
+    assert ok
+    assert env.concrete("N") is None and env.concrete("M") is None
+    # a bound symbol against a conflicting non-1 literal IS a conflict
+    env2 = DimEnv({"N": 4})
+    _, ok = env2.broadcast_pair("N", 8)
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow: package pins + the fixed-at-source regressions
+# ---------------------------------------------------------------------------
+
+_SF_FAMILIES = {
+    "shape-mismatch",
+    "sentinel-overflow",
+    "dtype-promotion",
+    "collective-conformance",
+}
+
+
+def test_shapeflow_package_pins_fw_tile_softmin_clean():
+    """The annotated kernels the families exist to protect analyze clean:
+    the FW close (`_mp` + sweep stages), the destination-tiled halo
+    exchange in ops/spf.py, the mesh tiling, and the TE softmin /
+    utilization / loss cores — with every shipped @shape_contract
+    collected and checked."""
+    from openr_tpu.analysis.shapeflow import LAST_SHAPEFLOW_STATS
+
+    targets = [
+        PKG / "apsp" / "kernels.py",
+        PKG / "ops" / "spf.py",
+        PKG / "parallel" / "mesh.py",
+        PKG / "te" / "objective.py",
+        PKG / "te" / "optimizer.py",
+    ]
+    found, _ = _findings(targets)
+    flagged = [f for f in found if f.rule in _SF_FAMILIES]
+    assert flagged == [], flagged
+    assert LAST_SHAPEFLOW_STATS["contracts"] >= 10
+    assert LAST_SHAPEFLOW_STATS["calls_checked"] >= 1
+
+
+def test_fw_block_shape_guards_divisibility():
+    """Regression (fixed at source): fw_block_shape now asserts the
+    power-of-two divisibility the blocking scheme relies on instead of
+    silently truncating the last tile."""
+    import pytest
+
+    from openr_tpu.apsp.kernels import fw_block_shape
+
+    assert fw_block_shape(256) == (2, 128)
+    assert fw_block_shape(64) == (1, 64)
+    with pytest.raises(AssertionError):
+        fw_block_shape(192)  # 192 % 128 != 0: not a bucket-padded count
+
+
+def test_objective_masks_cast_explicitly():
+    """Regression (fixed at source): the soft-utilization bool gates cast
+    through .astype(score.dtype) instead of promoting silently, and the
+    dtype family pins the file clean."""
+    found, _ = _findings(
+        [PKG / "te" / "objective.py"], rule="dtype-promotion"
+    )
+    assert found == [], found
+    src = (PKG / "te" / "objective.py").read_text()
+    assert src.count(".astype(score.dtype)") >= 2
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (--sarif): same findings, same exit codes, CI-consumable
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_round_trip(tmp_path, capsys):
+    import json
+
+    path = _write(tmp_path, "bad_sent.py", _SF_SENT_BAD)
+    rc = analysis_main([str(path), "--no-baseline", "--strict", "--sarif"])
+    out = capsys.readouterr().out
+    assert rc == 1  # the exit-code contract is exactly the --json one
+    doc = json.loads(out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "openr-tpu-analysis"
+    assert driver["version"] == ANALYSIS_VERSION
+    assert {r["id"] for r in driver["rules"]} == set(RULES)
+    for r in driver["rules"]:
+        assert r["defaultConfiguration"]["level"] in ("error", "warning")
+    ref = run_analysis([path], strict=True)
+    got = {
+        (
+            r["ruleId"],
+            r["locations"][0]["physicalLocation"]["artifactLocation"][
+                "uri"
+            ],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"],
+            r["level"],
+        )
+        for r in run["results"]
+    }
+    want = {
+        (
+            f.rule,
+            f.path,
+            max(f.line, 1),
+            "error" if f.severity == "error" else "warning",
+        )
+        for f in ref["findings"]
+    }
+    assert got == want and len(run["results"]) == len(ref["findings"])
+    for r in run["results"]:
+        assert r["message"]["text"].startswith("[")  # [check] prefix
+    # a clean tree renders an empty result set and exits 0
+    good = _write(tmp_path, "good_sent.py", _SF_SENT_GOOD)
+    capsys.readouterr()
+    assert analysis_main([str(good), "--no-baseline", "--sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow summary cache: fingerprint + version invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_shapeflow_summary_cache_round_trip_and_fingerprint(tmp_path):
+    from openr_tpu.analysis.cache import (
+        load_shapeflow_summaries,
+        store_shapeflow_summaries,
+    )
+
+    cache = tmp_path / "cache.json"
+    files = {"pkg/mod.py": {"hash": "abc", "functions": {"f": ["d", "w"]}}}
+    store_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp1", files)
+    assert (
+        load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp1") == files
+    )
+    # a contract edit (new fingerprint) drops every inferred summary
+    assert load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp2") == {}
+    # storing under the new fingerprint does not resurrect old entries
+    store_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp2", {})
+    assert load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp2") == {}
+    assert load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp1") == {}
+
+
+def test_shapeflow_cache_stale_version_and_corruption(tmp_path):
+    import json
+
+    from openr_tpu.analysis.cache import (
+        load_shapeflow_summaries,
+        store_shapeflow_summaries,
+    )
+
+    cache = tmp_path / "cache.json"
+    files = {"pkg/mod.py": {"hash": "abc", "functions": {"f": []}}}
+    store_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp", files)
+    # an ANALYSIS_VERSION bump (rule semantics changed) invalidates all
+    payload = json.loads(cache.read_text())
+    payload["analysis_version"] = "0.0.0"
+    cache.write_text(json.dumps(payload))
+    assert load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp") == {}
+    # corruption never crashes, and the next store heals the file
+    cache.write_text("{not json")
+    assert load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp") == {}
+    store_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp", files)
+    assert (
+        load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp") == files
+    )
+
+
+def test_shapeflow_cache_coexists_with_import_graph(tmp_path):
+    """The shapeflow section and the import-graph section share one cache
+    file; writing either side preserves the other."""
+    from openr_tpu.analysis.cache import (
+        changed_closure_cached,
+        load_shapeflow_summaries,
+        store_shapeflow_summaries,
+    )
+
+    pkg = _scratch_pkg(tmp_path)
+    cache = tmp_path / "cache.json"
+    files = {"pkg/mod_b.py": {"hash": "abc", "functions": {"helper": []}}}
+    store_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp", files)
+    sel, _ = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert sorted(p.name for p in sel) == ["mod_a.py", "mod_b.py"]
+    # the import-graph rewrite kept the shapeflow section
+    assert (
+        load_shapeflow_summaries(cache, ANALYSIS_VERSION, "fp") == files
+    )
+    _, stats = changed_closure_cached(pkg, ["pkg/mod_b.py"], tmp_path, cache)
+    assert stats == {"hits": 3, "misses": 0, "files": 3}
+
+
+# ---------------------------------------------------------------------------
+# ShapeFlow: contract counts through build info
+# ---------------------------------------------------------------------------
+
+
+def test_shapeflow_contracts_surface_through_build_info():
+    """Contract/function/inference counts and the pass wall time ride
+    get_build_info -> ctrl getBuildInfo -> `breeze openr version`,
+    alongside the existing per-rule stats."""
+    from openr_tpu.utils.build_info import get_build_info
+
+    run_analysis([PKG / "apsp"])
+    sf = get_analysis_info()["analysis_contracts"]
+    assert sf["contracts"] >= 1  # _mp is annotated
+    assert sf["functions"] >= sf["contracts"]
+    assert sf["wall_ms"] > 0
+    field = get_build_info()["build_analysis_contracts"]
+    head, ms = field.rsplit(":", 1)
+    assert ms.endswith("ms")
+    pairs = dict(p.split("=", 1) for p in head.split(","))
+    assert int(pairs["contracts"]) == sf["contracts"]
+    assert int(pairs["functions"]) == sf["functions"]
+    assert int(pairs["inferred"]) == sf["inferred"]
+    from openr_tpu.ctrl.server import CtrlServer
+
+    handler = CtrlServer.__new__(CtrlServer)
+    assert "build_analysis_contracts" in handler.m_getBuildInfo({})
