@@ -38,6 +38,45 @@ from benchmarks.common import note as _note
 from benchmarks.common import time_marginal as _marginal_time
 
 
+def _mem_columns(
+    layout,
+    n_nodes,
+    structures,
+    *,
+    n_sources=1,
+    graph=None,
+    tiling=None,
+    mesh_shape=None,
+) -> dict:
+    """Device-memory columns for one bench line (docs/Monitoring.md
+    "Device-memory observatory"): the ledger's peak resident bytes for
+    the line's structures next to the predict_fit forward model — the
+    same padding/bucketing arithmetic the capacity-admission gate uses —
+    so every BENCH round records how tight the prediction tracks what
+    was actually pinned. Degraded-aware by construction: cpu-fallback
+    rounds run the identical accounting on their reduced workload."""
+    from openr_tpu.monitor.memledger import get_ledger
+
+    ledger = get_ledger()
+    verdict = ledger.predict_fit(
+        n_nodes,
+        layout,
+        n_sources=n_sources,
+        graph=graph,
+        tiling=tiling,
+        mesh_shape=mesh_shape,
+    )
+    peaks = ledger.structure_peak_bytes()
+    peak = sum(peaks.get(s, 0) for s in structures)
+    return {
+        "mem_peak_bytes": int(peak),
+        "mem_predicted_bytes": int(verdict["predicted_bytes"]),
+        "mem_predicted_vs_live_bytes": int(
+            verdict["predicted_bytes"] - peak
+        ),
+    }
+
+
 def _native_rate(graph, samples: int) -> float:
     """SPF/s of the native C++ Dijkstra on `samples` sources."""
     from openr_tpu.solver.native_spf import NativeSpfSolver
@@ -136,6 +175,21 @@ def bench_wan() -> dict:
         for i in range(len(sell.wg))
     )
 
+    # ledger registration of one event's device working set (the sell
+    # planes + one weight set + the [S, n_pad] distance block the scan
+    # materializes) — the line's mem columns read these back
+    from openr_tpu.monitor.memledger import get_ledger
+
+    ledger = get_ledger()
+    ledger.register(
+        "bench/wan", "sell", layout="sell",
+        arrays=(*nbrs, *wg_stacks[0], ov),
+    )
+    ledger.register(
+        "bench/wan", "dist", layout="sell",
+        nbytes=n_sources * graph.n_pad * 4,
+    )
+
     @partial(jax.jit, static_argnames=("reps",))
     def chained(wgv, reps):
         def body(carry, wgs_event):
@@ -187,6 +241,11 @@ def bench_wan() -> dict:
         cpu_rate = None
         baseline = "unavailable"
 
+    mem = _mem_columns(
+        "sell", graph.n, ("sell", "dist"),
+        n_sources=n_sources, graph=graph,
+    )
+    ledger.release_area("bench/wan")
     return {
         "metric": f"wan{graph.n}_spf_recomputes_per_sec",
         "value": round(tpu_rate, 1),
@@ -196,6 +255,7 @@ def bench_wan() -> dict:
         "phases": _spf_phase_split(
             solve, sources, nbrs, wg_stacks[0], ov
         ),
+        **mem,
     }
 
 
@@ -244,6 +304,19 @@ def bench_grid() -> dict:
     wg_variants = tuple(
         jnp.asarray(np.stack([ws[i] for ws in wg_stacks]))
         for i in range(len(sell.wg))
+    )
+
+    # one event's device working set on the ledger (mem columns below)
+    from openr_tpu.monitor.memledger import get_ledger
+
+    ledger = get_ledger()
+    ledger.register(
+        "bench/grid", "sell", layout="sell",
+        arrays=(*nbrs, *wg_stacks[0], ov),
+    )
+    ledger.register(
+        "bench/grid", "dist", layout="sell",
+        nbytes=graph.n_pad * graph.n_pad * 4,
     )
 
     @partial(jax.jit, static_argnames=("reps",))
@@ -303,6 +376,11 @@ def bench_grid() -> dict:
         cpu_rate = len(sample) / (time.time() - t0)
         baseline = "python-oracle"
 
+    mem = _mem_columns(
+        "sell", graph.n, ("sell", "dist"),
+        n_sources=graph.n_pad, graph=graph,
+    )
+    ledger.release_area("bench/grid")
     return {
         "metric": "spf_recomputes_per_sec",
         "value": round(tpu_rate, 1),
@@ -312,6 +390,7 @@ def bench_grid() -> dict:
         "phases": _spf_phase_split(
             solve, sources, nbrs, wg_stacks[0], ov
         ),
+        **mem,
     }
 
 
@@ -487,6 +566,15 @@ def _bench_te() -> dict:
         f"{report['initial_max_util']:.2f} -> "
         f"{report['optimized_max_util']:.2f}"
     )
+    # TE registers its [B, n, n] scenario batch on the ledger for each
+    # run's duration (te/service.py seam); the structure peak is what one
+    # optimization actually pinned
+    from openr_tpu.ops.graph import compile_graph
+
+    mem = _mem_columns(
+        "te", report["nodes"], ("te",),
+        n_sources=report["scenarios"], graph=compile_graph(ls),
+    )
     return {
         "metric": "te_optimize_ms",
         "value": round(best, 2),
@@ -500,6 +588,7 @@ def _bench_te() -> dict:
         "initial_max_util": report["initial_max_util"],
         "optimized_max_util": report["optimized_max_util"],
         "improved": report["improved"],
+        **mem,
     }
 
 
@@ -564,6 +653,22 @@ def _bench_scale() -> dict:
     )
     key = tiling.shape_key() + (graph.n_pad,)
     solve = _tile_solver(key, mesh)
+    # the resident tile working set on the ledger (mem columns below):
+    # edge tiles + halo frontier + the tiled D (logical global bytes)
+    from openr_tpu.monitor.memledger import get_ledger
+
+    ledger = get_ledger()
+    ledger.register(
+        "bench/scale", "tile", layout="tile2d",
+        arrays=(args[1], args[2], args[3], args[5]),
+    )
+    ledger.register(
+        "bench/scale", "halo", layout="tile2d", arrays=(args[4],)
+    )
+    ledger.register(
+        "bench/scale", "dist", layout="tile2d",
+        nbytes=s_pad * graph.n_pad * 4,
+    )
     d, rounds = solve(*args)  # compile + first run, excluded
     t0 = time.time()
     d, rounds = solve(*args)
@@ -624,6 +729,12 @@ def _bench_scale() -> dict:
         f"per-device D tile {tile_bytes / 1e6:.1f}MB vs full replica "
         f"{replica_bytes / 1e6:.1f}MB ({replica_bytes / max(tile_bytes, 1):.0f}x)"
     )
+    mem = _mem_columns(
+        "tile2d", graph.n, ("tile", "halo", "dist"),
+        n_sources=s_pad, graph=graph, tiling=tiling,
+        mesh_shape=(b_ax, g_ax),
+    )
+    ledger.release_area("bench/scale")
     return {
         "metric": f"scale{graph.n}_tiled_cold_solve_ms",
         "value": round(cold_ms, 2),
@@ -638,6 +749,7 @@ def _bench_scale() -> dict:
         "replica_bytes_per_device": replica_bytes,
         "mesh": [mesh.shape["batch"], mesh.shape["graph"]],
         "phases": phases,
+        **mem,
     }
 
 
@@ -831,6 +943,11 @@ def _bench_apsp() -> dict:
         rounds = apsp.reclose_rounds_last or 0
     warm_ms = min(warm_times)
 
+    # mem columns measured while ONLY the main state's FW triple is
+    # resident (the sweep below stacks smaller states; ApspState
+    # registers its matrices with the ledger itself)
+    mem = _mem_columns("apsp", graph.n, ("apsp",), graph=graph)
+
     crossover = []
     handoff = None
     for nodes in sweep:
@@ -855,6 +972,7 @@ def _bench_apsp() -> dict:
         )
         if handoff is None and fw_ms < dj_ms:
             handoff = nodes
+        sub.close()  # return the sweep state's ledger bytes
     # parity spot-check: the bench must not report a number for a wrong
     # matrix (cheap at the smallest sweep size)
     g_chk = compile_edges(wan_edges(sweep[0], degree=4, seed=7))
@@ -862,6 +980,8 @@ def _bench_apsp() -> dict:
     chk.ensure(g_chk)
     ref = np_floyd_warshall(build_weight_matrix(g_chk), g_chk.overloaded)
     assert np.array_equal(chk.d, ref), "APSP bench parity check failed"
+    chk.close()
+    apsp.close()
 
     _note(
         f"apsp: {n}-node WAN blocked-FW close {cold_ms:.1f}ms cold / "
@@ -885,6 +1005,7 @@ def _bench_apsp() -> dict:
         "reclose_rounds": rounds,
         "crossover": crossover,
         "crossover_nodes": handoff,
+        **mem,
     }
 
 

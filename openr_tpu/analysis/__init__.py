@@ -1,6 +1,6 @@
 """Project-specific static analysis suite (docs/Analysis.md).
 
-Eleven rule families encode this repo's invariants, sharing two pieces of
+Twelve rule families encode this repo's invariants, sharing two pieces of
 interprocedural infrastructure (v2.0 — "DeepFlow"): a whole-package call
 graph (analysis/callgraph.py) and a light intraprocedural alias/escape
 dataflow engine (analysis/dataflow.py) — plus, since v3.0, the ShapeFlow
@@ -34,6 +34,10 @@ set, seeded from @shape_contract annotations (utils/shape_contract.py).
                      kernel hazard class
   - dtype-promotion: silent int->float promotion, bool masks in
                      arithmetic, int true division, float64 in traced code
+  - resident-accounting: device-tagged self.* stores in the solver/apsp/
+                     te packages must meet a device-memory ledger seam
+                     in the same body — residency the observatory can't
+                     see is invisible to watermarks and admission
   - collective-conformance: lax.ppermute/psum axis names checked against
                      the mesh axis vocabulary; ppermute perms must be
                      well-formed permutations
@@ -66,6 +70,7 @@ from openr_tpu.analysis import (  # noqa: F401  (registration side effect)
     device_transfer,
     recompile_risk,
     registry_drift,
+    resident_accounting,
     shard_spec,
     shapeflow,
     thread_ownership,

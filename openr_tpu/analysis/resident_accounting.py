@@ -1,0 +1,162 @@
+"""resident-accounting: device-resident state must be ledger-visible.
+
+The device-memory observatory (monitor/memledger.py) only works if every
+structure that stays resident on device registers with the ledger —
+`predict_fit` calibrates against the solvers' real footprints, the fleet
+`device_memory` rule attributes leaks by structure, and `getDeviceMemory`
+forensics claim to be the whole picture. A `self._x_dev = <device
+value>` store that never meets a ledger seam is residency the
+observatory cannot see: invisible to watermarks, unattributable in a
+leak, uncounted by admission.
+
+Mechanics: reuses device-transfer's per-class producer fixpoint
+(`_class_device_env` — module jit bindings, solver factories, device
+attributes, device-returning methods) to find methods that STORE a
+device-tagged value on `self`. In the resident-state packages
+(openr_tpu/solver, openr_tpu/apsp, openr_tpu/te), such a store is
+sanctioned only when the enclosing function touches a ledger seam in the
+same body: any name or attribute mentioning `ledger` (`self._ledger.
+register`, `get_ledger()`) or starting with `_mem` (`self._mem_register`,
+`self._mem_area` bookkeeping). Stores of non-device values (None resets,
+host mirrors produced by accounted fetches) are not residency and never
+trigger.
+
+Advisory: the device tag is the same heuristic classification
+device-transfer builds on, and a store can be legitimately covered by a
+register a call away (e.g. a helper invoked right after). `--strict`
+promotes it; the tier-1 self-run keeps the tree clean at strict level,
+so new unledgered residency shows up in review either way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set, Tuple
+
+from openr_tpu.analysis.callgraph import build_callgraph
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+    walk_nodes,
+)
+from openr_tpu.analysis.dataflow import AliasTracker
+from openr_tpu.analysis.device_transfer import (
+    _attr_classifier,
+    _class_device_env,
+    _with_class_env,
+)
+from openr_tpu.analysis.trace_safety import (
+    _numpy_aliases,
+    traced_function_infos,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# the packages that own device-resident state; everything else (tests,
+# benches, ops-level scratch) holds arrays transiently per call
+_RESIDENT_PACKAGES = (
+    "openr_tpu/solver/",
+    "openr_tpu/apsp/",
+    "openr_tpu/te/",
+)
+
+
+def _touches_ledger(fn) -> bool:
+    """True when the function body meets a ledger seam: any attribute or
+    name mentioning `ledger`, or an attribute starting with `_mem` (the
+    solver seam vocabulary — `_mem_register`, `_mem_release`,
+    `_mem_register_resident`, `_mem_area`)."""
+    for node in walk_nodes(fn):
+        if isinstance(node, ast.Attribute):
+            attr = node.attr.lower()
+            if "ledger" in attr or attr.startswith("_mem"):
+                return True
+        elif isinstance(node, ast.Name) and "ledger" in node.id.lower():
+            return True
+    return False
+
+
+@register
+class ResidentAccountingRule(Rule):
+    name = "resident-accounting"
+    severity = "advisory"
+    description = (
+        "device-resident attribute stores in the solver/apsp/te packages "
+        "must happen in functions that touch a device-memory ledger seam "
+        "(a `ledger`/`_mem*` reference in the same body) so the "
+        "observatory's accounting stays the whole picture"
+    )
+
+    def run(self, ctx: AnalysisContext):
+        cg = build_callgraph(ctx)
+        traced, _ = traced_function_infos(ctx)
+        traced_nodes = {id(fi.node) for fi in traced}
+        for mod in cg.modules.values():
+            path = str(mod.sf.path).replace("\\", "/")
+            if not any(pkg in path for pkg in _RESIDENT_PACKAGES):
+                continue
+            np_aliases = _numpy_aliases(mod.sf.tree)
+
+            def classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+                func = call.func
+                if isinstance(func, ast.Name):
+                    kind = cg.resolve_producer(mod, func.id)
+                    if kind in ("jit", "device"):
+                        return ("device", f"{func.id}(...)")
+                    if kind == "factory":
+                        return ("jit", func.id)
+                elif isinstance(func, ast.Attribute):
+                    chain = dotted_name(func)
+                    if chain and not chain.startswith("self."):
+                        kind = cg.resolve_producer_chain(mod, chain)
+                        if kind in ("jit", "device"):
+                            return ("device", f"{chain}(...)")
+                        if kind == "factory":
+                            return ("jit", chain)
+                elif isinstance(func, ast.Call):
+                    inner = call_name(func)
+                    if (
+                        inner
+                        and cg.resolve_producer(mod, inner) == "factory"
+                    ):
+                        return ("device", f"{inner}(...)(...)")
+                return None
+
+            for cls in walk_nodes(mod.sf.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                env = _class_device_env(cls, classify, np_aliases)
+                for fn in cls.body:
+                    if not isinstance(fn, _FuncDef):
+                        continue
+                    if id(fn) in traced_nodes:
+                        continue  # trace-safety's jurisdiction
+                    if _touches_ledger(fn):
+                        continue  # sanctioned: the seam is in the body
+                    tracker = AliasTracker(
+                        fn,
+                        classify_call=_with_class_env(classify, env),
+                        np_aliases=np_aliases,
+                        classify_attr=_attr_classifier(env),
+                    ).run()
+                    seen: Set[str] = set()
+                    for line, attr, tags in tracker.attr_stores:
+                        if attr in seen:
+                            continue
+                        if not any(t.tag[0] == "device" for t in tags):
+                            continue
+                        seen.add(attr)
+                        yield self.finding(
+                            "unledgered-store",
+                            mod.sf,
+                            line,
+                            f"'{cls.name}.{fn.name}' stores a device-"
+                            f"tagged value on self.{attr} without "
+                            f"touching a ledger seam — register the "
+                            f"residency (self._mem_register / "
+                            f"ledger.register) in the same function, "
+                            f"or waive with a comment",
+                        )

@@ -71,8 +71,22 @@ class ApspState:
         dispatch: Optional[Callable] = None,
         audit_interval: int = 0,
         warm: bool = True,
+        area: str = "",
+        on_refusal: Optional[Callable] = None,
     ) -> None:
         self.max_nodes = max_nodes
+        # device-memory observatory (monitor/memledger.py): the resident
+        # FW triple registers under this area tag; residency admission is
+        # headroom-gated through the ledger's capacity model with
+        # max_nodes as the fallback when no capacity source exists
+        from openr_tpu.monitor.memledger import get_ledger
+
+        self._ledger = get_ledger()
+        self._mem_area = area or "apsp"
+        self._mem_handle: Optional[int] = None
+        self._on_refusal = on_refusal
+        self.last_refusal: Optional[Dict] = None
+        self._refused_version: Optional[int] = None
         # dispatch(op, primary_fn, fallback_fn) -> (result, degraded):
         # the SolverSupervisor.supervised_call signature; None = bare
         # try/except with the numpy fallback
@@ -116,10 +130,33 @@ class ApspState:
     # ------------------------------------------------------------------
 
     def enabled_for(self, graph: CompiledGraph) -> bool:
-        """Dense FW serves small/medium areas: the solver picks the
-        batched-Dijkstra column solves beyond the node cap
-        (docs/Apsp.md crossover)."""
-        return 0 < graph.n <= self.max_nodes
+        """Dense FW residency admission. The PRIMARY gate is the memory
+        ledger's predictive capacity model: the [n_pad, n_pad] triple is
+        admitted only when `predict_fit` says it fits current headroom —
+        a measured verdict from the same padding arithmetic the closer
+        uses. The static `solver_apsp_max_nodes` cap is the FALLBACK,
+        used only when no capacity source exists (the CPU backend exposes
+        no memory stats). A definite no-fit is a refusal: counted,
+        remembered for getSolverHealth, and surfaced through the owning
+        solver as a SOLVER_CAPACITY_REFUSED sample instead of silent
+        non-residency (docs/Apsp.md crossover)."""
+        if graph.n <= 0:
+            return False
+        verdict = self._ledger.predict_fit(graph.n, "apsp", graph=graph)
+        if verdict["fits"] is None:
+            # no capacity source: the static node cap is the gate
+            return graph.n <= self.max_nodes
+        if verdict["fits"]:
+            return True
+        if self._refused_version != graph.version:
+            # one refusal per graph snapshot: every consumer probe after
+            # the first rides the remembered verdict
+            self._refused_version = graph.version
+            self._ledger.record_refusal(verdict)
+            self.last_refusal = dict(verdict)
+            if self._on_refusal is not None:
+                self._on_refusal(verdict)
+        return False
 
     def resident(self) -> bool:
         return self._d_dev is not None or self._d_host is not None
@@ -144,6 +181,27 @@ class ApspState:
         self._src_ref = None
         self._version = -2
         self.stale_reason = reason
+        self._mem_register_resident()
+
+    def _mem_register_resident(self) -> None:
+        """Ledger seam: re-register the resident FW triple (d + w +
+        allow) after a close, or release it when the matrix dropped
+        (invalidation, numpy fallback, teardown) — staleness
+        invalidation must return the ledger to its pre-close baseline."""
+        self._ledger.release(self._mem_handle)
+        self._mem_handle = None
+        if self._d_dev is not None:
+            self._mem_handle = self._ledger.register(
+                self._mem_area,
+                "apsp",
+                layout="apsp",
+                arrays=(self._d_dev, self._w_dev, self._allow_dev),
+            )
+
+    def close(self) -> None:
+        """Teardown: release the ledger entry (owning solve dropped)."""
+        self._ledger.release(self._mem_handle)
+        self._mem_handle = None
 
     # ------------------------------------------------------------------
 
@@ -259,6 +317,7 @@ class ApspState:
             self._w_dev = w_dev
             self._allow_dev = allow_dev
             self.backend = "device"
+        self._mem_register_resident()
         self._snapshot(graph)
         self.closes += 1
         self.cold_closes += 1
@@ -338,6 +397,7 @@ class ApspState:
             self.backend = "device"
             self.warm_closes += 1
             self.reclose_rounds_last = rounds
+        self._mem_register_resident()
         self._snapshot(graph)
         self.closes += 1
         self.close_ms_last = (time.perf_counter() - t0) * 1e3

@@ -8,6 +8,8 @@ same command vocabulary:
 
   breeze kvstore keys|keyvals|peers|peer-health|areas|history KEY [--area A]
   breeze decision adj|prefixes|routes|rib-policy|solver-health|
+                  memory [--area A] [--json]
+                  (device-memory observatory ledger, docs/Monitoring.md)|
                   solve-traces [--json]|profile [--seconds N] [--out DIR]|
                   profile-status|
                   te-optimize [--demands file.json] [--steps N] [--json]|
@@ -269,6 +271,62 @@ def cmd_decision(client: BlockingCtrlClient, args) -> None:
         state = "DEGRADED" if health.get("degraded") else "HEALTHY"
         print(f"solver: {state} (breaker: {health.get('breaker_state')})")
         _print_json(health)
+    elif args.cmd == "memory":
+        snap = client.call("getDeviceMemory", area=args.area)
+        if args.json:
+            _print_json(snap)
+            return
+        totals = snap.get("totals", {})
+        print(
+            f"device memory: {totals.get('live_bytes', 0)} live / "
+            f"{totals.get('peak_bytes', 0)} peak bytes, "
+            f"accounting {'EXACT' if snap.get('exact') else 'VIOLATED'} "
+            f"({totals.get('registers', 0)} registers, "
+            f"{totals.get('releases', 0)} releases, "
+            f"{totals.get('retained', 0)} retained)"
+        )
+        cap = snap.get("capacity", {})
+        rec = snap.get("reconcile", {})
+        print(
+            f"capacity: {cap.get('capacity_bytes') or '-'} bytes "
+            f"(source: {cap.get('source')}); reconcile via "
+            f"{rec.get('source')}: backend={rec.get('backend_bytes')} "
+            f"drift={rec.get('drift_bytes')}"
+        )
+        refusal = snap.get("last_refusal")
+        if totals.get("capacity_refusals"):
+            print(
+                f"capacity refusals: {totals['capacity_refusals']} "
+                f"(last: {refusal})"
+            )
+        _print_table(
+            ["Structure", "LiveBytes"],
+            [
+                [name, nbytes]
+                for name, nbytes in sorted(
+                    snap.get("structures", {}).items()
+                )
+                if nbytes
+            ],
+        )
+        rows = [
+            [
+                e["area"],
+                e["structure"],
+                e["layout"],
+                e["dtype"],
+                "x".join(str(s) for s in e["shape"]) or "-",
+                e["nbytes"],
+                "retained" if e["retained"] else "",
+            ]
+            for e in snap.get("entries", [])
+        ]
+        if rows:
+            _print_table(
+                ["Area", "Structure", "Layout", "Dtype", "Shape",
+                 "Bytes", "Flags"],
+                rows,
+            )
     elif args.cmd == "solve-traces":
         report = client.call(
             "getSolveTraces", area=args.area, last_n=args.last
@@ -1229,6 +1287,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default=None)
     dec.add_parser("rib-policy")
     dec.add_parser("solver-health")
+    p = dec.add_parser("memory")
+    p.add_argument("--area", default=None)
+    p.add_argument(
+        "--json", action="store_true", help="dump the raw ledger snapshot"
+    )
     p = dec.add_parser("solve-traces")
     p.add_argument("--area", default=None)
     p.add_argument(

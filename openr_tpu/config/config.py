@@ -200,6 +200,13 @@ class DecisionConfigSection:
     solver_trace_ring: int = 64
     solver_trace_sample_every: int = 16
     solver_forensics_dir: Optional[str] = None
+    # device-memory observatory (docs/Monitoring.md "Device-memory
+    # observatory"): capacity admission keeps this fraction of device
+    # capacity free when predict_fit gates a layout, and an explicit
+    # capacity override in bytes stands in when the backend exposes no
+    # memory_stats (0 = auto-detect)
+    solver_mem_headroom_frac: float = 0.10
+    solver_mem_capacity_bytes: int = 0
 
 
 @dataclass

@@ -389,6 +389,17 @@ class CtrlServer:
         assert self.decision is not None, "decision module not attached"
         return self.decision.get_solver_health()
 
+    def m_getDeviceMemory(self, params) -> Dict[str, Any]:
+        """Device-memory observatory read surface (docs/Monitoring.md
+        "Device-memory observatory"): the resident-state ledger snapshot
+        — per-structure live bytes, exact-accounting totals, watermark
+        reconciliation, capacity verdict and last admission refusal.
+        params: area (narrows the entry listing)."""
+        assert self.decision is not None, "decision module not attached"
+        return self.decision.get_device_memory(
+            area=params.get("area") or None
+        )
+
     def m_getSolveTraces(self, params) -> Dict[str, Any]:
         """Flight-recorder read surface (docs/Monitoring.md "Flight
         recorder & profiling"): per-area SolveTrace rings (event class,

@@ -160,6 +160,15 @@ class DecisionConfig:
     solver_trace_ring: int = 64
     solver_trace_sample_every: int = 16
     solver_forensics_dir: Optional[str] = None
+    # device-memory observatory (monitor/memledger.py,
+    # docs/Monitoring.md "Device-memory observatory"): capacity admission
+    # keeps this fraction of device capacity free when gating layouts
+    # (predict_fit headroom), and an explicit capacity override in bytes
+    # stands in for backends that expose no memory_stats (0 = auto-detect;
+    # without stats the static caps like solver_apsp_max_nodes remain the
+    # only gate)
+    solver_mem_headroom_frac: float = 0.10
+    solver_mem_capacity_bytes: int = 0
 
 
 # wall-clock PerfEvent descriptors mapped onto convergence-span stages:
@@ -311,6 +320,17 @@ class Decision(CountersMixin, HistogramsMixin):
             bgp_dry_run=config.bgp_dry_run,
             bgp_use_igp_metric=config.bgp_use_igp_metric,
         )
+        # device-memory observatory knobs apply to the process-wide ledger
+        # before any backend registers resident state
+        from openr_tpu.monitor.memledger import get_ledger
+
+        ledger = get_ledger()
+        ledger.set_headroom_frac(config.solver_mem_headroom_frac)
+        ledger.set_capacity_override(
+            config.solver_mem_capacity_bytes
+            if config.solver_mem_capacity_bytes > 0
+            else None
+        )
         if config.solver_backend == "tpu":
             primary = TpuSpfSolver(
                 config.my_node_name,
@@ -421,6 +441,11 @@ class Decision(CountersMixin, HistogramsMixin):
     def stop(self) -> None:
         if isinstance(self.solver, SolverSupervisor):
             self.solver.stop()
+        # device-memory observatory: daemon stop releases every ledger-
+        # registered structure (teardown returns the ledger to baseline)
+        solver_close = getattr(self.solver, "close", None)
+        if solver_close is not None:
+            solver_close()
         if self._task is not None:
             self._task.cancel()
             self._task = None
@@ -792,7 +817,7 @@ class Decision(CountersMixin, HistogramsMixin):
         # histogram objects are shared by reference — the solver keeps
         # recording into them, the monitor merges copies on export
         for key, value in self.solver.counters.items():
-            if key.startswith("decision.spf."):
+            if key.startswith(("decision.spf.", "decision.mem.")):
                 self.counters[key] = value
         for key, hist in self.solver._ensure_histograms().items():
             if key.startswith("decision.spf."):
@@ -935,6 +960,20 @@ class Decision(CountersMixin, HistogramsMixin):
                 self.solver, "apsp_close_ms_last", None
             ),
         }
+
+    def get_device_memory(self, area: Optional[str] = None) -> Dict:
+        """Device-memory observatory surface (ctrl `getDeviceMemory` /
+        `breeze decision memory`): the resident-state ledger snapshot —
+        per-structure live bytes, exact-accounting totals, watermark
+        reconciliation, the capacity verdict and the last admission
+        refusal (docs/Monitoring.md "Device-memory observatory"). The
+        ledger is process-global, so this answers even when the backend
+        runs bare; `area` narrows the entry listing only."""
+        from openr_tpu.monitor.memledger import get_ledger
+
+        snap = get_ledger().snapshot(area=area)
+        snap["supervised"] = isinstance(self.solver, SolverSupervisor)
+        return snap
 
     def get_solve_traces(
         self, area: Optional[str] = None, last_n: Optional[int] = None

@@ -44,6 +44,7 @@ from openr_tpu.fleet.rules import (
     E2E_P95,
     GAUGE_COUNTERS,
     GAUGE_PREFIX,
+    POOL_WIDE_RULES,
     RATE_COUNTERS,
     RATE_PREFIX,
     STAGE_AVG_PREFIX,
@@ -426,7 +427,16 @@ class FleetObserver(CountersMixin, HistogramsMixin):
         keys = set()
         new: List[Finding] = []
         for finding in found:
-            key = (finding.kind, finding.node)
+            # pool-wide rules (device_memory: one shared device pool, the
+            # rule already elects a single worst offender per tick) hold
+            # ONE episode per kind — per-node scrape windows pick up the
+            # same global signal at different ticks, and a per-node key
+            # would re-open the same exhaustion under each node's name
+            key = (
+                (finding.kind, "*")
+                if finding.kind in POOL_WIDE_RULES
+                else (finding.kind, finding.node)
+            )
             keys.add(key)
             if key in self._active:
                 continue  # still breaching: one sample per episode
@@ -457,8 +467,14 @@ class FleetObserver(CountersMixin, HistogramsMixin):
         sample.add_string("detail", finding.detail)
         sample.add_double("value", finding.value)
         sample.add_double("budget", finding.budget)
+        # convergence rules attribute stages; device_memory attributes
+        # ledger structures — the sample carries whichever was named
         sample.add_string_vector(
-            "stages", [s["stage"] for s in finding.attribution]
+            "stages",
+            [
+                s.get("stage", s.get("structure", ""))
+                for s in finding.attribution
+            ],
         )
         if finding.forensics_id:
             sample.add_string("forensics_id", finding.forensics_id)
@@ -487,6 +503,7 @@ class FleetObserver(CountersMixin, HistogramsMixin):
             "stream_stats": None,
             "journal_tail": None,
             "rib_diff": None,
+            "device_memory": None,
         }
         self.forensics.append(dump)
         del self.forensics[: -self.config.forensics_max]
@@ -527,6 +544,12 @@ class FleetObserver(CountersMixin, HistogramsMixin):
                 from_ts=finding.ts - self.config.forensics_rib_window_s,
                 to_ts=finding.ts,
             )
+            if finding.kind == "device_memory":
+                # the ledger snapshot names the leaking structure with
+                # exact per-entry evidence — the dump is self-contained
+                dump["device_memory"] = await self._call_quiet(
+                    client, "getDeviceMemory"
+                )
         finally:
             self._drop_client(client)
 

@@ -26,6 +26,10 @@ breach time. Each rule here evaluates one standing check against the
   |                   | flushes, stuck stale routes, GR hold expiries
   | flood_health      | dissemination plane: quarantine trips, typed
   |                   | wire rejects, flood duplicate ratio
+  | device_memory     | device-memory observatory (monitor/memledger.py):
+  |                   | headroom budget vs the capacity verdict gauge,
+  |                   | leak trend (series_slope over live-bytes) with
+  |                   | per-structure attribution, retained releases
 
 Interval values are computed by the collector (epoch-aware counter
 deltas + cumulative-histogram diffs, `monitor/exporter.py`
@@ -42,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional
 
 from openr_tpu.fleet.store import FleetStore
+from openr_tpu.monitor.memledger import STRUCT_GAUGES
 from openr_tpu.testing.soak import detect_step, series_slope
 
 # store series names the collector records (interval = between two
@@ -79,13 +84,21 @@ RATE_COUNTERS = (
     "kvstore.flood.duplicates",
     "kvstore.quarantine.trips",
     "kvstore.wire.rejected_total",
+    "decision.mem.retained",
+    "decision.mem.capacity_refusals",
+    "decision.mem.drift_events",
 )
 
-# gauges sampled verbatim
+# gauges sampled verbatim (the decision.mem.* per-structure gauges ride
+# along for leak attribution — the fixed memledger vocabulary)
 GAUGE_COUNTERS = (
     "decision.spf.fallback_active",
     "fib.num_stale_routes",
-)
+    "decision.mem.live_bytes_last",
+    "decision.mem.peak_bytes_last",
+    "decision.mem.headroom_bytes_last",
+    "decision.mem.structures_active",
+) + tuple(STRUCT_GAUGES.values())
 
 
 @dataclass
@@ -121,6 +134,17 @@ class SloConfig:
     # at least this multiple of the fleet-wide cumulative stage avg
     attribution_min_ratio: float = 2.0
     attribution_stages: int = 3
+    # device_memory: minimum headroom (bytes) the capacity verdict gauge
+    # may report before breaching; <0 disables (and nodes whose gauge is
+    # negative — no capacity source — are never judged against it)
+    mem_headroom_budget_bytes: float = -1.0
+    # device_memory leak trend: live-bytes slope budget (bytes/tick) over
+    # at least mem_leak_min_windows points; <0 disables, 0 arms with a
+    # zero budget (any sustained growth breaches). Retained releases
+    # (`solver.mem.retain` pins) always breach when the trend rule is
+    # armed — a pinned free IS the leak, no slope estimation needed
+    mem_leak_slope_budget: float = -1.0
+    mem_leak_min_windows: int = 4
 
 
 @dataclass
@@ -457,6 +481,160 @@ def eval_flood_health(
         )
 
 
+def _attribute_structures(
+    store: FleetStore, node: str
+) -> List[Dict[str, Any]]:
+    """Leak attribution: the ledger structures whose per-structure gauge
+    series GREW over the observation window — a leak pins one structure's
+    bytes while the others keep returning to baseline, so the growing
+    series names the offender (the device-memory analogue of per-stage
+    convergence attribution). Growth is measured from the window's
+    trough, not its first sample: a pinned buffer raises the series'
+    floor, and the window may open mid-churn at a transient peak."""
+    scored: List[Dict[str, Any]] = []
+    for structure, gauge in STRUCT_GAUGES.items():
+        series = store.series(node, GAUGE_PREFIX + gauge)
+        if len(series) < 2:
+            continue
+        growth = series[-1] - min(series)
+        if growth <= 0:
+            continue
+        scored.append(
+            {
+                "structure": structure,
+                "growth_bytes": int(growth),
+                "live_bytes": int(series[-1]),
+                "slope": round(series_slope(series), 2),
+            }
+        )
+    scored.sort(key=lambda s: s["growth_bytes"], reverse=True)
+    return scored[:6]
+
+
+# retain-signal trailing window (in scrape sweeps): long enough to
+# bridge per-node scrape skew on the shared counter, short enough that
+# a single pin ages out and the episode clears
+_RETAIN_WINDOW = 8
+
+# rules whose signal is one shared device pool, not per-node state: the
+# observer holds one breach episode per kind (not per node) for these —
+# per-node scrape windows see the same global counters at different
+# ticks, and per-node episodes would re-report one exhaustion N times
+POOL_WIDE_RULES = frozenset({"device_memory"})
+
+
+def eval_device_memory(
+    store: FleetStore, cfg: SloConfig
+) -> Iterable[Finding]:
+    """Device-memory observatory rule (docs/Monitoring.md "Device-memory
+    observatory"): a node breaches when its capacity headroom falls under
+    the budget, or when the leak-trend check is armed and either a
+    release was pinned live (`solver.mem.retain` — the injected-leak
+    signature) or the live-bytes series shows sustained growth.
+
+    Like `eval_convergence_p95`, at most ONE finding per tick — the worst
+    offender, the rest listed in evidence. Nodes sharing a device pool
+    (and, in the emulator, the process-global ledger) report the same
+    exhaustion at once; one episode per incident keeps MEM_SMOKE's
+    "exactly one breach" assertion — and a paging policy — meaningful."""
+    headroom_armed = cfg.mem_headroom_budget_bytes >= 0
+    trend_armed = cfg.mem_leak_slope_budget >= 0
+    if not headroom_armed and not trend_armed:
+        return
+    worst: Optional[Finding] = None
+    offenders: List[str] = []
+    for node in sorted(store.nodes()):
+        reasons: List[str] = []
+        value = 0.0
+        budget = 0.0
+        headroom = store.last(
+            node, GAUGE_PREFIX + "decision.mem.headroom_bytes_last"
+        )
+        # a negative headroom gauge means no capacity source exists on
+        # that node (the ledger folds -1) — not judgeable
+        if (
+            headroom_armed
+            and headroom is not None
+            and headroom >= 0
+            and headroom < cfg.mem_headroom_budget_bytes
+        ):
+            reasons.append(
+                f"headroom {int(headroom)}B under budget "
+                f"{int(cfg.mem_headroom_budget_bytes)}B"
+            )
+            value = float(headroom)
+            budget = cfg.mem_headroom_budget_bytes
+        # judged over a trailing window, not just the last interval: in
+        # a shared-pool deployment (the emulator's process-global ledger
+        # especially) each node's scrape picks the same global counter
+        # delta up in a different sweep, and a last-interval read would
+        # surface one incident to different ticks on different nodes
+        retained_series = store.series(
+            node, RATE_PREFIX + "decision.mem.retained"
+        )
+        retained = sum(
+            s for s in retained_series[-_RETAIN_WINDOW:] if s > 0
+        )
+        live_series = store.series(
+            node, GAUGE_PREFIX + "decision.mem.live_bytes_last"
+        )
+        slope = (
+            series_slope(live_series)
+            if len(live_series) >= cfg.mem_leak_min_windows
+            else 0.0
+        )
+        if trend_armed and retained > 0:
+            reasons.append(
+                f"{int(retained)} release(s) pinned live in the "
+                f"trailing window"
+            )
+            value = value or float(retained)
+        elif trend_armed and slope > cfg.mem_leak_slope_budget:
+            reasons.append(
+                f"live bytes trending +{slope:.0f}B/tick over "
+                f"{len(live_series)} points (budget "
+                f"{cfg.mem_leak_slope_budget:g})"
+            )
+            value = value or slope
+            budget = budget or cfg.mem_leak_slope_budget
+        if not reasons:
+            continue
+        offenders.append(node)
+        if worst is not None and value <= worst.value:
+            continue
+        worst = Finding(
+            kind="device_memory",
+            node=node,
+            detail=", ".join(reasons),
+            value=value,
+            budget=budget,
+            evidence={
+                "headroom_bytes": headroom,
+                "retained": retained,
+                "live_slope": round(slope, 2),
+                "live_series": live_series[-16:],
+                "capacity_refusals": store.last(
+                    node, RATE_PREFIX + "decision.mem.capacity_refusals"
+                ),
+                "drift_events": store.last(
+                    node, RATE_PREFIX + "decision.mem.drift_events"
+                ),
+            },
+        )
+    if worst is None:
+        return
+    worst.attribution = _attribute_structures(store, worst.node)
+    names = ",".join(
+        s["structure"] for s in worst.attribution
+    ) or "unattributed"
+    worst.detail = (
+        f"device memory unhealthy on {worst.node}: {worst.detail}"
+        f" ({len(offenders)} node(s) affected; structures: {names})"
+    )
+    worst.evidence["offenders"] = offenders
+    yield worst
+
+
 RULES = (
     ("convergence_p95", eval_convergence_p95),
     ("convergence_trend", eval_convergence_trend),
@@ -465,6 +643,7 @@ RULES = (
     ("admission_rejections", eval_admission_rejections),
     ("restart_health", eval_restart_health),
     ("flood_health", eval_flood_health),
+    ("device_memory", eval_device_memory),
 )
 
 

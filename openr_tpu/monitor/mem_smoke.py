@@ -1,0 +1,294 @@
+"""MEM_SMOKE tier-1 smoke (the device-memory sibling of FLEET_SMOKE):
+a small VirtualNetwork of TPU-backend nodes with the fleet observer
+attached, one injected ledger leak, and the observer must raise
+*exactly* one `device_memory` breach — correct rule, the leaking
+structure named in the attribution — with well-formed ledger forensics
+and a `breeze decision memory` round-trip.
+
+Sequence:
+
+  1. an N-node line (every node on the supervised TPU solver backend,
+     so real ledger registrations flow) converges; the observer scrapes
+     every node with the leak-trend rule ARMED at a zero budget; a
+     clean flap runs and NO rule may fire — solves register and release
+     device structures constantly, and an exact ledger shows none of
+     that churn as a leak (false-positive guard);
+  2. ONE fault is injected: `solver.mem.retain` (monitor/memledger.py)
+     pins the victim's next released buffer live — released by the
+     solver, never freed by the ledger: the canonical leak signature;
+  3. a second flap runs; the victim's solver rebuilds, the release is
+     pinned, `decision.mem.retained` ticks, and the observer's
+     `device_memory` rule must breach exactly once with the pinned
+     structure named in the attribution, a forensics dump embedding the
+     ledger snapshot (exact accounting, the retained entry visible in
+     the victim's area), and the breach LogSample carrying the dump id.
+
+The ledger is process-global (one device pool per process), so every
+node's `decision.mem.*` series show the incident — but each node's
+scrape picks the shared counters up in a different sweep, so WHICH node
+a tick elects as worst offender is scrape-timing dependent. Three
+mechanisms keep "exactly one breach" deterministic anyway: the rule
+yields one worst-offender finding per tick (`eval_device_memory`), the
+retain signal is judged over a trailing window (one pin stays visible
+to every node's evaluation, then ages out), and the observer holds ONE
+episode per pool-wide rule kind (`POOL_WIDE_RULES`) rather than per
+node. The elected node's identity is NOT asserted — only that the one
+finding names the leaked structure and carries well-formed forensics.
+
+Topology size scales via MEM_SMOKE_NODES; returns a summary dict.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import io
+import json
+import os
+from typing import Any, Dict, List
+
+from openr_tpu.fleet.observer import FleetConfig, FleetObserver
+from openr_tpu.fleet.rules import SloConfig
+from openr_tpu.monitor.memledger import MemLedger, get_ledger
+from openr_tpu.testing.faults import FaultInjector, injected
+
+
+def run_mem_smoke() -> Dict[str, Any]:
+    from openr_tpu.cli.breeze import main as breeze_main
+    from openr_tpu.testing.wrapper import VirtualNetwork, wait_until
+
+    n = max(3, int(os.environ.get("MEM_SMOKE_NODES", "3")))
+    mid = n // 2
+    # the leak is pinned to n0's area; the shared ledger means any node
+    # may be elected to carry the finding (module docstring)
+    victim = "n0"
+
+    async def body() -> Dict[str, Any]:
+        # the ledger is process-global and other tests may have left
+        # entries behind: judge only what THIS smoke registers
+        baseline_handles = {
+            e["handle"] for e in get_ledger().snapshot()["entries"]
+        }
+        net = VirtualNetwork()
+        for i in range(n):
+            net.add_node(
+                f"n{i}",
+                loopback_prefix=f"10.{i}.0.0/24",
+                # real ledger traffic needs the device solver path
+                config_overrides={
+                    "decision_config": {"solver_backend": "tpu"}
+                },
+            )
+        await net.start_all()
+        for i in range(n - 1):
+            net.connect(f"n{i}", f"if{i}r", f"n{i + 1}", f"if{i + 1}l")
+
+        def converged() -> bool:
+            for i in range(n):
+                got = set(net.wrappers[f"n{i}"].programmed_prefixes())
+                want = {f"10.{j}.0.0/24" for j in range(n) if j != i}
+                if not want.issubset(got):
+                    return False
+            return True
+
+        def partitioned() -> bool:
+            left = net.wrappers["n0"].programmed_prefixes()
+            return f"10.{n - 1}.0.0/24" not in left
+
+        observer = FleetObserver.for_network(
+            net,
+            config=FleetConfig(
+                scrape_interval_s=0.15,
+                eval_every=1,
+                slo=SloConfig(
+                    # the mem rule is under test; keep the latency rules
+                    # from competing for the "exactly one" assertion
+                    convergence_p95_budget_ms=60_000.0,
+                    trend_min_windows=0,
+                    # ARMED at zero budget: any pinned release breaches
+                    mem_leak_slope_budget=0.0,
+                    # live-bytes slope is legitimately noisy across a
+                    # flap (buffers are released + re-registered); the
+                    # deterministic leak signal is the retained counter,
+                    # so leave the slope estimator unjudged
+                    mem_leak_min_windows=10**6,
+                ),
+            ),
+        )
+
+        def flap():
+            net.fail_link(
+                f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+            )
+
+        def heal():
+            net.restore_link(
+                f"n{mid}", f"if{mid}r", f"n{mid + 1}", f"if{mid + 1}l"
+            )
+
+        def _breeze_memory(port: int):
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = breeze_main(
+                    ["--host", "127.0.0.1", "--port", str(port),
+                     "decision", "memory", "--json"]
+                )
+            return rc, json.loads(buf.getvalue())
+
+        leaked: List[Any] = []
+
+        def _pin(ctx) -> None:
+            ctx.retain = True
+            leaked.append(ctx.entry)
+
+        with injected(FaultInjector(seed=7)) as inj:
+            try:
+                await wait_until(converged, timeout=60.0)
+                await observer.start()
+                await wait_until(
+                    lambda: observer.counters.get("fleet.stream_frames", 0)
+                    >= n,
+                    timeout=30.0,
+                )
+                # the solvers actually registered device structures
+                await wait_until(
+                    lambda: observer.store.series(
+                        victim, "gauge.decision.mem.live_bytes_last"
+                    )
+                    != [],
+                    timeout=30.0,
+                )
+                # phase 1: a clean flap — releases + re-registers churn
+                # the ledger, and no rule may fire
+                flap()
+                await wait_until(partitioned, timeout=60.0)
+                heal()
+                await wait_until(converged, timeout=60.0)
+                await asyncio.sleep(0.5)  # a few clean evaluation ticks
+                clean_findings = len(observer.findings)
+
+                # phase 2: ONE injected leak — the victim's next release
+                # is pinned live by the ledger
+                inj.arm(
+                    "solver.mem.retain",
+                    times=1,
+                    when=lambda ctx: ctx.entry.area.endswith(
+                        "/" + victim
+                    ),
+                    action=_pin,
+                )
+                flap()
+                await wait_until(partitioned, timeout=60.0)
+                await wait_until(
+                    lambda: len(observer.findings) > clean_findings,
+                    timeout=60.0,
+                )
+                heal()
+                await wait_until(converged, timeout=60.0)
+                fired = inj.fired("solver.mem.retain")
+
+                # breeze round-trip against the victim's live ctrl port
+                rc, breeze_snap = await asyncio.get_event_loop(
+                ).run_in_executor(
+                    None,
+                    _breeze_memory,
+                    net.wrappers[victim].ctrl_port,
+                )
+            finally:
+                await observer.stop()
+                await net.stop_all()
+
+        report = observer.report()
+        ledger = get_ledger()
+        snap = ledger.snapshot()
+        summary = {
+            "nodes": n,
+            "victim": victim,
+            "clean_findings": clean_findings,
+            "faults_fired": fired,
+            "leaked_structure": leaked[0].structure if leaked else None,
+            "leaked_bytes": leaked[0].nbytes if leaked else 0,
+            "findings": [f.to_dict() for f in observer.findings],
+            "samples": [s.values() for s in observer.samples],
+            "forensics": observer.forensics,
+            "ledger": snap,
+            "breeze": breeze_snap,
+            "report": report,
+        }
+        # -- the smoke's contract ----------------------------------------
+        assert fired == 1, summary["faults_fired"]
+        assert len(leaked) == 1, summary["leaked_structure"]
+        assert clean_findings == 0, summary["findings"]
+        assert len(observer.findings) == 1, summary["findings"]
+        finding = observer.findings[0]
+        assert finding.kind == "device_memory", finding.to_dict()
+        nodes = {f"n{i}" for i in range(n)}
+        assert finding.node in nodes, finding.to_dict()
+        assert finding.evidence.get("retained", 0) >= 1, finding.to_dict()
+        # the pinned structure is named in the attribution
+        folded = MemLedger._fold_structure(leaked[0].structure)
+        named = [s["structure"] for s in finding.attribution]
+        assert folded in named, (folded, finding.to_dict())
+        # the breach sample is typed and carries the forensics id
+        sample = observer.samples[-1].values()
+        assert sample["event"] == "FLEET_SLO_BREACH", sample
+        assert sample["rule"] == "device_memory", sample
+        assert sample["node"] == finding.node, sample
+        # well-formed forensics: id linkage + embedded ledger snapshot
+        assert len(observer.forensics) == 1, summary["forensics"]
+        dump = observer.forensics[0]
+        assert dump["id"] == finding.forensics_id, dump["id"]
+        assert dump["id"] == sample["forensics_id"], dump["id"]
+        assert dump["reason"] == "device_memory", dump
+        mem = dump["device_memory"]
+        assert mem is not None, dump
+        assert mem["exact"], mem["totals"]
+        totals = mem["totals"]
+        assert (
+            totals["registered_bytes"]
+            == totals["live_bytes"] + totals["freed_bytes"]
+        ), totals
+        pinned = [e for e in mem["entries"] if e["retained"]]
+        assert any(
+            e["area"].endswith("/" + victim)
+            and e["structure"] == leaked[0].structure
+            for e in pinned
+        ), pinned
+        # breeze decision memory --json round-trips the same snapshot
+        assert rc == 0, rc
+        assert breeze_snap["exact"], breeze_snap["totals"]
+        assert breeze_snap["totals"]["retained"] == totals["retained"], (
+            breeze_snap["totals"],
+            totals,
+        )
+        assert any(
+            e["retained"] and e["structure"] == leaked[0].structure
+            for e in breeze_snap["entries"]
+        ), breeze_snap["entries"]
+        # daemon teardown released everything the fleet registered
+        # except the pinned entry (decision.stop -> solver.close)
+        assert snap["exact"], snap["totals"]
+        live_fleet = [
+            e
+            for e in snap["entries"]
+            if e["handle"] not in baseline_handles
+        ]
+        assert all(e["retained"] for e in live_fleet), live_fleet
+        assert any(
+            e["structure"] == leaked[0].structure for e in live_fleet
+        ), live_fleet
+        # the observer actually scraped the whole fleet, cleanly
+        counters = report["counters"]
+        assert counters.get("fleet.scrapes", 0) >= 2 * n, counters
+        assert counters.get("fleet.scrape_errors", 0) == 0, counters
+        checks = report["verdict"]["checks"]
+        assert checks["store_accounting"]["ok"], checks
+        assert checks["scrape_health"]["ok"], checks
+        assert not checks["no_slo_breach"]["ok"], checks
+        return summary
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(body())
+    finally:
+        loop.close()

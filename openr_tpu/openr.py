@@ -331,6 +331,8 @@ class OpenrDaemon:
                 solver_trace_ring=dc.solver_trace_ring,
                 solver_trace_sample_every=dc.solver_trace_sample_every,
                 solver_forensics_dir=dc.solver_forensics_dir,
+                solver_mem_headroom_frac=dc.solver_mem_headroom_frac,
+                solver_mem_capacity_bytes=dc.solver_mem_capacity_bytes,
                 enable_v4=c.enable_v4,
                 compute_lfa_paths=dc.compute_lfa_paths,
                 enable_ordered_fib=c.enable_ordered_fib_programming,

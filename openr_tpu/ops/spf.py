@@ -1225,3 +1225,21 @@ def compile_cache_stats() -> dict:
     return {"hits": hits, "misses": misses, "entries": entries}
 
 
+def compile_cache_memory() -> dict:
+    """Device-memory ledger external source (monitor/memledger.py): the
+    compiled executables are device-resident state too, but they live
+    behind module-level lru_caches the ledger does not allocate — so they
+    ride snapshots as an informational row (entry counts per family +
+    the APSP closer's caches) outside the exact-accounting invariant."""
+    from openr_tpu.apsp import apsp_compile_cache_stats
+
+    stats = compile_cache_stats()
+    fw = apsp_compile_cache_stats()
+    return {
+        "structure": "compile_cache",
+        "spf_entries": stats["entries"],
+        "apsp_entries": fw["entries"],
+        "entries": stats["entries"] + fw["entries"],
+    }
+
+

@@ -160,6 +160,18 @@ class GraphTiling:
         tiled-solver executables (weight patches never change it)."""
         return (self.g, self.n_tile, self.e_tile, self.h)
 
+    def tile_bytes(self) -> int:
+        """Device-resident bytes of the tiled edge planes (src_l + hseg +
+        w), the unit the memory ledger registers as the `tile` structure
+        and `predict_fit` forecasts from the same shapes."""
+        return 3 * self.g * self.e_tile * 4  # three int32 [g, e_tile] planes
+
+    def halo_bytes(self) -> int:
+        """Device-resident bytes of the halo frontier map (hcols): the
+        [g, h] int32 slot->column table the cross-tile fold gathers
+        through — the ledger's `halo` structure."""
+        return self.g * self.h * 4
+
     @shape_contract("w_edges:[e_pad]:int32", returns="[g,e_tile]:int32:inf")
     def tile_weights(self, w_edges: np.ndarray) -> np.ndarray:
         """[e_pad] dst-sorted edge weights -> the [g, e_tile] tiled form

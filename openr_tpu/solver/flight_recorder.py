@@ -251,6 +251,7 @@ class FlightRecorder:
         counters: Optional[Dict[str, int]] = None,
         mesh_digest: Optional[Dict[str, Any]] = None,
         extra: Optional[Dict[str, Any]] = None,
+        device_memory: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Snapshot the rings + context into one JSON-serializable
         forensics artifact; kept in a bounded in-memory list and, when
@@ -281,6 +282,11 @@ class FlightRecorder:
         }
         if extra:
             dump["extra"] = extra
+        if device_memory is not None:
+            # memory-ledger snapshot (monitor/memledger.py): resident
+            # structures + capacity picture at dump time — the device_oom
+            # post-mortem's primary evidence
+            dump["device_memory"] = device_memory
         self.dumps.append(dump)
         while len(self.dumps) > self.max_dumps:
             self.dumps.pop(0)

@@ -63,6 +63,7 @@ FAULT_COMPILE = "compile"
 FAULT_RUNTIME = "runtime"
 FAULT_DEVICE_LOSS = "device_loss"
 FAULT_DEADLINE = "deadline"
+FAULT_DEVICE_OOM = "device_oom"
 
 
 class SolveDeadlineExceeded(RuntimeError):
@@ -83,6 +84,22 @@ def classify_solver_error(exc: BaseException) -> str:
     text = " ".join(
         f"{type(e).__name__}: {e}" for e in _exc_chain(exc)
     ).lower()
+    # allocator exhaustion FIRST: XLA's RESOURCE_EXHAUSTED wording and the
+    # capacity model's predicted refusal both land here — the forensics
+    # dump for this kind embeds the full memory-ledger snapshot so the
+    # post-mortem names the structure that ate the chip
+    if any(
+        hint in text
+        for hint in (
+            "resource_exhausted",
+            "resource exhausted",
+            "out of memory",
+            "out-of-memory",
+            "memory allocation failure",
+            "allocation failure",
+        )
+    ) or "DeviceCapacityError" in names:
+        return FAULT_DEVICE_OOM
     if any(
         hint in text
         for hint in (
@@ -248,6 +265,13 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         if self._probe_task is not None:
             self._probe_task.cancel()
             self._probe_task = None
+
+    def close(self) -> None:
+        """Teardown passthrough: release the primary backend's ledger-
+        registered device structures (the fallback oracle holds none)."""
+        close = getattr(self.primary, "close", None)
+        if close is not None:
+            close()
 
     async def _probe_loop(self) -> None:
         import asyncio
@@ -571,15 +595,21 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
 
         from openr_tpu.solver.flight_recorder import device_digest
 
+        from openr_tpu.monitor.memledger import get_ledger
+
         dump = self.recorder.dump(
             reason,
             solver_config=dataclasses.asdict(self.config),
             counters={
                 k: v
                 for k, v in self.counters.items()
-                if k.startswith("decision.spf.")
+                if k.startswith(("decision.spf.", "decision.mem."))
             },
             mesh_digest=device_digest(getattr(self.primary, "mesh", None)),
+            # the full memory-ledger snapshot rides EVERY forensics dump:
+            # an OOM post-mortem must name the structures that were
+            # resident when the fault domain transitioned
+            device_memory=get_ledger().snapshot(),
         )
         self._bump("decision.spf.forensics_dumps")
         self._emit_sample(
@@ -612,6 +642,11 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             # evidence worth keeping: snapshot the solve history now,
             # while the slow solve's trace is still in the ring
             self._forensics_dump("deadline")
+        if kind == FAULT_DEVICE_OOM:
+            # allocator exhaustion: dump IMMEDIATELY, while the ledger
+            # still shows the resident set that overflowed the chip —
+            # retries and degradations below will start releasing it
+            self._forensics_dump("device_oom")
         if elapsed_s is not None and self.watchdog is not None:
             note = getattr(self.watchdog, "note_slow", None)
             if note is not None:
@@ -671,7 +706,11 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         help)."""
         if not self.config.mesh_degrade:
             return False
-        if self.last_fault_kind != FAULT_DEVICE_LOSS:
+        if self.last_fault_kind not in (FAULT_DEVICE_LOSS, FAULT_DEVICE_OOM):
+            # a smaller mesh only helps faults that are about the devices
+            # themselves: lost chips, or allocator exhaustion (fewer chips
+            # = smaller replicated working set per remaining headroom —
+            # the replicated->tiled->CPU degrade ladder's middle rungs)
             return False
         degrade = getattr(self.primary, "degrade_mesh", None)
         if degrade is None or not degrade():
@@ -854,13 +893,41 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
         counters = getattr(backend, "counters", None)
         if isinstance(counters, dict):
             for key, value in counters.items():
-                if key.startswith("decision.spf."):
+                if key.startswith(("decision.spf.", "decision.mem.")):
                     self.counters[key] = value
         ensure = getattr(backend, "_ensure_histograms", None)
         if ensure is not None:
             for key, hist in ensure().items():
                 if key.startswith("decision.spf."):
                     self._ensure_histograms()[key] = hist
+        self._drain_capacity_refusals(backend)
+
+    def _drain_capacity_refusals(self, backend) -> None:
+        """Emit one SOLVER_CAPACITY_REFUSED LogSample per headroom-gated
+        admission refusal the backend queued since the last sync: the
+        capacity model said a layout would not fit and the solver refused
+        or degraded residency instead of letting the allocator raise —
+        an explicit, typed event instead of silent non-residency."""
+        take = getattr(backend, "take_capacity_refusals", None)
+        if take is None:
+            return
+        for refusal in take():
+            self._emit_sample(
+                "SOLVER_CAPACITY_REFUSED",
+                {
+                    "layout": str(refusal.get("layout", "")),
+                    "capacity_source": str(refusal.get("source", "")),
+                },
+                {
+                    "n_nodes": int(refusal.get("n_nodes") or 0),
+                    "predicted_bytes": int(
+                        refusal.get("predicted_bytes") or 0
+                    ),
+                    "headroom_bytes": int(
+                        refusal.get("headroom_bytes") or 0
+                    ),
+                },
+            )
 
     def _emit_sample(self, event: str, strings: Dict, ints: Dict) -> None:
         if self._log_sample_fn is None:
@@ -931,4 +998,24 @@ class SolverSupervisor(CountersMixin, HistogramsMixin):
             # flight-recorder ring + forensics state
             "traces": self.recorder.stats(),
             "forensics": self.recorder.forensics_stats(),
+            # device-memory observatory rows (monitor/memledger.py):
+            # resident totals, the exact-accounting verdict, and the last
+            # headroom-gated capacity refusal
+            "device_memory": self._device_memory_health(),
+        }
+
+    def _device_memory_health(self) -> Dict:
+        from openr_tpu.monitor.memledger import get_ledger
+
+        ledger = get_ledger()
+        return {
+            "live_bytes": ledger.live_bytes,
+            "peak_bytes": ledger.peak_bytes,
+            "registered_bytes": ledger.registered_bytes,
+            "freed_bytes": ledger.freed_bytes,
+            "exact": ledger.check(),
+            "structures": ledger.structure_bytes(),
+            "capacity": ledger.capacity(),
+            "capacity_refusals": ledger.capacity_refusals,
+            "last_refusal": ledger.last_refusal,
         }

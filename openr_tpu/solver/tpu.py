@@ -44,12 +44,22 @@ from openr_tpu.ops.graph import (
 from openr_tpu.ops.spf import (
     batched_spf,
     batched_spf_vw,
+    compile_cache_memory,
     compile_cache_stats,
     sell_fixpoint_masked,
 )
+from openr_tpu.monitor.memledger import get_ledger
 from openr_tpu.solver.cpu import Metric, SpfSolver
 from openr_tpu.solver.flight_recorder import NULL_CLOCK, SolveTrace
 from openr_tpu.testing.faults import fault_point
+
+
+class DeviceCapacityError(RuntimeError):
+    """Predicted RESOURCE_EXHAUSTED: the memory ledger's capacity model
+    says the chosen layout cannot fit current headroom. Raised BEFORE the
+    device dispatch so the supervisor classifies it as `device_oom` and
+    walks the degrade ladder (smaller mesh -> CPU oracle) instead of the
+    allocator dying mid-solve."""
 
 
 # fixed per-bucket patch width for the fused patch+solve executable; events
@@ -244,9 +254,17 @@ class _AreaSolve:
         apsp_audit_interval: int = 0,
         apsp_dispatch=None,
         recorder=None,
+        on_capacity_refusal=None,
     ) -> None:
         self.link_state = link_state
         self.me = me
+        # device-memory ledger (monitor/memledger.py): every persistent
+        # buffer this solve uploads registers under this area tag and is
+        # released by close() — the exact-accounting observatory surface
+        self._ledger = get_ledger()
+        self._mem_area = f"{link_state.area}/{me}"
+        self._mem: Dict[str, int] = {}
+        self._on_capacity_refusal = on_capacity_refusal
         # flight recorder (solver/flight_recorder.py): every solve emits a
         # SolveTrace into the bounded per-area ring; every Nth solve gets
         # a live PhaseClock whose seams barrier at phase boundaries. The
@@ -272,6 +290,8 @@ class _AreaSolve:
                 dispatch=apsp_dispatch,
                 audit_interval=apsp_audit_interval,
                 warm=warm_start,
+                area=self._mem_area,
+                on_refusal=self._note_capacity_refusal,
             )
         self.device_solves = 0
         self.ksp_device_batches = 0
@@ -354,7 +374,79 @@ class _AreaSolve:
                 trace.d2h_bytes += self._d_host.nbytes
                 if self._recorder is not None:
                     self._recorder.observe_phase("d2h", ms)
+            self._mem_register(
+                "mirror", "host", arrays=(self._d_host,)
+            )
         return self._d_host
+
+    # -- device-memory ledger seams (monitor/memledger.py) -------------
+
+    def _mem_register(
+        self, structure: str, layout: str, arrays=(), nbytes=None
+    ) -> None:
+        """Ledger register seam: (re-)register one named resident
+        structure under this area, releasing the previous generation
+        first — a structural rebuild frees the old buffers when the new
+        upload replaces them, so live_bytes tracks what is actually
+        reachable on device."""
+        self._ledger.release(self._mem.pop(structure, None))
+        self._mem[structure] = self._ledger.register(
+            self._mem_area,
+            structure,
+            layout=layout,
+            arrays=arrays,
+            nbytes=nbytes,
+        )
+
+    def _mem_release(self, structure: str) -> None:
+        """Ledger release seam for one named structure."""
+        self._ledger.release(self._mem.pop(structure, None))
+
+    def close(self) -> None:
+        """Area teardown: release every ledger-registered structure (the
+        resident distance matrix, layout buffers, patch slots, mirrors)
+        and the APSP state. Called when the owning TpuSpfSolver drops or
+        replaces this solve (invalidation, mesh degradation, LinkState
+        replacement)."""
+        if self.apsp is not None:
+            self.apsp.close()
+        for structure in list(self._mem):
+            self._mem_release(structure)
+
+    def _note_capacity_refusal(self, verdict: Dict) -> None:
+        """Record + propagate a headroom-gated admission refusal up to
+        the owning solver (surfaced as SOLVER_CAPACITY_REFUSED)."""
+        if self._on_capacity_refusal is not None:
+            self._on_capacity_refusal(verdict)
+
+    def _admit_layout(self, layout: str) -> None:
+        """Predictive capacity admission: before the first dispatch of a
+        layout, ask the ledger's forward model whether it fits current
+        headroom. No capacity source (the CPU tier-1 backend) -> no
+        verdict -> admit; a definite no-fit raises DeviceCapacityError so
+        the supervisor degrades (device_oom ladder) BEFORE the allocator
+        raises RESOURCE_EXHAUSTED mid-solve."""
+        verdict = self._ledger.predict_fit(
+            self.graph.n,
+            layout,
+            n_sources=len(getattr(self, "sources", ())) or 1,
+            graph=self.graph,
+            mesh_shape=(
+                (self.mesh.shape["batch"], self.mesh.shape["graph"])
+                if self.mesh is not None
+                else None
+            ),
+        )
+        if verdict["fits"] is False:
+            self._ledger.record_refusal(verdict)
+            self._note_capacity_refusal(verdict)
+            raise DeviceCapacityError(
+                f"predicted RESOURCE_EXHAUSTED: layout {layout} for area "
+                f"{self._mem_area} needs {verdict['predicted_bytes']} bytes, "
+                f"headroom {verdict['headroom_bytes']} "
+                f"(capacity {verdict['capacity_bytes']}, "
+                f"source {verdict['source']})"
+            )
 
     def _batch_pad(self, n: int, minimum: int = 8) -> int:
         """Source-batch pad: power-of-two bucket, rounded up to a multiple
@@ -417,18 +509,27 @@ class _AreaSolve:
         self.h2d_bytes += rows.nbytes
         pc.seam("prepare")
         if self._use_tiled():
+            self._admit_layout("tile2d")
             self._d_dev, self.rounds_last = self._tile_solve_resident(rows)
         elif self.graph.sell is not None:
+            self._admit_layout("sell")
             self._d_dev, self.rounds_last = self._sell_solve_resident(rows)
         elif self.mesh is not None:
             from openr_tpu.parallel import sharded_batched_spf
 
+            self._admit_layout("replicated")
             self._d_dev = sharded_batched_spf(self.graph, rows, self.mesh)
             self.rounds_last = None  # edge-list form: rounds untracked
             self.full_solves += 1
             pc.seam("relax", self._d_dev)
         else:
+            self._admit_layout("bf")
             self._d_dev, self.rounds_last = self._bf_solve_resident(rows)
+        self._mem_register(
+            "dist",
+            (self._dev or {}).get("kind", "none"),
+            arrays=(self._d_dev,),
+        )
         self.solve_ms_last = (time.perf_counter() - t0) * 1e3
         self.last_solve_warm = self.incremental_solves > inc_before
         self.device_solves += 1
@@ -437,6 +538,7 @@ class _AreaSolve:
             # the accumulated delta cannot describe the event — poison it
             # until the consumer takes it (and full-rebuilds)
             self._d_host = None
+            self._mem_release("mirror")
             self._nh_links = None
             self._nh_mask = None
             self._delta_pending = None
@@ -589,6 +691,12 @@ class _AreaSolve:
                 + tiling.hcols.nbytes
                 + g.overloaded.nbytes
             )
+            self._mem_register(
+                "tile",
+                "tile2d",
+                arrays=(st["src_l"], st["hseg"], st["w2"], st["ov"]),
+            )
+            self._mem_register("halo", "tile2d", arrays=(st["hcols"],))
         else:
             tiling = st["tiling"]
             ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
@@ -716,6 +824,20 @@ class _AreaSolve:
                 sum(a.nbytes for a in sell.nbr)
                 + sum(a.nbytes for a in sell.wg)
                 + g.overloaded.nbytes
+            )
+            self._mem_register(
+                "sell",
+                "sell",
+                arrays=(*st["nbrs"], *st["wgs"], st["ov"]),
+            )
+            # fixed-capacity weight-patch slots (rowcol [nb,64,2] + vals
+            # [nb,64], int32): allocated fresh per patched event but the
+            # capacity is layout-constant, so the ledger carries it as one
+            # resident-equivalent entry per sell generation
+            self._mem_register(
+                "patch",
+                "sell",
+                nbytes=len(sell.nbr) * _PATCH_SLOTS * 3 * 4,
             )
         else:
             ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
@@ -918,6 +1040,11 @@ class _AreaSolve:
             }
             self.h2d_bytes += (
                 g.src.nbytes + g.dst.nbytes + g.w.nbytes + g.overloaded.nbytes
+            )
+            self._mem_register(
+                "bf",
+                "bf",
+                arrays=(st["src"], st["dst"], st["w"], st["ov"]),
             )
         else:
             ov_changed = not np.array_equal(st["ov_host"], g.overloaded)
@@ -1260,6 +1387,7 @@ class _AreaSolve:
                     w_rows[row, fwd] = INF
                     w_rows[row, rev] = INF
             st = self._dev
+            self._mem_register("ksp", "vw", arrays=(w_rows,))
             fault_point("ops.spf.batched_spf_vw", self.graph)
             d_dev, _rounds, _inv = _bf_solver_warm_vw(
                 jnp.asarray(sources, dtype=jnp.int32),
@@ -1280,14 +1408,17 @@ class _AreaSolve:
                     fwd, rev = self.graph.link_edges[link]
                     w_rows[row, fwd] = INF
                     w_rows[row, rev] = INF
+            self._mem_register("ksp", "vw", arrays=(w_rows,))
             d_rows = np.asarray(
                 batched_spf_vw(self.graph, sources, w_rows, mesh=self.mesh)
             )
             self.h2d_bytes += w_rows.nbytes
         # the penalized distance rows are consumed host-side by the greedy
         # back-trace — a real copy-back, so it rides the transfer counters
-        # like the mirror fetch does
+        # like the mirror fetch does; the per-row-weights layer upload is
+        # transient, so its ledger entry releases with the batch
         self.d2h_bytes += d_rows.nbytes
+        self._mem_release("ksp")
         self.ksp_device_batches += 1
 
         for row, (dest, ig) in enumerate(zip(todo, ignores)):
@@ -1387,6 +1518,13 @@ class TpuSpfSolver(SpfSolver):
         # tracking lives in _AreaSolve.refresh()
         self._solves: Dict[Tuple[str, str], Tuple[int, _AreaSolve]] = {}
         self.device_solves = 0  # counter: batched device calls
+        # device-memory observatory: the process-global ledger plus the
+        # compile caches as an informational external source; headroom-
+        # gated admission refusals queue here until the supervisor drains
+        # them into SOLVER_CAPACITY_REFUSED samples
+        self._ledger = get_ledger()
+        self._ledger.attach_external("compile_cache", compile_cache_memory)
+        self._capacity_refusals: List[Dict] = []
         self.warm_start = warm_start
         # resident APSP matrix knobs (docs/Apsp.md): areas up to this many
         # real nodes keep a blocked-FW all-pairs matrix on device; 0 = off
@@ -1458,6 +1596,10 @@ class TpuSpfSolver(SpfSolver):
             self.device_solves += solve.device_solves - before
             self._sync_spf_counters(solve, inc0, full0)
             return solve
+        if cached is not None:
+            # a replaced LinkState for the same area: release the stale
+            # solve's device buffers from the ledger before the rebuild
+            cached[1].close()
         solve = _AreaSolve(
             link_state,
             node,
@@ -1467,11 +1609,23 @@ class TpuSpfSolver(SpfSolver):
             apsp_audit_interval=self.apsp_audit_interval,
             apsp_dispatch=self._apsp_dispatch,
             recorder=self._recorder,
+            on_capacity_refusal=self._note_capacity_refusal,
         )
         self.device_solves += solve.device_solves
         self._sync_spf_counters(solve, 0, 0)
         self._solves[key] = (id(link_state), solve)
         return solve
+
+    def _note_capacity_refusal(self, verdict: Dict) -> None:
+        """Queue a headroom-gated admission refusal for the supervisor to
+        drain into a SOLVER_CAPACITY_REFUSED LogSample; also kept as the
+        last_capacity_refusal gauge row in getSolverHealth."""
+        self._capacity_refusals.append(dict(verdict))
+
+    def take_capacity_refusals(self) -> List[Dict]:
+        """Drain queued capacity refusals (supervisor sample emission)."""
+        out, self._capacity_refusals = self._capacity_refusals, []
+        return out
 
     def _sync_spf_counters(
         self, solve: _AreaSolve, inc0: int, full0: int
@@ -1568,6 +1722,10 @@ class TpuSpfSolver(SpfSolver):
         counters["decision.spf.compile_cache_misses"] = (
             stats["misses"] + fw_stats["misses"]
         )
+        # device-memory observatory: fold the ledger's counters + gauges
+        # (decision.mem.*) in on the same sync cadence as the transfer
+        # bytes they complement
+        self._ledger.fold_counters(counters)
 
     def _sync_apsp_counters(self, solve: _AreaSolve) -> None:
         """Fold the solve's APSP + KSP-warm stats into the decision.spf.*
@@ -1706,7 +1864,7 @@ class TpuSpfSolver(SpfSolver):
         if new_mesh is None:
             return False
         self.mesh = new_mesh
-        self._solves.clear()
+        self._close_solves()
         counters = self._ensure_counters()
         self._bump("decision.spf.mesh_degradations")
         counters["decision.spf.mesh_devices"] = int(new_mesh.devices.size)
@@ -1717,8 +1875,24 @@ class TpuSpfSolver(SpfSolver):
         recompiles the graph and solves cold. The supervisor calls this on
         breaker trips and audit mismatches — after a device fault or a
         detected divergence the resident buffers are not to be trusted."""
-        self._solves.clear()
+        self._close_solves()
         self._bump("decision.spf.warm_state_invalidations")
+
+    def close(self) -> None:
+        """Solver teardown (daemon stop): release every device-resident
+        structure this solver registered with the memory ledger. Entries
+        pinned by `solver.mem.retain` survive by design — that is the
+        leak the observatory exists to show."""
+        self._close_solves()
+
+    def _close_solves(self) -> None:
+        """Drop every cached device solve, releasing each one's ledger-
+        registered buffers first — teardown must return the ledger to its
+        pre-area baseline (the leak-regression contract)."""
+        for _, solve in self._solves.values():
+            solve.close()
+        self._solves.clear()
+        self._ledger.fold_counters(self._ensure_counters())
 
     def audit_warm_state(self) -> List[dict]:
         """Shadow cold-audit of every resident warm solve: recompute each

@@ -103,6 +103,19 @@ class TeService(CountersMixin, HistogramsMixin):
         if len(drained_rows):
             demands[:, drained_rows, :] = 0.0
             demands[:, :, drained_rows] = 0.0
+        # device-memory ledger seam (monitor/memledger.py): the [B, N, N]
+        # scenario batch + capacity vector are the TE run's device-resident
+        # working set — registered for the optimization's duration,
+        # released with the report build below
+        from openr_tpu.monitor.memledger import get_ledger
+
+        ledger = get_ledger()
+        mem_handle = ledger.register(
+            f"{area}/te",
+            "te",
+            layout="te",
+            arrays=(demands, caps),
+        )
 
         cfg = TeOptConfig(
             steps=int(params.get("steps", TeOptConfig.steps)),
@@ -135,16 +148,19 @@ class TeService(CountersMixin, HistogramsMixin):
             )
 
         supervised = getattr(self.solver, "supervised_call", None)
-        if supervised is not None:
-            result, degraded = supervised(
-                "te.optimize", primary, fallback
-            )
-        else:
-            try:
-                result, degraded = primary(), False
-            except Exception as exc:
-                log.warning("TE device optimization failed: %s", exc)
-                result, degraded = fallback(), True
+        try:
+            if supervised is not None:
+                result, degraded = supervised(
+                    "te.optimize", primary, fallback
+                )
+            else:
+                try:
+                    result, degraded = primary(), False
+                except Exception as exc:
+                    log.warning("TE device optimization failed: %s", exc)
+                    result, degraded = fallback(), True
+        finally:
+            ledger.release(mem_handle)
         if degraded:
             self._emit_degraded(area)
 

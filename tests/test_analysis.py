@@ -784,6 +784,7 @@ def test_self_run_covers_all_rule_families():
         "sentinel-overflow",
         "dtype-promotion",
         "collective-conformance",
+        "resident-accounting",
     }
 
 
@@ -2514,3 +2515,98 @@ def test_shapeflow_contracts_surface_through_build_info():
 
     handler = CtrlServer.__new__(CtrlServer)
     assert "build_analysis_contracts" in handler.m_getBuildInfo({})
+
+
+# ---------------------------------------------------------------------------
+# resident-accounting
+# ---------------------------------------------------------------------------
+
+_RESIDENT_BAD = '''
+import jax
+
+
+@jax.jit
+def _solve_core(x):
+    return x
+
+
+class Solve:
+    def warm(self, x):
+        self._d_dev = _solve_core(x)  # resident, never registered
+        return 1
+'''
+
+_RESIDENT_GOOD = '''
+import jax
+
+
+@jax.jit
+def _solve_core(x):
+    return x
+
+
+class Solve:
+    def warm(self, x):
+        self._d_dev = _solve_core(x)
+        self._mem_register("dist", arrays=(self._d_dev,))
+        return 1
+
+    def rebuild(self, x):
+        self._w_dev = _solve_core(x)
+        self._ledger.register("0/a", "w", arrays=(self._w_dev,))
+        return 1
+
+    def reset(self):
+        self._d_dev = None  # not a device value: never flagged
+
+    def _mem_register(self, structure, arrays=()):
+        pass
+'''
+
+
+def test_resident_accounting_flags_unledgered_store(tmp_path):
+    path = _write(
+        tmp_path, "openr_tpu/solver/bad_res.py", _RESIDENT_BAD
+    )
+    found, _ = _findings([path], rule="resident-accounting")
+    assert [f.check for f in found] == ["unledgered-store"], found
+    assert "self._d_dev" in found[0].message
+
+
+def test_resident_accounting_ledger_seams_stay_quiet(tmp_path):
+    path = _write(
+        tmp_path, "openr_tpu/solver/good_res.py", _RESIDENT_GOOD
+    )
+    found, _ = _findings([path], rule="resident-accounting")
+    assert found == [], found
+
+
+def test_resident_accounting_scoped_to_resident_packages(tmp_path):
+    # the same store outside solver/apsp/te is transient working state
+    path = _write(tmp_path, "openr_tpu/ops/bad_res.py", _RESIDENT_BAD)
+    found, _ = _findings([path], rule="resident-accounting")
+    assert found == [], found
+
+
+def test_resident_accounting_is_advisory_unless_strict(tmp_path):
+    path = _write(
+        tmp_path, "openr_tpu/apsp/bad_res.py", _RESIDENT_BAD
+    )
+    found, _ = _findings(
+        [path], rule="resident-accounting", strict=False
+    )
+    assert [f.severity for f in found] == ["advisory"], found
+    found, _ = _findings(
+        [path], rule="resident-accounting", strict=True
+    )
+    assert [f.severity for f in found] == ["error"], found
+
+
+def test_resident_accounting_repo_is_clean_strict():
+    """The real solver/apsp/te packages pass their own rule at strict
+    level: every device-resident store meets a ledger seam."""
+    found, _ = _findings(
+        [PKG / "solver", PKG / "apsp", PKG / "te"],
+        rule="resident-accounting",
+    )
+    assert found == [], found

@@ -135,6 +135,18 @@ def test_bench_py_smoke(capsys, monkeypatch):
         assert result["schema_version"] >= 1
         assert result["build"]
     assert results[0]["metric"].endswith("spf_recomputes_per_sec")
+    # device-memory columns (docs/Monitoring.md "Device-memory
+    # observatory"): the SPF, TE, scale-tiled and APSP lines each report
+    # the ledger's peak resident bytes for the line's working set next to
+    # the predict_fit forward model — the delta column is the standing
+    # record of how tight the admission arithmetic tracks reality
+    for idx in (0, 2, 3, 6):
+        line = results[idx]
+        assert line["mem_peak_bytes"] > 0, line["metric"]
+        assert line["mem_predicted_bytes"] > 0, line["metric"]
+        assert line["mem_predicted_vs_live_bytes"] == (
+            line["mem_predicted_bytes"] - line["mem_peak_bytes"]
+        ), line["metric"]
     # phase-split contract (ISSUE 13): the SPF line carries per-phase
     # attribution columns measured with explicit barriers, so the first
     # hardware round lands with h2d/relax/d2h split out of the headline
@@ -271,6 +283,10 @@ def test_bench_py_marks_fallback_degraded(capsys, monkeypatch):
             result["metric"].endswith("_tiled_cold_solve_ms")
         ):
             assert {"h2d_ms", "relax_ms", "d2h_ms"} == set(result["phases"])
+            # mem columns are degraded-aware too: a cpu-fallback round
+            # still accounts its (reduced) working set on the ledger
+            assert result["mem_peak_bytes"] > 0
+            assert result["mem_predicted_bytes"] > 0
 
 
 def test_bench_py_dead_backend_degrades_never_raises():

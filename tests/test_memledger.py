@@ -1,0 +1,390 @@
+"""Device-memory ledger (monitor/memledger.py): the exact-accounting
+invariant, the leak-regression contract (solver lifecycles return the
+ledger to baseline), predict_fit accuracy against measured residency,
+and the `solver.mem.retain` leak pin — docs/Monitoring.md
+"Device-memory observatory"."""
+
+import random
+
+import numpy as np
+import pytest
+
+from openr_tpu.apsp import ApspState
+from openr_tpu.monitor.memledger import MemLedger, get_ledger
+from openr_tpu.ops.graph import compile_edges
+from openr_tpu.parallel import resolve_mesh
+from openr_tpu.solver import TpuSpfSolver
+from openr_tpu.solver.tpu import _AreaSolve
+from openr_tpu.testing.faults import FaultInjector, injected
+from openr_tpu.topology import build_adj_dbs, grid_edges, wan_edges
+
+from test_tpu_solver import apply_random_event
+from test_tpu_solver_mesh import build_ls, make_prefix_state
+
+PFXS = ["10.1.0.0/16"]
+
+
+def _totals(ledger):
+    return ledger.snapshot()["totals"]
+
+
+def assert_exact(ledger):
+    snap = ledger.snapshot()
+    t = snap["totals"]
+    assert snap["exact"], t
+    assert t["registered_bytes"] == t["live_bytes"] + t["freed_bytes"], t
+    live = sum(e["nbytes"] for e in snap["entries"])
+    assert live == t["live_bytes"], (live, t)
+
+
+def _live_handles(ledger):
+    return {e["handle"] for e in ledger.snapshot()["entries"]}
+
+
+# ---------------------------------------------------------------------------
+# exact accounting (standalone ledger)
+# ---------------------------------------------------------------------------
+
+
+class TestExactAccounting:
+    def test_register_update_release_cycle(self):
+        led = MemLedger()
+        a = np.zeros((8, 16), np.int32)
+        h = led.register("0/a", "dist", layout="sell", arrays=(a,))
+        assert_exact(led)
+        t = _totals(led)
+        assert t["live_bytes"] == a.nbytes
+        assert t["registered_bytes"] == a.nbytes
+        assert t["peak_bytes"] == a.nbytes
+
+        # grow in place: delta flows through registered, not freed
+        b = np.zeros((16, 16), np.int32)
+        led.update(h, arrays=(b,))
+        assert_exact(led)
+        assert _totals(led)["live_bytes"] == b.nbytes
+
+        # shrink in place: delta flows through freed
+        led.update(h, arrays=(a,))
+        assert_exact(led)
+        t = _totals(led)
+        assert t["live_bytes"] == a.nbytes
+        assert t["freed_bytes"] == b.nbytes - a.nbytes
+        assert t["peak_bytes"] == b.nbytes
+
+        assert led.release(h) is True
+        assert_exact(led)
+        t = _totals(led)
+        assert t["live_bytes"] == 0
+        assert t["registered_bytes"] == t["freed_bytes"]
+        # double release is inert
+        assert led.release(h) is False
+        assert led.release(None) is False
+        assert_exact(led)
+
+    def test_structure_and_area_folds(self):
+        led = MemLedger()
+        led.register("0/a", "dist", layout="sell",
+                     arrays=(np.zeros(64, np.int32),))
+        led.register("0/a", "sell", layout="sell", nbytes=100)
+        led.register("0/b", "apsp", layout="apsp", nbytes=900)
+        led.register("0/b", "weird", layout="host", nbytes=7)
+        snap = led.snapshot()
+        assert snap["structures"]["dist"] == 256
+        assert snap["structures"]["sell"] == 100
+        assert snap["structures"]["apsp"] == 900
+        # unknown structures fold onto the fixed gauge vocabulary
+        assert snap["structures"]["other"] == 7
+        assert snap["areas"]["0/a"] == 356
+        assert snap["areas"]["0/b"] == 907
+        # per-area filter narrows entries but keeps process totals
+        sub = led.snapshot(area="0/b")
+        assert {e["structure"] for e in sub["entries"]} == {
+            "apsp", "weird"
+        }
+        assert sub["totals"] == snap["totals"]
+
+    def test_release_area(self):
+        led = MemLedger()
+        led.register("0/a", "dist", layout="sell", nbytes=10)
+        led.register("0/a", "sell", layout="sell", nbytes=20)
+        led.register("0/b", "dist", layout="sell", nbytes=30)
+        assert led.release_area("0/a") == 2
+        assert_exact(led)
+        t = _totals(led)
+        assert t["live_bytes"] == 30
+        assert t["freed_bytes"] == 30
+
+    def test_capacity_override_and_refusal(self):
+        led = MemLedger(capacity_bytes=1 << 20)
+        cap = led.capacity()
+        assert cap["capacity_bytes"] == 1 << 20
+        assert cap["source"] == "override"
+        # 4096 nodes of FW triple cannot fit a 1 MiB budget
+        verdict = led.predict_fit(4096, "apsp")
+        assert verdict["fits"] is False
+        assert verdict["predicted_bytes"] > verdict["headroom_bytes"]
+        led.record_refusal(verdict)
+        snap = led.snapshot()
+        assert snap["totals"]["capacity_refusals"] == 1
+        assert snap["last_refusal"]["layout"] == "apsp"
+        # a small graph fits the same budget
+        assert led.predict_fit(16, "apsp")["fits"] is True
+
+    def test_no_capacity_source_yields_open_verdict(self):
+        # the tier-1 CPU backend exposes no bytes_limit: fits must be
+        # None ("no capacity source, callers use their fallback gate"),
+        # never a definite yes/no invented from thin air
+        led = MemLedger()
+        if led.capacity()["capacity_bytes"] is None:
+            assert led.predict_fit(64, "bf")["fits"] is None
+
+
+# ---------------------------------------------------------------------------
+# the solver.mem.retain leak pin (standalone ledger, global fault seam)
+# ---------------------------------------------------------------------------
+
+
+class TestRetainFault:
+    def test_retain_pins_entry_live_and_stays_exact(self):
+        led = MemLedger()
+        h = led.register("0/a", "dist", layout="sell", nbytes=512)
+        led.register("0/a", "sell", layout="sell", nbytes=128)
+        with injected(FaultInjector(seed=1)) as inj:
+            inj.arm(
+                "solver.mem.retain",
+                times=1,
+                action=lambda ctx: setattr(ctx, "retain", True),
+            )
+            # the release is pinned: not freed, still live
+            assert led.release(h) is False
+            assert inj.fired("solver.mem.retain") == 1
+        assert_exact(led)
+        t = _totals(led)
+        assert t["retained"] == 1
+        assert t["live_bytes"] == 512 + 128
+        assert t["freed_bytes"] == 0
+        pinned = [
+            e for e in led.snapshot()["entries"] if e["retained"]
+        ]
+        assert len(pinned) == 1 and pinned[0]["structure"] == "dist"
+        # a pinned entry stays pinned: later releases are inert
+        assert led.release(h) is False
+        assert _totals(led)["live_bytes"] == 512 + 128
+
+    def test_unarmed_release_is_a_real_free(self):
+        led = MemLedger()
+        h = led.register("0/a", "dist", layout="sell", nbytes=64)
+        with injected(FaultInjector(seed=1)):
+            assert led.release(h) is True  # armed point, no spec
+        t = _totals(led)
+        assert t["retained"] == 0 and t["live_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# leak regression: solver lifecycles return the ledger to baseline
+# ---------------------------------------------------------------------------
+
+
+class TestLeakRegression:
+    def test_warm_solves_and_teardown_return_to_baseline(self):
+        led = get_ledger()
+        base = _live_handles(led)
+        edges = wan_edges(16, seed=3)
+        dbs = build_adj_dbs(edges)
+        # build the LinkState from the same dbs so events mutate it
+        from openr_tpu.lsdb import LinkState
+
+        ls = LinkState("0")
+        for db in dbs.values():
+            ls.update_adjacency_database(db)
+        ps = make_prefix_state({"w1": PFXS})
+        tpu = TpuSpfSolver("w0")
+        tpu.build_route_db("w0", {"0": ls}, ps)
+        assert _live_handles(led) - base, "solver registered nothing"
+        assert_exact(led)
+        rng = random.Random(7)
+        links = list(edges)
+        for _ in range(3):
+            apply_random_event(rng, dbs, ls, links)
+            tpu.build_route_db("w0", {"0": ls}, ps)
+            assert_exact(led)
+        tpu.close()
+        assert _live_handles(led) == base
+        assert_exact(led)
+
+    def test_mesh_degrade_and_invalidation_return_to_baseline(self):
+        led = get_ledger()
+        base = _live_handles(led)
+        edges = grid_edges(4)
+        ls = build_ls(edges)
+        ps = make_prefix_state({"g1_1": PFXS})
+        tpu = TpuSpfSolver("g0_0", mesh=(2, 2))
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert _live_handles(led) - base
+        # mesh degradation drops every cached solve -> baseline
+        assert tpu.degrade_mesh() is True
+        assert _live_handles(led) == base
+        # the next solve re-registers on the degraded mesh
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        assert _live_handles(led) - base
+        # warm-state invalidation (breaker trip / audit mismatch path)
+        tpu.invalidate_warm_state()
+        assert _live_handles(led) == base
+        tpu.build_route_db("g0_0", {"0": ls}, ps)
+        tpu.close()
+        assert _live_handles(led) == base
+        assert_exact(led)
+
+    def test_apsp_invalidation_returns_to_baseline(self):
+        led = get_ledger()
+        base = _live_handles(led)
+        g = compile_edges(wan_edges(32, degree=4, seed=7))
+        apsp = ApspState(max_nodes=64, area="test/apsp")
+        assert apsp.ensure(g) is True
+        grown = _live_handles(led) - base
+        assert grown
+        assert_exact(led)
+        apsp.invalidate("test_staleness")
+        assert _live_handles(led) == base
+        assert apsp.ensure(g) is True
+        apsp.close()
+        assert _live_handles(led) == base
+        assert_exact(led)
+
+
+# ---------------------------------------------------------------------------
+# predict_fit accuracy: the forward model vs measured residency
+# ---------------------------------------------------------------------------
+
+
+def _area_live_bytes(ledger, area, skip=("mirror",), exclude=frozenset()):
+    # `exclude` carries the handles live before the structure under test
+    # was built: the ledger is process-global, and earlier tests in the
+    # same pytest process (the bench contract tests especially) may hold
+    # entries under the same area string
+    return sum(
+        e["nbytes"]
+        for e in ledger.snapshot(area=area)["entries"]
+        if e["area"] == area
+        and e["structure"] not in skip
+        and e["handle"] not in exclude
+    )
+
+
+def assert_within(predicted, live, frac=0.10):
+    assert live > 0
+    assert abs(predicted - live) <= frac * live, (predicted, live)
+
+
+class TestPredictFitAccuracy:
+    def test_sell_layout_within_ten_percent(self):
+        led = get_ledger()
+        before = _live_handles(led)
+        ls = build_ls(wan_edges(24, seed=2))
+        solve = _AreaSolve(ls, "w0", mesh=None)
+        try:
+            kind = (solve._dev or {}).get("kind")
+            assert kind == "sell", kind
+            verdict = led.predict_fit(
+                solve.graph.n,
+                kind,
+                n_sources=len(getattr(solve, "sources", ())) or 1,
+                graph=solve.graph,
+            )
+            live = _area_live_bytes(
+                led, solve._mem_area, exclude=before
+            )
+            assert_within(verdict["predicted_bytes"], live)
+        finally:
+            solve.close()
+
+    def test_edge_list_layout_within_ten_percent(self, monkeypatch):
+        # the resident edge-list planes (src/dst/w + ov) are the bf
+        # layout; `replicated` (the sharded full-solve path) shares the
+        # same predict_fit arithmetic but keeps no resident planes, so
+        # accuracy is pinned on the resident variant. Sell is always
+        # built for real edge lists — strip it to force this path.
+        import openr_tpu.solver.tpu as tpu_mod
+
+        real_compile = tpu_mod.compile_graph
+
+        def no_sell(ls):
+            g = real_compile(ls)
+            g.sell = None
+            return g
+
+        monkeypatch.setattr(tpu_mod, "compile_graph", no_sell)
+        led = get_ledger()
+        before = _live_handles(led)
+        ls = build_ls(wan_edges(24, seed=2))
+        solve = _AreaSolve(ls, "w0", mesh=None)
+        try:
+            kind = (solve._dev or {}).get("kind")
+            assert kind == "bf", kind
+            verdict = led.predict_fit(
+                solve.graph.n,
+                kind,
+                n_sources=len(getattr(solve, "sources", ())) or 1,
+                graph=solve.graph,
+            )
+            live = _area_live_bytes(
+                led, solve._mem_area, exclude=before
+            )
+            assert_within(verdict["predicted_bytes"], live)
+            # the replicated layout is the same logical footprint
+            repl = led.predict_fit(
+                solve.graph.n,
+                "replicated",
+                n_sources=len(getattr(solve, "sources", ())) or 1,
+                graph=solve.graph,
+            )
+            assert (
+                repl["predicted_bytes"] == verdict["predicted_bytes"]
+            ), (repl, verdict)
+        finally:
+            solve.close()
+
+    def test_tile2d_layout_within_ten_percent(self):
+        led = get_ledger()
+        before = _live_handles(led)
+        mesh = resolve_mesh((2, 2))
+        ls = build_ls(grid_edges(4))
+        solve = _AreaSolve(ls, "g0_0", mesh=mesh)
+        try:
+            kind = (solve._dev or {}).get("kind")
+            assert kind == "tile2d", kind
+            verdict = led.predict_fit(
+                solve.graph.n,
+                kind,
+                n_sources=len(getattr(solve, "sources", ())) or 1,
+                graph=solve.graph,
+                mesh_shape=(
+                    mesh.shape["batch"], mesh.shape["graph"]
+                ),
+            )
+            live = _area_live_bytes(
+                led, solve._mem_area, exclude=before
+            )
+            assert_within(verdict["predicted_bytes"], live)
+        finally:
+            solve.close()
+
+    def test_apsp_layout_is_exact(self):
+        led = get_ledger()
+        before = _live_handles(led)
+        g = compile_edges(wan_edges(48, degree=4, seed=7))
+        apsp = ApspState(max_nodes=64, area="test/apsp-fit")
+        try:
+            assert apsp.ensure(g) is True
+            verdict = led.predict_fit(g.n, "apsp", graph=g)
+            live = _area_live_bytes(
+                led, "test/apsp-fit", exclude=before
+            )
+            # the FW triple is fully determined by n_pad: exact, not
+            # merely within tolerance
+            assert verdict["predicted_bytes"] == live, (
+                verdict["components"],
+                live,
+            )
+        finally:
+            apsp.close()
